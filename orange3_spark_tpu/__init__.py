@@ -1,0 +1,38 @@
+"""orange3_spark_tpu — a TPU-native dataflow data-mining framework.
+
+Re-creates the capabilities of the Orange3-Spark add-on (Orange visual
+workflows executing on Spark DataFrames + MLlib estimators) with a
+JAX/XLA-native backend: columnar tables of GSPMD-sharded ``jax.Array``
+columns, MLlib-style Estimator/Transformer/Pipeline ML on top of
+``jit``/``shard_map`` over a ``jax.sharding.Mesh``, and an Orange-style
+widget/signal workflow graph that can be staged into a single XLA
+computation.
+
+Reference parity note: the reference mount (/root/reference) was empty in
+every session so far (see SURVEY.md §0); the capability target is defined
+by BASELINE.json + the public Orange3-Spark API surface (OWSpark* widgets
+wrapping pyspark.sql.DataFrame and pyspark.ml estimators).
+"""
+
+from orange3_spark_tpu.core.domain import (
+    ContinuousVariable,
+    DiscreteVariable,
+    Domain,
+    StringVariable,
+    Variable,
+)
+from orange3_spark_tpu.core.session import TpuSession
+from orange3_spark_tpu.core.table import TpuTable
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ContinuousVariable",
+    "DiscreteVariable",
+    "Domain",
+    "StringVariable",
+    "TpuSession",
+    "TpuTable",
+    "Variable",
+    "__version__",
+]

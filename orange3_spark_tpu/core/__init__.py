@@ -1,0 +1,19 @@
+from orange3_spark_tpu.core.domain import (
+    ContinuousVariable,
+    DiscreteVariable,
+    Domain,
+    StringVariable,
+    Variable,
+)
+from orange3_spark_tpu.core.session import TpuSession
+from orange3_spark_tpu.core.table import TpuTable
+
+__all__ = [
+    "ContinuousVariable",
+    "DiscreteVariable",
+    "Domain",
+    "StringVariable",
+    "TpuSession",
+    "TpuTable",
+    "Variable",
+]

@@ -1,0 +1,76 @@
+"""Dataset loaders + synthetic generators matching BASELINE.md's configs.
+
+Config 1 uses the real Iris table; configs 2–5 (Criteo-1B, HIGGS-11M,
+MovieLens-25M, NYC-Taxi-1B) are served by shape-faithful synthetic generators
+— the real corpora aren't on this machine (zero egress), and the baseline
+metric is rows/sec throughput, which the generators reproduce at any scale.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
+from orange3_spark_tpu.core.table import TpuTable
+
+
+def load_iris(session=None) -> TpuTable:
+    """Iris-150 as a TpuTable (BASELINE config 1)."""
+    from sklearn.datasets import load_iris as _sk_iris
+
+    data = _sk_iris()
+    attrs = [ContinuousVariable(n) for n in data.feature_names]
+    cvar = DiscreteVariable("iris", tuple(data.target_names))
+    domain = Domain(attrs, cvar)
+    return TpuTable.from_numpy(domain, data.data, data.target, session=session)
+
+
+def make_classification(
+    n_rows: int,
+    n_features: int,
+    n_classes: int = 2,
+    seed: int = 0,
+    noise: float = 1.0,
+    session=None,
+) -> TpuTable:
+    """Linear-separable-ish synthetic classifier data (Criteo/HIGGS stand-in)."""
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n_rows, n_features), dtype=np.float32)
+    true_w = rng.standard_normal((n_features, n_classes)).astype(np.float32)
+    logits = X @ true_w + noise * rng.standard_normal((n_rows, n_classes)).astype(np.float32)
+    y = np.argmax(logits, axis=1).astype(np.float32)
+    domain = Domain(
+        [ContinuousVariable(f"f{i}") for i in range(n_features)],
+        DiscreteVariable("label", tuple(str(c) for c in range(n_classes))),
+    )
+    return TpuTable.from_numpy(domain, X, y, session=session)
+
+
+def make_blobs(
+    n_rows: int, n_features: int, n_centers: int, seed: int = 0, spread: float = 0.5,
+    session=None,
+) -> tuple[TpuTable, np.ndarray]:
+    """Gaussian blobs for KMeans testing (NYC-Taxi stand-in)."""
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(-5, 5, size=(n_centers, n_features)).astype(np.float32)
+    assign = rng.integers(0, n_centers, size=n_rows)
+    X = centers[assign] + spread * rng.standard_normal((n_rows, n_features)).astype(np.float32)
+    domain = Domain([ContinuousVariable(f"f{i}") for i in range(n_features)])
+    return TpuTable.from_numpy(domain, X, session=session), assign
+
+
+def make_ratings(
+    n_users: int, n_items: int, n_ratings: int, rank: int = 8, seed: int = 0,
+    noise: float = 0.1,
+) -> np.ndarray:
+    """(user, item, rating) triples from a low-rank model (MovieLens stand-in).
+
+    Returns a float32 [n_ratings, 3] array; duplicates possible like real logs.
+    """
+    rng = np.random.default_rng(seed)
+    U = rng.standard_normal((n_users, rank)).astype(np.float32) / np.sqrt(rank)
+    V = rng.standard_normal((n_items, rank)).astype(np.float32) / np.sqrt(rank)
+    users = rng.integers(0, n_users, size=n_ratings)
+    items = rng.integers(0, n_items, size=n_ratings)
+    ratings = np.sum(U[users] * V[items], axis=1) + noise * rng.standard_normal(n_ratings).astype(np.float32)
+    return np.stack([users.astype(np.float32), items.astype(np.float32), ratings], axis=1)

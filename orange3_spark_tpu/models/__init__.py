@@ -1,0 +1,3 @@
+from orange3_spark_tpu.models.base import Estimator, Model, Params, Pipeline, PipelineModel, Transformer
+
+__all__ = ["Estimator", "Model", "Params", "Pipeline", "PipelineModel", "Transformer"]

@@ -1,0 +1,160 @@
+"""MLlib-style Estimator / Transformer / Pipeline protocol.
+
+The reference exposes ``pyspark.ml.Estimator.fit(df) -> Model`` and
+``Transformer.transform(df) -> df``, with hyper-parameters as introspectable
+``Param`` objects that the add-on uses to auto-generate widget GUIs
+(SURVEY.md §2b "Estimator/Transformer/Pipeline API"; reconstructed, mount
+empty — the auto-generation-from-params pattern is the add-on's signature
+design and is preserved here). TPU-native redesign: params are frozen
+dataclasses (hashable → usable as jit static args; introspectable via
+``dataclasses.fields`` → widget auto-generation in widgets/autogen.py), and a
+fitted Model is a host object wrapping a **pytree of device arrays** so it
+can be checkpointed, donated, and passed through staged workflow graphs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Sequence
+
+import numpy as np
+
+from orange3_spark_tpu.core.table import TpuTable
+
+
+@dataclasses.dataclass(frozen=True)
+class Params:
+    """Base for estimator hyper-parameter dataclasses.
+
+    Frozen (hashable) so a params instance can be a jit static argument and a
+    dict key in compile caches. ``describe()`` yields (name, type, default)
+    triples — the introspection surface the widget auto-generator consumes,
+    playing the role of ``pyspark.ml.param.Param`` metadata in the reference.
+    """
+
+    def replace(self, **kwargs) -> "Params":
+        return dataclasses.replace(self, **kwargs)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def describe(cls) -> list[tuple[str, type, Any]]:
+        return [(f.name, f.type, f.default) for f in dataclasses.fields(cls)]
+
+
+class Transformer:
+    """transform(table) -> table. Stateless or carrying fitted state."""
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        raise NotImplementedError
+
+    def __call__(self, table: TpuTable) -> TpuTable:
+        return self.transform(table)
+
+
+class Model(Transformer):
+    """A fitted model: hyper-params + a pytree of device arrays.
+
+    Subclasses set ``self.params`` and expose fitted state through
+    ``state_pytree`` for checkpointing (utils/checkpoint.py).
+    """
+
+    params: Params
+
+    @property
+    def state_pytree(self) -> dict[str, Any]:
+        raise NotImplementedError
+
+    def load_state_pytree(self, state: dict[str, Any]) -> None:
+        for k, v in state.items():
+            setattr(self, k, v)
+
+
+class Estimator:
+    """fit(table) -> Model.  Subclasses define ``ParamsCls`` and ``_fit``."""
+
+    ParamsCls: type[Params] = Params
+
+    def __init__(self, params: Params | None = None, **kwargs):
+        if params is None:
+            params = self.ParamsCls(**kwargs)
+        elif kwargs:
+            params = params.replace(**kwargs)
+        self.params = params
+        self.last_fit_metrics: dict[str, float] = {}
+
+    def fit(self, table: TpuTable) -> Model:
+        t0 = time.perf_counter()
+        model = self._fit(table)
+        dt = time.perf_counter() - t0
+        # rows/sec/chip is THE baseline metric (BASELINE.json "metric").
+        n_chips = table.session.n_devices
+        self.last_fit_metrics = {
+            "fit_seconds": dt,
+            "rows_per_sec_per_chip": table.n_rows / dt / max(n_chips, 1),
+        }
+        return model
+
+    def _fit(self, table: TpuTable) -> Model:
+        raise NotImplementedError
+
+    def fit_transform(self, table: TpuTable) -> TpuTable:
+        return self.fit(table).transform(table)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"{type(self).__name__}({self.params})"
+
+
+class Pipeline(Estimator):
+    """Chain of estimators/transformers (pyspark.ml.Pipeline equivalent)."""
+
+    def __init__(self, stages: Sequence[Estimator | Transformer]):
+        super().__init__(Params())
+        self.stages = list(stages)
+
+    def _fit(self, table: TpuTable) -> "PipelineModel":
+        fitted: list[Transformer] = []
+        for stage in self.stages:
+            if isinstance(stage, Estimator):
+                model = stage.fit(table)
+                fitted.append(model)
+                table = model.transform(table)
+            else:
+                fitted.append(stage)
+                table = stage.transform(table)
+        return PipelineModel(fitted)
+
+
+class PipelineModel(Model):
+    def __init__(self, stages: Sequence[Transformer]):
+        self.params = Params()
+        self.stages = list(stages)
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        for stage in self.stages:
+            table = stage.transform(table)
+        return table
+
+    @property
+    def state_pytree(self) -> dict[str, Any]:
+        return {
+            f"stage{i}": s.state_pytree
+            for i, s in enumerate(self.stages)
+            if isinstance(s, Model)
+        }
+
+    def load_state_pytree(self, state: dict[str, Any]) -> None:
+        for key, sub in state.items():
+            idx = int(key.removeprefix("stage"))
+            stage = self.stages[idx]
+            if not isinstance(stage, Model):
+                raise ValueError(f"checkpoint has state for non-model stage {idx}")
+            stage.load_state_pytree(sub)
+
+
+def predictions_to_numpy(table: TpuTable, column: str = "prediction") -> np.ndarray:
+    """Collect one prediction column to host, stripping padding."""
+    col = table.column(column)
+    return np.asarray(col)[: table.n_rows]

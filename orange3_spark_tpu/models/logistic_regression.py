@@ -1,0 +1,136 @@
+"""LogisticRegression — the reference's flagship estimator, TPU-native.
+
+Capability parity target: ``pyspark.ml.classification.LogisticRegression``
+as wrapped by the add-on's auto-generated OWSparkLogisticRegression-style
+widget (SURVEY.md §2b; reconstructed — reference mount empty). Param names
+mirror MLlib's (maxIter→max_iter etc.) so widget auto-generation and ported
+user code line up.
+
+Design: multinomial softmax fit by the fused L-BFGS program in _linear.py —
+one XLA computation for the whole fit, gradients all-reduced over ICI by
+GSPMD instead of MLlib's per-iteration treeAggregate shuffle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models._linear import column_inv_std, fit_linear
+from orange3_spark_tpu.models.base import Estimator, Model, Params
+
+
+@dataclasses.dataclass(frozen=True)
+class LogisticRegressionParams(Params):
+    max_iter: int = 100            # MLlib maxIter
+    reg_param: float = 0.0         # MLlib regParam (L2 when elastic_net=0)
+    elastic_net_param: float = 0.0 # MLlib elasticNetParam (L1 mixing; TODO OWLQN)
+    tol: float = 1e-6              # MLlib tol
+    fit_intercept: bool = True     # MLlib fitIntercept
+    family: str = "auto"           # 'auto' | 'binomial' | 'multinomial'
+    standardization: bool = True   # MLlib standardization
+    threshold: float = 0.5         # MLlib threshold (binomial decision cut)
+    compute_dtype: str = "float32" # 'bfloat16' for MXU-rate fits on big data
+
+
+class LogisticRegressionModel(Model):
+    def __init__(self, params, coef, intercept, class_values):
+        self.params = params
+        self.coef = coef              # f32[d, k]
+        self.intercept = intercept    # f32[k]
+        self.class_values = tuple(class_values)
+        self.n_iter_: int | None = None
+
+    @property
+    def state_pytree(self):
+        return {"coef": self.coef, "intercept": self.intercept}
+
+    @staticmethod
+    @jax.jit
+    def _predict_kernel(X, coef, intercept, threshold):
+        logits = X @ coef + intercept
+        prob = jax.nn.softmax(logits, axis=-1)
+        if coef.shape[1] == 2:
+            # MLlib binomial semantics: predict class 1 iff P(1) > threshold
+            pred = (prob[:, 1] > threshold).astype(jnp.float32)
+        else:
+            pred = jnp.argmax(logits, axis=-1).astype(jnp.float32)
+        return prob, pred
+
+    def _predict(self, X):
+        return self._predict_kernel(
+            X, self.coef, self.intercept, jnp.float32(self.params.threshold)
+        )
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        """Append probability_<c> and prediction columns (Spark's
+        probability/prediction output columns on the transformed DataFrame)."""
+        prob, pred = self._predict(table.X)
+        new_attrs = list(table.domain.attributes) + [
+            ContinuousVariable(f"probability_{c}") for c in self.class_values
+        ] + [DiscreteVariable("prediction", self.class_values)]
+        new_domain = Domain(new_attrs, table.domain.class_vars, table.domain.metas)
+        X = jnp.concatenate([table.X, prob, pred[:, None]], axis=1)
+        return table.with_X(X, new_domain)
+
+    def predict(self, table: TpuTable) -> np.ndarray:
+        _, pred = self._predict(table.X)
+        return np.asarray(pred)[: table.n_rows]
+
+    def predict_proba(self, table: TpuTable) -> np.ndarray:
+        prob, _ = self._predict(table.X)
+        return np.asarray(prob)[: table.n_rows]
+
+
+class LogisticRegression(Estimator):
+    ParamsCls = LogisticRegressionParams
+    params: LogisticRegressionParams
+
+    def _fit(self, table: TpuTable) -> LogisticRegressionModel:
+        p = self.params
+        if p.elastic_net_param != 0.0:
+            # L1/elastic-net needs an OWLQN-style prox step; explicit error
+            # beats silently fitting pure L2 (MLlib would use OWLQN here).
+            raise NotImplementedError(
+                "elastic_net_param != 0 (L1) is not implemented yet; use reg_param (L2)"
+            )
+        y = table.y
+        cvar = table.domain.class_var
+        if isinstance(cvar, DiscreteVariable) and cvar.values:
+            class_values = cvar.values
+        else:
+            class_values = tuple(
+                str(int(v)) for v in range(int(np.asarray(jnp.max(y)).item()) + 1)
+            )
+        k = len(class_values)
+        if p.family == "binomial" and k != 2:
+            raise ValueError(f"binomial family needs 2 classes, got {k}")
+
+        X, w = table.X, table.W
+        inv_std = None
+        if p.standardization:
+            inv_std = column_inv_std(X, w)
+            X = X * inv_std  # scale-only, MLlib-style
+
+        result = fit_linear(
+            X, y, w,
+            jnp.float32(p.reg_param),
+            jnp.float32(p.tol),
+            jnp.int32(p.max_iter),
+            loss_kind="logistic",
+            k=k,
+            fit_intercept=p.fit_intercept,
+            compute_dtype=jnp.dtype(p.compute_dtype),
+        )
+        coef = result.coef
+        if inv_std is not None:
+            coef = coef * inv_std[:, None]  # back to original feature space
+        model = LogisticRegressionModel(p, coef, result.intercept, class_values)
+        model.n_iter_ = int(result.n_iter)
+        return model

@@ -1,0 +1,3 @@
+from orange3_spark_tpu.ops.stats import weighted_moments
+
+__all__ = ["weighted_moments"]

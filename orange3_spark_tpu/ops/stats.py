@@ -1,0 +1,39 @@
+"""Shared weighted-statistics kernels.
+
+One definition of weighted mean/variance for the whole framework (describe,
+standardization, Gramian centering) so numerics can never silently diverge
+between call sites. All reductions contract over the sharded row axis — GSPMD
+inserts the ICI all-reduce (MLlib computes the same moments with a
+MultivariateOnlineSummarizer treeAggregate; SURVEY.md §2b, reconstructed).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: guard for total-weight division on empty/fully-filtered tables
+EPS_TOTAL_WEIGHT = 1e-12
+
+
+@jax.jit
+def weighted_moments(X, w):
+    """Per-column weighted moments of row-sharded X.
+
+    Returns (mean[d], var[d], total_weight[]) — population variance, the
+    MLlib convention for standardization.
+    """
+    tot = jnp.maximum(jnp.sum(w), EPS_TOTAL_WEIGHT)
+    wcol = w[:, None]
+    mean = jnp.sum(X * wcol, axis=0) / tot
+    var = jnp.sum((X - mean) ** 2 * wcol, axis=0) / tot
+    return mean, var, tot
+
+
+@jax.jit
+def inv_std_scale(X, w):
+    """1/std per column (1.0 for constant columns) — MLlib-style scale-only
+    standardization factor."""
+    _, var, _ = weighted_moments(X, w)
+    std = jnp.sqrt(var)
+    return jnp.where(std > 1e-12, 1.0 / std, 1.0)

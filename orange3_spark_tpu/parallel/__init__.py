@@ -1,0 +1,7 @@
+from orange3_spark_tpu.parallel.collectives import (
+    data_parallel_sum,
+    distributed_gramian,
+    tree_aggregate,
+)
+
+__all__ = ["data_parallel_sum", "distributed_gramian", "tree_aggregate"]

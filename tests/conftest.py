@@ -1,0 +1,33 @@
+"""Test harness: 8 fake CPU devices so every mesh/psum/shard_map path runs in
+plain pytest without a TPU — the analogue of PySpark's local[N] test master
+(SURVEY.md §4)."""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from orange3_spark_tpu.core.session import TpuSession  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def session() -> TpuSession:
+    assert len(jax.devices()) == 8, "expected 8 fake CPU devices"
+    return TpuSession.builder_get_or_create()
+
+
+@pytest.fixture(scope="session")
+def iris(session):
+    from orange3_spark_tpu.datasets import load_iris
+
+    return load_iris(session)

@@ -1,0 +1,115 @@
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.datasets import load_iris, make_classification
+from orange3_spark_tpu.models.logistic_regression import LogisticRegression
+
+
+def test_iris_accuracy_vs_sklearn(session, iris):
+    """BASELINE config 1: Iris LogReg, correctness vs sklearn."""
+    est = LogisticRegression(max_iter=200, reg_param=1e-4)
+    model = est.fit(iris)
+    pred = model.predict(iris)
+    y = np.asarray(iris.to_numpy()[1])[:, 0]
+    acc = np.mean(pred == y)
+
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    X = iris.to_numpy()[0]
+    sk = SkLR(max_iter=500, C=1e4).fit(X, y)
+    sk_acc = sk.score(X, y)
+    assert acc >= sk_acc - 0.02, f"ours {acc} vs sklearn {sk_acc}"
+    agreement = np.mean(pred == sk.predict(X))
+    assert agreement >= 0.95
+
+
+def test_binary_classification(session):
+    t = make_classification(600, 10, n_classes=2, seed=1, noise=0.1, session=session)
+    model = LogisticRegression(max_iter=100).fit(t)
+    pred = model.predict(t)
+    y = t.to_numpy()[1][:, 0]
+    assert np.mean(pred == y) > 0.95
+
+
+def test_probabilities_sum_to_one(session, iris):
+    model = LogisticRegression(max_iter=50).fit(iris)
+    proba = model.predict_proba(iris)
+    assert proba.shape == (150, 3)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_transform_appends_columns(session, iris):
+    model = LogisticRegression(max_iter=50).fit(iris)
+    out = model.transform(iris)
+    names = [v.name for v in out.domain.attributes]
+    assert "prediction" in names
+    assert any(n.startswith("probability_") for n in names)
+    assert out.n_attrs == iris.n_attrs + 3 + 1
+
+
+def test_weighted_fit_ignores_zero_weight_rows(session):
+    """Filtered rows must not influence the fit (Spark filter semantics)."""
+    t = make_classification(400, 5, n_classes=2, seed=2, session=session)
+    X, Y, _ = t.to_numpy()
+    # corrupt second half with flipped labels, then filter it out
+    Y2 = Y.copy()
+    Y2[200:] = 1 - Y2[200:]
+    from orange3_spark_tpu.core.table import TpuTable
+
+    corrupt = TpuTable.from_numpy(t.domain, X, Y2, session=session)
+    import jax.numpy as jnp
+
+    mask = jnp.arange(corrupt.n_pad) < 200
+    filtered = corrupt.filter(mask)
+    m_filtered = LogisticRegression(max_iter=100).fit(filtered)
+
+    clean_half = TpuTable.from_numpy(t.domain, X[:200], Y[:200], session=session)
+    m_clean = LogisticRegression(max_iter=100).fit(clean_half)
+
+    np.testing.assert_allclose(
+        np.asarray(m_filtered.coef), np.asarray(m_clean.coef), rtol=1e-3, atol=1e-4
+    )
+
+
+def test_regularization_shrinks_coefficients(session, iris):
+    loose = LogisticRegression(max_iter=100, reg_param=0.0).fit(iris)
+    tight = LogisticRegression(max_iter=100, reg_param=1.0).fit(iris)
+    assert np.linalg.norm(np.asarray(tight.coef)) < np.linalg.norm(np.asarray(loose.coef))
+
+
+def test_standardization_off(session, iris):
+    model = LogisticRegression(max_iter=200, standardization=False, reg_param=1e-4).fit(iris)
+    pred = model.predict(iris)
+    y = iris.to_numpy()[1][:, 0]
+    assert np.mean(pred == y) > 0.9
+
+
+def test_fit_metrics_recorded(session, iris):
+    est = LogisticRegression(max_iter=20)
+    est.fit(iris)
+    assert est.last_fit_metrics["rows_per_sec_per_chip"] > 0
+
+
+def test_max_iter_zero_returns_init(session, iris):
+    """MLlib maxIter=0 semantics: no optimization step, zero coefficients."""
+    model = LogisticRegression(max_iter=0).fit(iris)
+    assert model.n_iter_ == 0
+    assert np.allclose(np.asarray(model.coef), 0.0)
+
+
+def test_binomial_threshold_changes_predictions(session):
+    t = make_classification(300, 5, n_classes=2, seed=3, noise=2.0, session=session)
+    model = LogisticRegression(max_iter=50).fit(t)
+    low = model.params.replace(threshold=0.01)
+    high = model.params.replace(threshold=0.99)
+    model.params = low
+    pred_low = model.predict(t)
+    model.params = high
+    pred_high = model.predict(t)
+    # low threshold predicts class 1 almost everywhere, high almost nowhere
+    assert pred_low.mean() > pred_high.mean()
+
+
+def test_elastic_net_not_silently_ignored(session, iris):
+    with pytest.raises(NotImplementedError):
+        LogisticRegression(elastic_net_param=0.5).fit(iris)

@@ -1,0 +1,97 @@
+import jax
+import numpy as np
+import pytest
+
+from orange3_spark_tpu import ContinuousVariable, DiscreteVariable, Domain, TpuTable
+
+
+def make_table(session, n=10, d=3, with_y=True, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    domain = Domain(
+        [ContinuousVariable(f"c{i}") for i in range(d)],
+        DiscreteVariable("y", ("a", "b")) if with_y else None,
+    )
+    Y = rng.integers(0, 2, size=n).astype(np.float32) if with_y else None
+    return TpuTable.from_numpy(domain, X, Y, session=session), X, Y
+
+
+def test_roundtrip_and_padding(session):
+    t, X, Y = make_table(session, n=10, d=3)
+    assert t.n_rows == 10
+    assert t.n_pad % session.data_parallelism == 0
+    assert t.n_pad >= 10
+    Xr, Yr, Wr = t.to_numpy()
+    np.testing.assert_allclose(Xr, X, rtol=1e-6)
+    np.testing.assert_allclose(Yr[:, 0], Y, rtol=1e-6)
+    assert np.all(Wr == 1.0)
+
+
+def test_sharding_is_row_partitioned(session):
+    t, _, _ = make_table(session, n=16, d=4)
+    shardings = t.X.sharding.spec
+    assert shardings[0] == session.data_axis
+
+
+def test_padding_rows_have_zero_weight(session):
+    t, _, _ = make_table(session, n=10)
+    W = np.asarray(jax.device_get(t.W))
+    assert np.all(W[10:] == 0.0)
+    assert t.count() == 10
+
+
+def test_filter_and_count(session):
+    t, X, _ = make_table(session, n=20)
+    filtered = t.filter(lambda tb: tb.X[:, 0] > 0)
+    expected = int(np.sum(X[:, 0] > 0))
+    assert filtered.count() == expected
+    # original untouched
+    assert t.count() == 20
+
+
+def test_compacted(session):
+    t, X, _ = make_table(session, n=20)
+    c = t.filter(lambda tb: tb.X[:, 0] > 0).compacted()
+    assert c.n_rows == int(np.sum(X[:, 0] > 0))
+    Xc, _, _ = c.to_numpy()
+    np.testing.assert_allclose(np.sort(Xc[:, 0]), np.sort(X[X[:, 0] > 0, 0]), rtol=1e-6)
+
+
+def test_select_columns(session):
+    t, X, _ = make_table(session, n=12, d=4)
+    s = t.select(["c2", "c0"])
+    assert s.n_attrs == 2
+    Xs, _, _ = s.to_numpy()
+    np.testing.assert_allclose(Xs[:, 0], X[:, 2], rtol=1e-6)
+    np.testing.assert_allclose(Xs[:, 1], X[:, 0], rtol=1e-6)
+    # class var preserved
+    assert s.domain.class_var.name == "y"
+
+
+def test_describe_matches_numpy(session):
+    t, X, _ = make_table(session, n=50, d=3)
+    st = t.describe()
+    np.testing.assert_allclose(st["mean"], X.mean(0), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(st["std"], X.std(0), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(st["min"], X.min(0), rtol=1e-6)
+    np.testing.assert_allclose(st["max"], X.max(0), rtol=1e-6)
+
+
+def test_describe_respects_filter(session):
+    t, X, _ = make_table(session, n=40, d=2)
+    mask = X[:, 0] > 0
+    st = t.filter(lambda tb: tb.X[:, 0] > 0).describe()
+    np.testing.assert_allclose(st["mean"], X[mask].mean(0), rtol=1e-5, atol=1e-6)
+
+
+def test_column_access(session):
+    t, X, Y = make_table(session, n=10, d=3)
+    np.testing.assert_allclose(np.asarray(t.column("c1"))[:10], X[:, 1], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(t.column("y"))[:10], Y, rtol=1e-6)
+
+
+def test_domain_validation(session):
+    with pytest.raises(ValueError):
+        Domain([ContinuousVariable("a")], None, ()).__class__(
+            [__import__("orange3_spark_tpu").StringVariable("s")]
+        )
