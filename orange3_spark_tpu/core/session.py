@@ -123,9 +123,11 @@ class TpuSession:
     @contextlib.contextmanager
     def use(self):
         """Install as the active session for the duration of a block."""
-        prev = TpuSession._active
-        TpuSession._active = self
+        with TpuSession._lock:
+            prev = TpuSession._active
+            TpuSession._active = self
         try:
             yield self
         finally:
-            TpuSession._active = prev
+            with TpuSession._lock:
+                TpuSession._active = prev

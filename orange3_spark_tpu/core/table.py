@@ -224,8 +224,22 @@ class TpuTable:
 
     # ------------------------------------------------------------- actions
     def head(self, k: int = 5) -> np.ndarray:
+        """First k LIVE rows (respects filters, like DataFrame.head).
+
+        Scans device chunks host-ward until k live rows are found, so a
+        billion-row table never transfers more than the prefix it needs.
+        """
         k = min(k, self.n_rows)
-        return np.asarray(jax.device_get(self.X[:k]))
+        out: list[np.ndarray] = []
+        chunk = max(1024, 4 * k)
+        start = 0
+        while start < self.n_rows and sum(len(c) for c in out) < k:
+            stop = min(start + chunk, self.n_rows)
+            Xc = np.asarray(jax.device_get(self.X[start:stop]))
+            Wc = np.asarray(jax.device_get(self.W[start:stop]))
+            out.append(Xc[Wc > 0])
+            start = stop
+        return np.concatenate(out, axis=0)[:k] if out else np.empty((0, self.n_attrs))
 
     def describe(self) -> dict[str, np.ndarray]:
         """Weighted per-column mean/std/min/max (DataFrame.describe action)."""

@@ -44,11 +44,14 @@ def _make_objective(loss_kind: str, fit_intercept: bool, compute_dtype):
       * 'squared'       — least squares (LinearRegression)
     """
 
-    def objective(theta, X, y, w, reg_l2, sum_w):
+    def objective(theta, X, y, w, reg_l2, sum_w, col_scale):
         coef = theta["coef"]
         intercept = theta["intercept"]
         Xc = X.astype(compute_dtype)
-        logits = jnp.dot(Xc, coef.astype(compute_dtype),
+        # fold per-column standardization into the coefficient side: X@(s*B)
+        # keeps the [N,d] operand untouched (no scaled copy of the data ever
+        # materializes — XLA fuses the [d,k] scale into the matmul epilogue)
+        logits = jnp.dot(Xc, (coef * col_scale[:, None]).astype(compute_dtype),
                          preferred_element_type=jnp.float32)
         if fit_intercept:
             logits = logits + intercept
@@ -82,6 +85,7 @@ def fit_linear(
     reg_l2,        # f32[] L2 regParam
     tol,           # f32[] gradient-norm tolerance
     max_iter,      # i32[]
+    col_scale=None,  # f32[d] standardization scale folded into the matmul
     *,
     loss_kind: str,
     k: int,
@@ -89,17 +93,24 @@ def fit_linear(
     memory_size: int = 10,
     compute_dtype=jnp.float32,
 ):
-    """One fused XLA program: full L-BFGS fit of a linear model."""
+    """One fused XLA program: full L-BFGS fit of a linear model.
+
+    Note: with ``col_scale`` the optimization runs in the scaled space; the
+    returned coef is the SCALED-space coefficient — callers multiply by the
+    scale to return to original feature space (MLlib does the same rescale).
+    """
     d = X.shape[1]
+    if col_scale is None:
+        col_scale = jnp.ones((d,), jnp.float32)
     theta0 = {
         "coef": jnp.zeros((d, k), jnp.float32),
         "intercept": jnp.zeros((k,), jnp.float32),
     }
-    sum_w = jnp.maximum(jnp.sum(w), 1e-12)
+    sum_w = jnp.maximum(jnp.sum(w), EPS_TOTAL_WEIGHT)
     objective = _make_objective(loss_kind, fit_intercept, compute_dtype)
 
     def value_fn(theta):
-        return objective(theta, X, y, w, reg_l2, sum_w)
+        return objective(theta, X, y, w, reg_l2, sum_w, col_scale)
 
     opt = optax.lbfgs(memory_size=memory_size)
     value_and_grad = optax.value_and_grad_from_state(value_fn)
@@ -127,9 +138,12 @@ def fit_linear(
         coef=theta["coef"],
         intercept=theta["intercept"] if fit_intercept else jnp.zeros((k,)),
         n_iter=otu.tree_get(state, "count"),
-        final_loss=value_fn(theta),
+        final_loss=otu.tree_get(state, "value"),  # converged loss, free from state
     )
 
 
-# MLlib-style scale-only standardization factor; shared stats kernel.
-from orange3_spark_tpu.ops.stats import inv_std_scale as column_inv_std  # noqa: E402
+# MLlib-style scale-only standardization factor; shared stats kernels.
+from orange3_spark_tpu.ops.stats import (  # noqa: E402
+    EPS_TOTAL_WEIGHT,
+    inv_std_scale as column_inv_std,
+)

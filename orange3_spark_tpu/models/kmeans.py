@@ -1,0 +1,187 @@
+"""KMeans — parity with ``pyspark.ml.clustering.KMeans``.
+
+MLlib runs Lloyd's algorithm with k-means|| initialization, one
+treeAggregate per iteration to sum per-cluster centroids (SURVEY.md §2b row
+"KMeans"; reconstructed, mount empty). TPU-native redesign:
+
+* assignment = argmin of pairwise squared distances computed with the matmul
+  identity  |x-c|² = |x|² - 2x·c + |c|²  — the 2x·c term is an [N,d]@[d,k]
+  MXU matmul, not a broadcast subtract (HBM-bandwidth friendly);
+* center update = one-hot(assign)ᵀ @ X — another MXU matmul whose row-axis
+  contraction GSPMD all-reduces over ICI (the treeAggregate moment);
+* the whole Lloyd loop is a single jitted ``lax.while_loop`` with the MLlib
+  convergence test (all center moves < tol).
+
+Init: 'random' samples k distinct live rows; 'k-means||' is served by
+kmeans++ on a host-side sample (≤ init_sample_size rows) — same quality goal
+(spread seeds) without a multi-round distributed sampling pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orange3_spark_tpu.core.domain import DiscreteVariable, Domain
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models.base import Estimator, Model, Params
+
+
+@dataclasses.dataclass(frozen=True)
+class KMeansParams(Params):
+    k: int = 2                    # MLlib k
+    max_iter: int = 20            # MLlib maxIter
+    tol: float = 1e-4             # MLlib tol (center movement)
+    init_mode: str = "k-means||"  # MLlib initMode: 'random' | 'k-means||'
+    seed: int = 0                 # MLlib seed
+    n_init: int = 1               # restarts, best-cost wins (vmapped — beyond
+                                  # MLlib, which is single-init; ~free on TPU)
+    init_sample_size: int = 8192  # host sample for the ++-style init
+    compute_dtype: str = "float32"
+
+
+@partial(jax.jit, static_argnames=("compute_dtype",))
+def _assign(X, centers, w, compute_dtype=jnp.float32):
+    """Nearest-center ids + weighted cost. Distances via the matmul identity."""
+    Xc = X.astype(compute_dtype)
+    Cc = centers.astype(compute_dtype)
+    cross = jnp.dot(Xc, Cc.T, preferred_element_type=jnp.float32)  # [N,k] on MXU
+    x2 = jnp.sum(X * X, axis=1, keepdims=True)
+    c2 = jnp.sum(centers * centers, axis=1)
+    d2 = x2 - 2.0 * cross + c2
+    assign = jnp.argmin(d2, axis=1)
+    cost = jnp.sum(jnp.min(d2, axis=1) * w)
+    return assign, cost
+
+
+@partial(jax.jit, static_argnames=("k", "max_iter", "compute_dtype"))
+def _lloyd(X, w, centers0, tol, *, k: int, max_iter: int, compute_dtype=jnp.float32):
+    def body(carry):
+        centers, _, it, _ = carry
+        assign, cost = _assign(X, centers, w, compute_dtype)
+        onehot = jax.nn.one_hot(assign, k, dtype=jnp.float32) * w[:, None]  # [N,k]
+        sums = onehot.T @ X          # [k,d] MXU matmul, all-reduced by GSPMD
+        counts = jnp.sum(onehot, axis=0)
+        new_centers = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts, 1e-12)[:, None], centers
+        )
+        move = jnp.sqrt(jnp.sum((new_centers - centers) ** 2, axis=1))
+        converged = jnp.all(move < tol)
+        return new_centers, cost, it + 1, converged
+
+    def keep_going(carry):
+        _, _, it, converged = carry
+        return (it < max_iter) & ~converged
+
+    centers, cost, n_iter, _ = jax.lax.while_loop(
+        keep_going, body, (centers0, jnp.float32(jnp.inf), 0, False)
+    )
+    # final stats at the converged centers
+    assign, cost = _assign(X, centers, w, compute_dtype)
+    return centers, assign, cost, n_iter
+
+
+class KMeansModel(Model):
+    def __init__(self, params, centers):
+        self.params = params
+        self.centers = centers  # f32[k, d]
+        self.n_iter_: int | None = None
+        self.training_cost_: float | None = None  # MLlib summary.trainingCost
+
+    @property
+    def state_pytree(self):
+        return {"centers": self.centers}
+
+    @property
+    def cluster_centers_(self) -> np.ndarray:
+        return np.asarray(self.centers)
+
+    def predict(self, table: TpuTable) -> np.ndarray:
+        assign, _ = _assign(table.X, self.centers, table.W)
+        return np.asarray(assign)[: table.n_rows]
+
+    def compute_cost(self, table: TpuTable) -> float:
+        _, cost = _assign(table.X, self.centers, table.W)
+        return float(cost)
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        """Append the 'cluster' prediction column (Spark's predictionCol)."""
+        assign, _ = _assign(table.X, self.centers, table.W)
+        k = self.centers.shape[0]
+        new_attrs = list(table.domain.attributes) + [
+            DiscreteVariable("cluster", tuple(str(i) for i in range(k)))
+        ]
+        new_domain = Domain(new_attrs, table.domain.class_vars, table.domain.metas)
+        X = jnp.concatenate([table.X, assign[:, None].astype(jnp.float32)], axis=1)
+        return table.with_X(X, new_domain)
+
+
+class KMeans(Estimator):
+    ParamsCls = KMeansParams
+    params: KMeansParams
+
+    def _init_centers(self, table: TpuTable) -> jnp.ndarray:
+        p = self.params
+        rng = np.random.default_rng(p.seed)
+        # sample only live rows — filtered (w=0) rows must not seed centers,
+        # or a center stranded on a dead outlier never receives points and
+        # Lloyd's keeps it forever
+        live = np.flatnonzero(np.asarray(jax.device_get(table.W)) > 0)
+        n = len(live)
+        if n == 0:
+            raise ValueError("cannot fit KMeans: table has no live rows")
+        if p.init_mode == "random":
+            idx = live[rng.choice(n, size=min(p.k, n), replace=False)]
+            centers = np.asarray(jax.device_get(table.X[np.sort(idx)]))
+        elif p.init_mode == "k-means||":
+            # kmeans++ on a host sample: same seed-spreading intent as
+            # MLlib's distributed k-means|| oversampling rounds.
+            m = min(n, p.init_sample_size)
+            idx = live[rng.choice(n, size=m, replace=False)] if m < n else live
+            sample = np.asarray(jax.device_get(table.X))[idx]
+            centers = [sample[rng.integers(m)]]
+            d2 = np.sum((sample - centers[0]) ** 2, axis=1)
+            for _ in range(1, min(p.k, m)):
+                probs = d2 / max(d2.sum(), 1e-12)
+                centers.append(sample[rng.choice(m, p=probs)])
+                d2 = np.minimum(d2, np.sum((sample - centers[-1]) ** 2, axis=1))
+            centers = np.stack(centers)
+        else:
+            raise ValueError(f"unknown init_mode {p.init_mode!r}")
+        if centers.shape[0] < p.k:  # fewer rows than k: pad with jitter
+            extra = centers[rng.integers(centers.shape[0], size=p.k - centers.shape[0])]
+            centers = np.concatenate([centers, extra + 1e-3], axis=0)
+        return jax.device_put(centers.astype(np.float32), table.session.replicated)
+
+    def _fit(self, table: TpuTable) -> KMeansModel:
+        p = self.params
+        lloyd = partial(
+            _lloyd, k=p.k, max_iter=p.max_iter,
+            compute_dtype=jnp.dtype(p.compute_dtype),
+        )
+        tol = jnp.float32(p.tol)
+        if p.n_init <= 1:
+            centers, _, cost, n_iter = lloyd(table.X, table.W, self._init_centers(table), tol)
+        else:
+            # all restarts advance in lockstep inside one vmapped while_loop —
+            # n_init independent Lloyd runs for roughly the cost of one
+            inits = jnp.stack([
+                self.replace_seed(s)._init_centers(table)
+                for s in range(p.seed, p.seed + p.n_init)
+            ])
+            centers_v, _, cost_v, iter_v = jax.vmap(
+                lambda c0: lloyd(table.X, table.W, c0, tol)
+            )(inits)
+            best = jnp.argmin(cost_v)
+            centers, cost, n_iter = centers_v[best], cost_v[best], iter_v[best]
+        model = KMeansModel(p, centers)
+        model.n_iter_ = int(n_iter)
+        model.training_cost_ = float(cost)
+        return model
+
+    def replace_seed(self, seed: int) -> "KMeans":
+        return KMeans(self.params.replace(seed=seed))

@@ -1,0 +1,113 @@
+"""LinearRegression — parity with ``pyspark.ml.regression.LinearRegression``.
+
+MLlib solves either by WLS normal equations (small d) or L-BFGS; we provide
+both: ``solver='normal'`` builds the Gramian with one ICI all-reduce and
+solves host-free via Cholesky, ``solver='l-bfgs'`` reuses the fused trainer.
+(SURVEY.md §2b; reconstructed — reference mount empty.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models._linear import fit_linear
+from orange3_spark_tpu.models.base import Estimator, Model, Params
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearRegressionParams(Params):
+    max_iter: int = 100
+    reg_param: float = 0.0
+    tol: float = 1e-6
+    fit_intercept: bool = True
+    solver: str = "normal"  # 'normal' | 'l-bfgs'  (MLlib solver param)
+    compute_dtype: str = "float32"
+
+
+@jax.jit
+def _normal_equations(X, y, w):
+    """Weighted ridge normal equations with one all-reduce over the row axis.
+
+    Returns (XtX[d,d], Xty[d], x_sum[d], y_sum[], tot[]) so the intercept can
+    be folded in without materializing a bias column.
+    """
+    wc = w[:, None]
+    XtX = (X * wc).T @ X
+    Xty = (X * wc).T @ (y * 1.0)
+    from orange3_spark_tpu.ops.stats import EPS_TOTAL_WEIGHT
+
+    x_sum = jnp.sum(X * wc, axis=0)
+    y_sum = jnp.sum(y * w)
+    tot = jnp.maximum(jnp.sum(w), EPS_TOTAL_WEIGHT)
+    return XtX, Xty, x_sum, y_sum, tot
+
+
+class LinearRegressionModel(Model):
+    def __init__(self, params, coef, intercept):
+        self.params = params
+        self.coef = coef            # f32[d]
+        self.intercept = intercept  # f32[]
+        self.n_iter_: int | None = None
+
+    @property
+    def state_pytree(self):
+        return {"coef": self.coef, "intercept": self.intercept}
+
+    @staticmethod
+    @jax.jit
+    def _predict_kernel(X, coef, intercept):
+        return X @ coef + intercept
+
+    def predict(self, table: TpuTable) -> np.ndarray:
+        yhat = self._predict_kernel(table.X, self.coef, self.intercept)
+        return np.asarray(yhat)[: table.n_rows]
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        yhat = self._predict_kernel(table.X, self.coef, self.intercept)
+        new_attrs = list(table.domain.attributes) + [ContinuousVariable("prediction")]
+        new_domain = Domain(new_attrs, table.domain.class_vars, table.domain.metas)
+        X = jnp.concatenate([table.X, yhat[:, None]], axis=1)
+        return table.with_X(X, new_domain)
+
+
+class LinearRegression(Estimator):
+    ParamsCls = LinearRegressionParams
+    params: LinearRegressionParams
+
+    def _fit(self, table: TpuTable) -> LinearRegressionModel:
+        p = self.params
+        y, X, w = table.y, table.X, table.W
+        if p.solver == "normal":
+            XtX, Xty, x_sum, y_sum, tot = _normal_equations(X, y, w)
+            d = X.shape[1]
+            if p.fit_intercept:
+                # center via the accumulated sums: solve on centered moments
+                mean_x = x_sum / tot
+                mean_y = y_sum / tot
+                A = XtX - tot * jnp.outer(mean_x, mean_x)
+                b = Xty - tot * mean_x * mean_y
+            else:
+                A, b = XtX, Xty
+            # MLlib regParam scales the normalized objective; normal equations
+            # are on the un-normalized sums, so multiply by total weight.
+            A = A + p.reg_param * tot * jnp.eye(d, dtype=A.dtype)
+            coef = jax.scipy.linalg.solve(A, b, assume_a="pos")
+            intercept = (mean_y - coef @ mean_x) if p.fit_intercept else jnp.float32(0.0)
+            model = LinearRegressionModel(p, coef, intercept)
+            model.n_iter_ = 1
+            return model
+        result = fit_linear(
+            X, y, w,
+            jnp.float32(p.reg_param), jnp.float32(p.tol), jnp.int32(p.max_iter),
+            loss_kind="squared", k=1, fit_intercept=p.fit_intercept,
+            compute_dtype=jnp.dtype(p.compute_dtype),
+        )
+        model = LinearRegressionModel(p, result.coef[:, 0], result.intercept[0])
+        model.n_iter_ = int(result.n_iter)
+        return model

@@ -1,0 +1,110 @@
+"""LinearSVC — parity with ``pyspark.ml.classification.LinearSVC``.
+
+Binary hinge-loss classifier (SURVEY.md §2b row "LogisticRegression /
+LinearSVC"; reconstructed, mount empty). Same fused L-BFGS program as
+LogisticRegression with the hinge objective; MLlib drives this with OWLQN over
+treeAggregate, we let GSPMD all-reduce the hinge subgradients over ICI.
+``loss='squared_hinge'`` is offered because L-BFGS likes smooth objectives —
+default stays 'hinge' for MLlib parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models._linear import column_inv_std, fit_linear
+from orange3_spark_tpu.models.base import Estimator, Model, Params
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearSVCParams(Params):
+    max_iter: int = 100          # MLlib maxIter
+    reg_param: float = 0.0       # MLlib regParam
+    tol: float = 1e-6            # MLlib tol
+    fit_intercept: bool = True   # MLlib fitIntercept
+    standardization: bool = True # MLlib standardization
+    threshold: float = 0.0       # MLlib threshold (on the raw margin)
+    loss: str = "hinge"          # 'hinge' (MLlib) | 'squared_hinge'
+    compute_dtype: str = "float32"
+
+
+class LinearSVCModel(Model):
+    def __init__(self, params, coef, intercept, class_values):
+        self.params = params
+        self.coef = coef            # f32[d, 1]
+        self.intercept = intercept  # f32[1]
+        self.class_values = tuple(class_values)
+        self.n_iter_: int | None = None
+
+    @property
+    def state_pytree(self):
+        return {"coef": self.coef, "intercept": self.intercept}
+
+    @staticmethod
+    @jax.jit
+    def _margin_kernel(X, coef, intercept):
+        return (X @ coef + intercept)[:, 0]
+
+    def decision_function(self, table: TpuTable) -> np.ndarray:
+        m = self._margin_kernel(table.X, self.coef, self.intercept)
+        return np.asarray(m)[: table.n_rows]
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        """Append rawPrediction (margin) and prediction columns."""
+        margin = self._margin_kernel(table.X, self.coef, self.intercept)
+        pred = (margin > self.params.threshold).astype(jnp.float32)
+        new_attrs = list(table.domain.attributes) + [
+            ContinuousVariable("rawPrediction"),
+            DiscreteVariable("prediction", self.class_values),
+        ]
+        new_domain = Domain(new_attrs, table.domain.class_vars, table.domain.metas)
+        X = jnp.concatenate([table.X, margin[:, None], pred[:, None]], axis=1)
+        return table.with_X(X, new_domain)
+
+    def predict(self, table: TpuTable) -> np.ndarray:
+        margin = self._margin_kernel(table.X, self.coef, self.intercept)
+        pred = (margin > self.params.threshold).astype(jnp.float32)
+        return np.asarray(pred)[: table.n_rows]
+
+
+class LinearSVC(Estimator):
+    ParamsCls = LinearSVCParams
+    params: LinearSVCParams
+
+    def _fit(self, table: TpuTable) -> LinearSVCModel:
+        p = self.params
+        y = table.y
+        cvar = table.domain.class_var
+        class_values = (
+            cvar.values if isinstance(cvar, DiscreteVariable) and cvar.values
+            else ("0", "1")
+        )
+        if len(class_values) != 2:
+            raise ValueError(
+                f"LinearSVC is binary (MLlib parity); got {len(class_values)} classes"
+            )
+        X, w = table.X, table.W
+        inv_std = column_inv_std(X, w) if p.standardization else None
+        result = fit_linear(
+            X, y, w,
+            jnp.float32(p.reg_param),
+            jnp.float32(p.tol),
+            jnp.int32(p.max_iter),
+            inv_std,
+            loss_kind=p.loss,
+            k=1,
+            fit_intercept=p.fit_intercept,
+            compute_dtype=jnp.dtype(p.compute_dtype),
+        )
+        coef = result.coef
+        if inv_std is not None:
+            coef = coef * inv_std[:, None]
+        model = LinearSVCModel(p, coef, result.intercept, class_values)
+        model.n_iter_ = int(result.n_iter)
+        return model

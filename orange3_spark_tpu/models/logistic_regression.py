@@ -113,16 +113,15 @@ class LogisticRegression(Estimator):
             raise ValueError(f"binomial family needs 2 classes, got {k}")
 
         X, w = table.X, table.W
-        inv_std = None
-        if p.standardization:
-            inv_std = column_inv_std(X, w)
-            X = X * inv_std  # scale-only, MLlib-style
-
+        # scale-only standardization folded INTO the fit matmul (no scaled
+        # copy of the [N,d] data is ever materialized), MLlib-style
+        inv_std = column_inv_std(X, w) if p.standardization else None
         result = fit_linear(
             X, y, w,
             jnp.float32(p.reg_param),
             jnp.float32(p.tol),
             jnp.int32(p.max_iter),
+            inv_std,
             loss_kind="logistic",
             k=k,
             fit_intercept=p.fit_intercept,

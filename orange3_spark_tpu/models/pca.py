@@ -1,0 +1,87 @@
+"""PCA — parity with ``pyspark.ml.feature.PCA``.
+
+MLlib computes a distributed Gramian (RowMatrix.computeCovariance via
+treeAggregate) then a local SVD (SURVEY.md §2b row "PCA"; reconstructed,
+mount empty). Identical shape here: one ICI-all-reduced [d,d] Gramian matmul,
+then ``jnp.linalg.eigh`` on the replicated covariance — d is small, N is the
+distributed dimension.
+
+Transform follows Orange's PCA widget semantics: the output table's
+attributes ARE the principal components (PC1..PCk); original columns are
+replaced (Spark instead appends a vector column — same information, flat
+columnar form).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models.base import Estimator, Model, Params
+from orange3_spark_tpu.parallel.collectives import distributed_gramian
+
+
+@dataclasses.dataclass(frozen=True)
+class PCAParams(Params):
+    k: int = 2          # MLlib k: number of principal components
+    center: bool = True # Orange centers; MLlib PCA does too (covariance)
+
+
+class PCAModel(Model):
+    def __init__(self, params, components, mean, explained_variance):
+        self.params = params
+        self.components = components                  # f32[d, k] (columns = PCs)
+        self.mean = mean                              # f32[d]
+        self.explained_variance = explained_variance  # f32[k]
+
+    @property
+    def state_pytree(self):
+        return {
+            "components": self.components,
+            "mean": self.mean,
+            "explained_variance": self.explained_variance,
+        }
+
+    @property
+    def explained_variance_ratio_(self) -> np.ndarray:
+        ev = np.asarray(self.explained_variance)
+        return ev / max(ev.sum(), 1e-12) if ev.sum() > 0 else ev
+
+    @staticmethod
+    @jax.jit
+    def _project(X, components, mean):
+        return (X - mean) @ components  # [N,d]@[d,k] on the MXU
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        Z = self._project(table.X, self.components, self.mean)
+        k = self.components.shape[1]
+        new_domain = Domain(
+            [ContinuousVariable(f"PC{i + 1}") for i in range(k)],
+            table.domain.class_vars,
+            table.domain.metas,
+        )
+        return table.with_X(Z, new_domain)
+
+
+class PCA(Estimator):
+    ParamsCls = PCAParams
+    params: PCAParams
+
+    def _fit(self, table: TpuTable) -> PCAModel:
+        p = self.params
+        if p.k > table.n_attrs:
+            raise ValueError(f"k={p.k} exceeds n_features={table.n_attrs}")
+        G, mean, tot = distributed_gramian(table.X, table.W, center=p.center)
+        cov = G / tot
+        eigvals, eigvecs = jnp.linalg.eigh(cov)   # ascending
+        order = jnp.argsort(eigvals)[::-1][: p.k]
+        components = eigvecs[:, order]
+        explained = jnp.maximum(eigvals[order], 0.0)
+        if not p.center:
+            mean = jnp.zeros_like(mean)
+        return PCAModel(p, components, mean, explained)

@@ -68,7 +68,7 @@ def _gramian_kernel(X, W, center: bool):
 
     w = W[:, None]
     mean, _, tot = weighted_moments(X, W)
-    Xc = jnp.where(center, X - mean, X)
+    Xc = X - mean if center else X  # center is trace-time static
     # (d,d) matmul contraction over the sharded row axis — GSPMD turns this
     # into local matmuls + one all-reduce over ICI (the treeAggregate moment).
     G = (Xc * w).T @ Xc

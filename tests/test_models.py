@@ -1,0 +1,167 @@
+"""LinearSVC / LinearRegression / KMeans / PCA vs sklearn numerics (SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.datasets import load_iris, make_blobs, make_classification
+from orange3_spark_tpu.models.kmeans import KMeans
+from orange3_spark_tpu.models.linear_regression import LinearRegression
+from orange3_spark_tpu.models.linear_svc import LinearSVC
+from orange3_spark_tpu.models.pca import PCA
+
+
+# ------------------------------------------------------------------ LinearSVC
+def test_linear_svc_binary(session):
+    t = make_classification(500, 8, n_classes=2, seed=5, noise=0.1, session=session)
+    model = LinearSVC(max_iter=100, reg_param=0.01, loss="squared_hinge").fit(t)
+    pred = model.predict(t)
+    y = t.to_numpy()[1][:, 0]
+    assert np.mean(pred == y) > 0.95
+
+
+def test_linear_svc_rejects_multiclass(session, iris):
+    with pytest.raises(ValueError, match="binary"):
+        LinearSVC().fit(iris)
+
+
+def test_linear_svc_transform_appends(session):
+    t = make_classification(200, 4, n_classes=2, seed=6, session=session)
+    out = LinearSVC(max_iter=50).fit(t).transform(t)
+    names = [v.name for v in out.domain.attributes]
+    assert "rawPrediction" in names and "prediction" in names
+
+
+# ---------------------------------------------------------- LinearRegression
+def _regression_data(session, n=400, d=6, seed=7, noise=0.01):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    true_w = rng.standard_normal(d).astype(np.float32)
+    y = X @ true_w + 2.5 + noise * rng.standard_normal(n).astype(np.float32)
+    t = TpuTable.from_arrays(X, y, session=session)
+    return t, X, y, true_w
+
+
+def test_linreg_normal_matches_sklearn(session):
+    t, X, y, _ = _regression_data(session)
+    model = LinearRegression(solver="normal").fit(t)
+
+    from sklearn.linear_model import LinearRegression as SkLin
+
+    sk = SkLin().fit(X, y)
+    np.testing.assert_allclose(np.asarray(model.coef), sk.coef_, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(float(model.intercept), sk.intercept_, rtol=1e-3)
+
+
+def test_linreg_lbfgs_close_to_normal(session):
+    t, X, y, _ = _regression_data(session)
+    m1 = LinearRegression(solver="normal").fit(t)
+    m2 = LinearRegression(solver="l-bfgs", max_iter=200, tol=1e-8).fit(t)
+    np.testing.assert_allclose(
+        np.asarray(m1.coef), np.asarray(m2.coef), rtol=1e-2, atol=1e-3
+    )
+
+
+def test_linreg_ridge_matches_sklearn(session):
+    t, X, y, _ = _regression_data(session)
+    lam = 0.5
+    model = LinearRegression(solver="normal", reg_param=lam).fit(t)
+
+    from sklearn.linear_model import Ridge
+
+    # sklearn Ridge penalizes alpha*||w||^2 on the SUM of squares; ours is on
+    # the mean (MLlib convention), so alpha = lam * n matches.
+    sk = Ridge(alpha=lam * len(X)).fit(X, y)
+    np.testing.assert_allclose(np.asarray(model.coef), sk.coef_, rtol=1e-3, atol=1e-4)
+
+
+# ------------------------------------------------------------------- KMeans
+def test_kmeans_recovers_blobs(session):
+    t, true_assign = make_blobs(1000, 5, n_centers=4, seed=8, spread=0.3, session=session)
+    model = KMeans(k=4, max_iter=50, seed=0).fit(t)
+    pred = model.predict(t)
+    # adjusted rand index vs ground truth should be near 1 for tight blobs
+    from sklearn.metrics import adjusted_rand_score
+
+    assert adjusted_rand_score(true_assign, pred) > 0.95
+    assert model.training_cost_ is not None and model.training_cost_ > 0
+
+
+def test_kmeans_matches_sklearn_cost(session):
+    t, _ = make_blobs(600, 4, n_centers=3, seed=9, spread=0.5, session=session)
+    model = KMeans(k=3, max_iter=100, seed=1).fit(t)
+
+    from sklearn.cluster import KMeans as SkKMeans
+
+    X = t.to_numpy()[0]
+    sk = SkKMeans(n_clusters=3, n_init=5, random_state=0).fit(X)
+    # our single-init cost within 5% of sklearn's best-of-5
+    assert model.compute_cost(t) <= sk.inertia_ * 1.05
+
+
+def test_kmeans_random_init_and_transform(session):
+    t, _ = make_blobs(300, 3, n_centers=2, seed=10, session=session)
+    model = KMeans(k=2, init_mode="random", max_iter=30).fit(t)
+    out = model.transform(t)
+    assert out.domain.attributes[-1].name == "cluster"
+    clusters = np.asarray(out.column("cluster"))[: t.n_rows]
+    assert set(np.unique(clusters)) <= {0.0, 1.0}
+
+
+def test_kmeans_respects_filter(session):
+    t, _ = make_blobs(400, 3, n_centers=2, seed=11, session=session)
+    X = t.to_numpy()[0]
+    # shift a far-away outlier cluster into rows we then filter out
+    X2 = X.copy()
+    X2[:50] += 100.0
+    t2 = TpuTable.from_numpy(t.domain, X2, session=session)
+    import jax.numpy as jnp
+
+    filtered = t2.filter(jnp.arange(t2.n_pad) >= 50)
+    model = KMeans(k=2, max_iter=50, seed=2).fit(filtered)
+    centers = model.cluster_centers_
+    assert np.all(np.abs(centers) < 50), "outlier rows leaked into centers"
+
+
+# ---------------------------------------------------------------------- PCA
+def test_pca_matches_sklearn(session, iris):
+    model = PCA(k=2).fit(iris)
+    Z = model.transform(iris).to_numpy()[0]
+
+    from sklearn.decomposition import PCA as SkPCA
+
+    X = iris.to_numpy()[0]
+    sk = SkPCA(n_components=2).fit(X)
+    Zsk = sk.transform(X)
+    # components are sign-ambiguous; compare |projections|
+    for j in range(2):
+        corr = np.corrcoef(Z[:, j], Zsk[:, j])[0, 1]
+        assert abs(corr) > 0.999
+    np.testing.assert_allclose(
+        np.asarray(model.explained_variance),
+        sk.explained_variance_ * (len(X) - 1) / len(X),  # population vs sample
+        rtol=1e-3,
+    )
+
+
+def test_pca_transform_domain(session, iris):
+    out = PCA(k=3).fit(iris).transform(iris)
+    assert [v.name for v in out.domain.attributes] == ["PC1", "PC2", "PC3"]
+    assert out.domain.class_var.name == "iris"  # class var preserved
+
+
+def test_pca_k_too_large(session, iris):
+    with pytest.raises(ValueError):
+        PCA(k=10).fit(iris)
+
+
+def test_kmeans_multi_init_beats_bad_seed(session, iris):
+    """seed=0 single-init hits a local minimum on iris; n_init=3 escapes it."""
+    single = KMeans(k=3, max_iter=100, seed=0).fit(iris)
+    multi = KMeans(k=3, max_iter=100, seed=0, n_init=3).fit(iris)
+    assert multi.training_cost_ <= single.training_cost_
+    from sklearn.cluster import KMeans as SkKMeans
+
+    X = iris.to_numpy()[0]
+    sk = SkKMeans(n_clusters=3, n_init=10, random_state=0).fit(X)
+    assert multi.training_cost_ <= sk.inertia_ * 1.01

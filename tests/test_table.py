@@ -95,3 +95,10 @@ def test_domain_validation(session):
         Domain([ContinuousVariable("a")], None, ()).__class__(
             [__import__("orange3_spark_tpu").StringVariable("s")]
         )
+
+
+def test_head_respects_filter(session):
+    t, X, _ = make_table(session, n=40, d=2)
+    h = t.filter(lambda tb: tb.X[:, 0] > 0).head(5)
+    expected = X[X[:, 0] > 0][:5]
+    np.testing.assert_allclose(h, expected, rtol=1e-6)
