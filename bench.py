@@ -1,0 +1,78 @@
+"""Benchmark harness — BASELINE config 2 proxy (Criteo-scale LogisticRegression).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: rows/sec/chip on a LogisticRegression fit — "rows" = training rows
+visited, i.e. n_rows × iterations_completed / wall_seconds / n_chips, the
+throughput MLlib's treeAggregate gradient loop is bounded by.
+
+vs_baseline: BASELINE.md records NO published reference numbers (empty mount,
+`published: {}`), so the denominator is a documented proxy: a 32-executor
+Spark/MLlib cluster sustaining ~8M dense rows/sec on LogReg ≈ 250k
+rows/sec per chip-equivalent of a v5e-8. The north-star (≥10× Spark) is
+vs_baseline ≥ 10.
+"""
+
+import json
+import time
+
+SPARK_PROXY_ROWS_PER_SEC_PER_CHIP = 250_000.0
+
+N_ROWS = 4_000_000
+N_FEATURES = 40  # Criteo-style dense feature width
+N_ITERS = 20
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
+    from orange3_spark_tpu.models.logistic_regression import LogisticRegression
+
+    session = TpuSession.builder_get_or_create()
+    n_chips = session.n_devices
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((N_ROWS, N_FEATURES), dtype=np.float32)
+    true_w = rng.standard_normal((N_FEATURES,)).astype(np.float32)
+    y = (X @ true_w + 0.5 * rng.standard_normal(N_ROWS).astype(np.float32) > 0).astype(
+        np.float32
+    )
+    domain = Domain(
+        [ContinuousVariable(f"f{i}") for i in range(N_FEATURES)],
+        DiscreteVariable("click", ("0", "1")),
+    )
+    table = TpuTable.from_numpy(domain, X, y, session=session)
+
+    # tol=0 forces exactly N_ITERS L-BFGS iterations -> deterministic row count
+    est = LogisticRegression(
+        max_iter=N_ITERS, tol=0.0, reg_param=1e-6, compute_dtype="bfloat16"
+    )
+    est.fit(table)  # warm-up: XLA compile + autotune
+    t0 = time.perf_counter()
+    model = est.fit(table)
+    jax.block_until_ready(model.state_pytree)
+    dt = time.perf_counter() - t0
+
+    iters = model.n_iter_ or N_ITERS
+    rows_per_sec_per_chip = N_ROWS * iters / dt / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "logreg_fit_rows_per_sec_per_chip",
+                "value": round(rows_per_sec_per_chip, 1),
+                "unit": "rows/s/chip",
+                "vs_baseline": round(
+                    rows_per_sec_per_chip / SPARK_PROXY_ROWS_PER_SEC_PER_CHIP, 3
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,7 @@ cluster manager.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import os
 import threading
 from typing import Sequence
@@ -37,6 +38,11 @@ class TpuSession:
 
     _lock = threading.Lock()
     _active: "TpuSession | None" = None
+    # per-context override installed by use(); isolates concurrent threads /
+    # async tasks from each other and from the global get-or-create singleton
+    _ctx_active: "contextvars.ContextVar[TpuSession | None]" = contextvars.ContextVar(
+        "tpu_session_ctx", default=None
+    )
 
     def __init__(
         self,
@@ -70,7 +76,8 @@ class TpuSession:
 
     @classmethod
     def active(cls) -> "TpuSession":
-        return cls.builder_get_or_create()
+        ctx = cls._ctx_active.get()
+        return ctx if ctx is not None else cls.builder_get_or_create()
 
     @classmethod
     def stop(cls) -> None:
@@ -122,12 +129,10 @@ class TpuSession:
 
     @contextlib.contextmanager
     def use(self):
-        """Install as the active session for the duration of a block."""
-        with TpuSession._lock:
-            prev = TpuSession._active
-            TpuSession._active = self
+        """Install as the active session within this context (thread/task-local,
+        so concurrent use() blocks can't clobber each other's view)."""
+        token = TpuSession._ctx_active.set(self)
         try:
             yield self
         finally:
-            with TpuSession._lock:
-                TpuSession._active = prev
+            TpuSession._ctx_active.reset(token)

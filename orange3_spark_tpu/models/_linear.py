@@ -134,11 +134,17 @@ def fit_linear(
         return (max_iter > 0) & ((count == 0) | ((count < max_iter) & (gnorm > tol)))
 
     theta, state = jax.lax.while_loop(keep_going, step, (theta0, opt.init(theta0)))
+    n_iter = otu.tree_get(state, "count")
+    # converged loss is already in the linesearch state; only the max_iter=0
+    # path (state still holds optax's inf sentinel) pays a fresh evaluation
+    final_loss = jax.lax.cond(
+        n_iter == 0, lambda: value_fn(theta), lambda: otu.tree_get(state, "value")
+    )
     return LinearFitResult(
         coef=theta["coef"],
         intercept=theta["intercept"] if fit_intercept else jnp.zeros((k,)),
-        n_iter=otu.tree_get(state, "count"),
-        final_loss=otu.tree_get(state, "value"),  # converged loss, free from state
+        n_iter=n_iter,
+        final_loss=final_loss,
     )
 
 

@@ -142,12 +142,17 @@ class KMeans(Estimator):
             # MLlib's distributed k-means|| oversampling rounds.
             m = min(n, p.init_sample_size)
             idx = live[rng.choice(n, size=m, replace=False)] if m < n else live
-            sample = np.asarray(jax.device_get(table.X))[idx]
+            # gather the sample ON DEVICE, then pull only those m rows host-ward
+            # (never device_get the full [N,d] table)
+            sample = np.asarray(jax.device_get(table.X[np.sort(idx)]))
             centers = [sample[rng.integers(m)]]
             d2 = np.sum((sample - centers[0]) ** 2, axis=1)
             for _ in range(1, min(p.k, m)):
-                probs = d2 / max(d2.sum(), 1e-12)
-                centers.append(sample[rng.choice(m, p=probs)])
+                s = d2.sum()
+                if s > 0:
+                    centers.append(sample[rng.choice(m, p=d2 / s)])
+                else:  # all remaining points identical to a seed: pick uniformly
+                    centers.append(sample[rng.integers(m)])
                 d2 = np.minimum(d2, np.sum((sample - centers[-1]) ** 2, axis=1))
             centers = np.stack(centers)
         else:

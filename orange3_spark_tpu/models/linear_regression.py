@@ -18,6 +18,7 @@ from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
 from orange3_spark_tpu.core.table import TpuTable
 from orange3_spark_tpu.models._linear import fit_linear
 from orange3_spark_tpu.models.base import Estimator, Model, Params
+from orange3_spark_tpu.ops.stats import EPS_TOTAL_WEIGHT
 
 
 @dataclasses.dataclass(frozen=True)
@@ -40,8 +41,6 @@ def _normal_equations(X, y, w):
     wc = w[:, None]
     XtX = (X * wc).T @ X
     Xty = (X * wc).T @ (y * 1.0)
-    from orange3_spark_tpu.ops.stats import EPS_TOTAL_WEIGHT
-
     x_sum = jnp.sum(X * wc, axis=0)
     y_sum = jnp.sum(y * w)
     tot = jnp.maximum(jnp.sum(w), EPS_TOTAL_WEIGHT)

@@ -33,11 +33,12 @@ class PCAParams(Params):
 
 
 class PCAModel(Model):
-    def __init__(self, params, components, mean, explained_variance):
+    def __init__(self, params, components, mean, explained_variance, total_variance):
         self.params = params
         self.components = components                  # f32[d, k] (columns = PCs)
         self.mean = mean                              # f32[d]
         self.explained_variance = explained_variance  # f32[k]
+        self.total_variance = total_variance          # f32[] trace of covariance
 
     @property
     def state_pytree(self):
@@ -45,12 +46,14 @@ class PCAModel(Model):
             "components": self.components,
             "mean": self.mean,
             "explained_variance": self.explained_variance,
+            "total_variance": self.total_variance,
         }
 
     @property
     def explained_variance_ratio_(self) -> np.ndarray:
         ev = np.asarray(self.explained_variance)
-        return ev / max(ev.sum(), 1e-12) if ev.sum() > 0 else ev
+        tot = float(self.total_variance)
+        return ev / tot if tot > 0 else ev
 
     @staticmethod
     @jax.jit
@@ -82,6 +85,7 @@ class PCA(Estimator):
         order = jnp.argsort(eigvals)[::-1][: p.k]
         components = eigvecs[:, order]
         explained = jnp.maximum(eigvals[order], 0.0)
+        total = jnp.maximum(jnp.trace(cov), 0.0)
         if not p.center:
             mean = jnp.zeros_like(mean)
-        return PCAModel(p, components, mean, explained)
+        return PCAModel(p, components, mean, explained, total)

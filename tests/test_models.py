@@ -165,3 +165,28 @@ def test_kmeans_multi_init_beats_bad_seed(session, iris):
     X = iris.to_numpy()[0]
     sk = SkKMeans(n_clusters=3, n_init=10, random_state=0).fit(X)
     assert multi.training_cost_ <= sk.inertia_ * 1.01
+
+
+def test_pca_explained_variance_ratio(session, iris):
+    model = PCA(k=2).fit(iris)
+    from sklearn.decomposition import PCA as SkPCA
+
+    sk = SkPCA(n_components=2).fit(iris.to_numpy()[0])
+    np.testing.assert_allclose(
+        model.explained_variance_ratio_, sk.explained_variance_ratio_, rtol=1e-3
+    )
+
+
+def test_kmeans_constant_data_does_not_crash(session):
+    X = np.ones((64, 3), dtype=np.float32)
+    t = TpuTable.from_arrays(X, None, session=session)
+    model = KMeans(k=3, max_iter=10, seed=0).fit(t)
+    assert np.all(np.isfinite(model.cluster_centers_))
+
+
+def test_fit_linear_max_iter_zero_finite_loss(session, iris):
+    from orange3_spark_tpu.models.logistic_regression import LogisticRegression
+
+    est = LogisticRegression(max_iter=0)
+    model = est.fit(iris)
+    assert model.n_iter_ == 0  # and final_loss must be finite (ln 3 at init)
