@@ -89,10 +89,12 @@ class Estimator:
     def fit(self, table: TpuTable) -> Model:
         t0 = time.perf_counter()
         model = self._fit(table)
-        try:
-            jax.block_until_ready(model.state_pytree)  # don't time async dispatch
-        except NotImplementedError:
-            pass
+        if isinstance(model, Model):
+            try:
+                jax.block_until_ready(model.state_pytree)  # don't time async dispatch
+            except NotImplementedError:
+                pass
+        # else: stateless result (e.g. QuantileDiscretizer -> Bucketizer)
         dt = time.perf_counter() - t0
         # rows/sec/chip is THE baseline metric (BASELINE.json "metric").
         # NOTE: first call includes XLA compile; benchmark harnesses must warm

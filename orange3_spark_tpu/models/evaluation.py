@@ -1,0 +1,234 @@
+"""Evaluators — ``pyspark.ml.evaluation`` capability parity.
+
+BinaryClassificationEvaluator (areaUnderROC/PR), MulticlassClassification-
+Evaluator (accuracy/f1/precision/recall), RegressionEvaluator (rmse/mse/mae/r2),
+ClusteringEvaluator (silhouette). All computed as weighted device reductions
+over the sharded prediction columns a model's transform() appended.
+(SURVEY.md §2b — reconstructed, mount empty; evaluator widgets in the add-on
+wrap these MLlib classes.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models.base import Params
+from orange3_spark_tpu.ops.stats import EPS_TOTAL_WEIGHT
+
+
+def _col(table: TpuTable, name: str):
+    return table.column(name)
+
+
+@dataclasses.dataclass(frozen=True)
+class EvaluatorParams(Params):
+    metric_name: str = ""
+    prediction_col: str = "prediction"
+    label_col: str = ""          # default: the table's class var
+    probability_col: str = ""    # binary: score column (default probability_<pos>)
+
+
+class _Evaluator:
+    ParamsCls = EvaluatorParams
+    default_metric = ""
+
+    def __init__(self, params: EvaluatorParams | None = None, **kwargs):
+        self.params = params or EvaluatorParams(**kwargs)
+
+    def _label(self, table: TpuTable):
+        p = self.params
+        return _col(table, p.label_col) if p.label_col else table.y
+
+    def evaluate(self, table: TpuTable) -> float:
+        metric = self.params.metric_name or self.default_metric
+        return float(self._compute(table, metric))
+
+    def _compute(self, table: TpuTable, metric: str):
+        raise NotImplementedError
+
+
+@jax.jit
+def _weighted_auc(score, label, w):
+    """Weighted ROC AUC via the rank statistic, O(N log N) device sort.
+
+    Tied scores get the exact weighted MIDRANK of their tie group (cumulative
+    weight before the group + half the group's weight), so the result is
+    independent of sort order among ties — all-equal scores give exactly 0.5.
+    """
+    n = score.shape[0]
+    order = jnp.argsort(score)
+    s, y, ww = score[order], label[order], w[order]
+    cw = jnp.cumsum(ww)
+    # tie groups: group id = number of strict increases seen so far
+    new_group = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                 (s[1:] > s[:-1]).astype(jnp.int32)])
+    gid = jnp.cumsum(new_group)                      # [N] in [0, n)
+    group_w = jax.ops.segment_sum(ww, gid, num_segments=n)
+    group_end_cw = jax.ops.segment_max(cw, gid, num_segments=n)
+    midrank_g = group_end_cw - group_w / 2.0
+    rank = midrank_g[gid]
+    pos_w = jnp.sum(jnp.where(y > 0, ww, 0.0))
+    neg_w = jnp.sum(jnp.where(y <= 0, ww, 0.0))
+    sum_pos_ranks = jnp.sum(jnp.where(y > 0, rank * ww, 0.0))
+    auc = (sum_pos_ranks / jnp.maximum(pos_w, EPS_TOTAL_WEIGHT)
+           - pos_w / 2.0) / jnp.maximum(neg_w, EPS_TOTAL_WEIGHT)
+    return jnp.clip(auc, 0.0, 1.0)
+
+
+@jax.jit
+def _weighted_auc_pr(score, label, w):
+    """Weighted area under the precision-recall curve: step integration at
+    descending score thresholds, with tied scores collapsed to one curve
+    point (the tie-group end), matching sklearn's average_precision on
+    distinct scores and remaining order-independent under ties."""
+    n = score.shape[0]
+    order = jnp.argsort(-score)
+    s, y, ww = score[order], label[order], w[order]
+    tp = jnp.cumsum(jnp.where(y > 0, ww, 0.0))
+    fp = jnp.cumsum(jnp.where(y <= 0, ww, 0.0))
+    pos_w = jnp.maximum(tp[-1], EPS_TOTAL_WEIGHT)
+    precision = tp / jnp.maximum(tp + fp, EPS_TOTAL_WEIGHT)
+    recall = tp / pos_w
+    # dense tie-group ids (descending order -> strict decrease starts a group)
+    gid = jnp.cumsum(jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                      (s[1:] < s[:-1]).astype(jnp.int32)]))
+    is_end = jnp.concatenate([(s[1:] < s[:-1]), jnp.ones((1,), bool)])
+    # per-group curve point = values at the group's end element
+    g_recall = jax.ops.segment_sum(jnp.where(is_end, recall, 0.0), gid, num_segments=n)
+    g_prec = jax.ops.segment_sum(jnp.where(is_end, precision, 0.0), gid, num_segments=n)
+    prev_recall = jnp.concatenate([jnp.zeros((1,)), g_recall[:-1]])
+    # empty trailing group slots have g_prec == g_recall == 0 -> zero step
+    steps = jnp.maximum(g_recall - prev_recall, 0.0) * g_prec
+    return jnp.clip(jnp.sum(steps), 0.0, 1.0)
+
+
+class BinaryClassificationEvaluator(_Evaluator):
+    default_metric = "areaUnderROC"
+
+    def _compute(self, table: TpuTable, metric: str):
+        p = self.params
+        label = self._label(table)
+        names = [v.name for v in table.domain.attributes]
+        if p.probability_col:
+            score = _col(table, p.probability_col)
+        elif "probability_1" in names:
+            score = _col(table, "probability_1")
+        elif any(n.startswith("probability_") for n in names):
+            score = _col(table, [n for n in names if n.startswith("probability_")][-1])
+        elif "rawPrediction" in names:
+            score = _col(table, "rawPrediction")
+        else:
+            raise ValueError("no probability/rawPrediction column; transform first")
+        if metric == "areaUnderROC":
+            return _weighted_auc(score, label, table.W)
+        if metric == "areaUnderPR":
+            return _weighted_auc_pr(score, label, table.W)
+        raise ValueError(f"unknown metric {metric!r}")
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("n_classes",))
+def _confusion_weighted(pred, label, w, n_classes):
+    oh_p = jax.nn.one_hot(pred.astype(jnp.int32), n_classes) * w[:, None]
+    oh_l = jax.nn.one_hot(label.astype(jnp.int32), n_classes)
+    return oh_l.T @ oh_p  # [true, pred] weighted counts
+
+
+class MulticlassClassificationEvaluator(_Evaluator):
+    default_metric = "accuracy"
+
+    def _compute(self, table: TpuTable, metric: str):
+        pred = _col(table, self.params.prediction_col)
+        label = self._label(table)
+        n_classes = int(np.asarray(jnp.maximum(jnp.max(pred), jnp.max(label))).item()) + 1
+        C = _confusion_weighted(pred, label, table.W, n_classes)
+        C = np.asarray(C)
+        tp = np.diag(C)
+        tot = max(C.sum(), 1e-12)
+        if metric == "accuracy":
+            return tp.sum() / tot
+        prec = tp / np.maximum(C.sum(axis=0), 1e-12)
+        rec = tp / np.maximum(C.sum(axis=1), 1e-12)
+        support = C.sum(axis=1) / tot
+        if metric == "weightedPrecision":
+            return float(np.sum(prec * support))
+        if metric == "weightedRecall":
+            return float(np.sum(rec * support))
+        if metric == "f1":
+            f1 = 2 * prec * rec / np.maximum(prec + rec, 1e-12)
+            return float(np.sum(f1 * support))
+        raise ValueError(f"unknown metric {metric!r}")
+
+
+class RegressionEvaluator(_Evaluator):
+    default_metric = "rmse"
+
+    def _compute(self, table: TpuTable, metric: str):
+        pred = _col(table, self.params.prediction_col)
+        label = self._label(table)
+        w = table.W
+        tot = jnp.maximum(jnp.sum(w), EPS_TOTAL_WEIGHT)
+        err = pred - label
+        if metric in ("rmse", "mse"):
+            mse = jnp.sum(err * err * w) / tot
+            return jnp.sqrt(mse) if metric == "rmse" else mse
+        if metric == "mae":
+            return jnp.sum(jnp.abs(err) * w) / tot
+        if metric == "r2":
+            mean_y = jnp.sum(label * w) / tot
+            ss_res = jnp.sum(err * err * w)
+            ss_tot = jnp.maximum(jnp.sum((label - mean_y) ** 2 * w), EPS_TOTAL_WEIGHT)
+            return 1.0 - ss_res / ss_tot
+        raise ValueError(f"unknown metric {metric!r}")
+
+
+class ClusteringEvaluator(_Evaluator):
+    """Silhouette (simplified squared-Euclidean form, like Spark): uses
+    cluster centroids rather than all-pairs distances — O(N*k) on device."""
+
+    default_metric = "silhouette"
+
+    def _compute(self, table: TpuTable, metric: str):
+        if metric != "silhouette":
+            raise ValueError(f"unknown metric {metric!r}")
+        pred = _col(table, self.params.prediction_col
+                    if self.params.prediction_col != "prediction" else "cluster")
+        X_names = [v.name for v in table.domain.attributes]
+        feat_idx = [i for i, n in enumerate(X_names)
+                    if n not in ("cluster", "prediction")]
+        X = jnp.take(table.X, jnp.asarray(feat_idx), axis=1)
+        w = table.W
+        k = int(np.asarray(jnp.max(pred)).item()) + 1
+        return float(_silhouette_centroid(X, pred, w, k))
+
+
+from functools import partial  # noqa: E402
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _silhouette_centroid(X, pred, w, k: int):
+    onehot = jax.nn.one_hot(pred.astype(jnp.int32), k) * w[:, None]
+    counts = jnp.maximum(jnp.sum(onehot, axis=0), EPS_TOTAL_WEIGHT)
+    centroids = (onehot.T @ X) / counts[:, None]
+    d2 = (
+        jnp.sum(X * X, axis=1, keepdims=True)
+        - 2.0 * X @ centroids.T
+        + jnp.sum(centroids * centroids, axis=1)
+    )  # [N, k]
+    own = jnp.take_along_axis(d2, pred.astype(jnp.int32)[:, None], axis=1)[:, 0]
+    other = jnp.min(
+        jnp.where(
+            jax.nn.one_hot(pred.astype(jnp.int32), k) > 0, jnp.inf, d2
+        ),
+        axis=1,
+    )
+    s = (other - own) / jnp.maximum(jnp.maximum(own, other), EPS_TOTAL_WEIGHT)
+    tot = jnp.maximum(jnp.sum(w), EPS_TOTAL_WEIGHT)
+    return jnp.sum(s * w) / tot

@@ -31,6 +31,38 @@ def weighted_moments(X, w):
 
 
 @jax.jit
+def weighted_quantiles(X, w, qs):
+    """Per-column weighted quantiles (DataFrame.approxQuantile parity).
+
+    Exact (not sketch-based like Spark's Greenwald-Khanna): a full device sort
+    per column — O(N log N) on-device beats a host-side streaming sketch until
+    N no longer fits HBM, and it keeps the op usable inside jitted pipelines
+    (QuantileDiscretizer, GBT binning). Padding/filtered rows (w==0) are
+    excluded by the cumulative-weight search (including q=0, which returns the
+    smallest LIVE value, not a padding zero). Columns with zero total weight
+    return 0.0.
+
+    X: f32[N, d] row-sharded; w: f32[N] or f32[N, d] per-cell weights
+    (per-cell lets Imputer batch its per-column missing masks into one call).
+    Returns f32[q, d].
+    """
+    W2 = w[:, None] * jnp.ones_like(X) if w.ndim == 1 else w
+    order = jnp.argsort(X, axis=0)                       # [N, d]
+    Xs = jnp.take_along_axis(X, order, axis=0)
+    ws = jnp.take_along_axis(W2, order, axis=0)
+    cw = jnp.cumsum(ws, axis=0)
+    tot_raw = cw[-1]                                     # [d]
+    tot = jnp.maximum(tot_raw, EPS_TOTAL_WEIGHT)
+    # clip the target above zero so leading zero-weight (padding) runs — where
+    # cw is still exactly 0 — are never selected, even at q=0
+    targets = jnp.maximum(qs[:, None] * tot[None, :], EPS_TOTAL_WEIGHT)
+    idx = jnp.sum(cw[None, :, :] < targets[:, None, :], axis=1)
+    idx = jnp.clip(idx, 0, X.shape[0] - 1)
+    out = jnp.take_along_axis(Xs, idx, axis=0)
+    return jnp.where(tot_raw[None, :] > 0, out, 0.0)
+
+
+@jax.jit
 def inv_std_scale(X, w):
     """1/std per column (1.0 for constant columns) — MLlib-style scale-only
     standardization factor."""

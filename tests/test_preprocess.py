@@ -1,0 +1,324 @@
+"""Feature transformer + evaluator tests vs sklearn numerics (SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.core.domain import (
+    ContinuousVariable,
+    DiscreteVariable,
+    Domain,
+    StringVariable,
+)
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.datasets import make_classification
+from orange3_spark_tpu.models.preprocess import (
+    Binarizer,
+    Bucketizer,
+    FeatureHasher,
+    Imputer,
+    MaxAbsScaler,
+    MinMaxScaler,
+    Normalizer,
+    OneHotEncoder,
+    QuantileDiscretizer,
+    StandardScaler,
+    StringIndexer,
+    VectorAssembler,
+)
+
+
+def _table(session, n=100, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    X = (rng.standard_normal((n, d)) * [1, 5, 0.1, 10] + [0, 3, -2, 100]).astype(np.float32)
+    return TpuTable.from_arrays(X, None, session=session), X
+
+
+def test_standard_scaler_matches_sklearn(session):
+    t, X = _table(session)
+    out = StandardScaler(with_mean=True, with_std=True).fit(t).transform(t)
+    from sklearn.preprocessing import StandardScaler as Sk
+
+    np.testing.assert_allclose(
+        out.to_numpy()[0], Sk().fit_transform(X), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_standard_scaler_default_no_mean(session):
+    t, X = _table(session)
+    out = StandardScaler().fit(t).transform(t)  # Spark default: withMean=False
+    got = out.to_numpy()[0]
+    np.testing.assert_allclose(got, X / X.std(0), rtol=1e-4, atol=1e-5)
+
+
+def test_minmax_scaler(session):
+    t, X = _table(session)
+    out = MinMaxScaler().fit(t).transform(t)
+    got = out.to_numpy()[0]
+    assert got.min() >= -1e-6 and got.max() <= 1 + 1e-6
+    from sklearn.preprocessing import MinMaxScaler as Sk
+
+    np.testing.assert_allclose(got, Sk().fit_transform(X), rtol=1e-4, atol=1e-5)
+
+
+def test_minmax_constant_column_maps_to_midpoint(session):
+    X = np.ones((32, 2), dtype=np.float32)
+    X[:, 1] = np.arange(32)
+    t = TpuTable.from_arrays(X, None, session=session)
+    got = MinMaxScaler().fit(t).transform(t).to_numpy()[0]
+    np.testing.assert_allclose(got[:, 0], 0.5)
+
+
+def test_maxabs_scaler(session):
+    t, X = _table(session)
+    got = MaxAbsScaler().fit(t).transform(t).to_numpy()[0]
+    from sklearn.preprocessing import MaxAbsScaler as Sk
+
+    np.testing.assert_allclose(got, Sk().fit_transform(X), rtol=1e-4, atol=1e-5)
+
+
+def test_imputer_mean_and_median(session):
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((200, 3)).astype(np.float32)
+    X[::7, 0] = np.nan
+    X[::5, 2] = np.nan
+    t = TpuTable.from_arrays(X, None, session=session)
+    got = Imputer(strategy="mean").fit(t).transform(t).to_numpy()[0]
+    from sklearn.impute import SimpleImputer
+
+    exp = SimpleImputer(strategy="mean").fit_transform(X)
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+    got_med = Imputer(strategy="median").fit(t).transform(t).to_numpy()[0]
+    exp_med = SimpleImputer(strategy="median").fit_transform(X)
+    # our weighted quantile uses a step interpolation; allow small tolerance
+    np.testing.assert_allclose(got_med, exp_med, rtol=1e-2, atol=5e-2)
+
+
+def test_imputer_scaler_ignore_filtered_rows(session):
+    t, X = _table(session, n=60)
+    import jax.numpy as jnp
+
+    half = t.filter(jnp.arange(t.n_pad) < 30)
+    m = StandardScaler(with_mean=True).fit(half)
+    np.testing.assert_allclose(np.asarray(m.mean), X[:30].mean(0), rtol=1e-4, atol=1e-5)
+
+
+def test_bucketizer(session):
+    X = np.asarray([[-5.0], [-0.5], [0.0], [0.5], [5.0]], dtype=np.float32)
+    t = TpuTable.from_arrays(X, None, attr_names=["v"], session=session)
+    b = Bucketizer(splits=(-np.inf, 0.0, 1.0, np.inf), input_col="v")
+    out = b.transform(t)
+    binned = np.asarray(out.column("v_binned"))[:5]
+    np.testing.assert_array_equal(binned, [0, 0, 1, 1, 2])
+
+
+def test_quantile_discretizer(session):
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((1000, 1)).astype(np.float32)
+    t = TpuTable.from_arrays(X, None, attr_names=["v"], session=session)
+    model = QuantileDiscretizer(num_buckets=4, input_col="v").fit(t)
+    out = model.transform(t)
+    binned = np.asarray(out.column("v_binned"))[:1000]
+    counts = np.bincount(binned.astype(int), minlength=4)
+    assert counts.min() > 180  # ~250 each for 4 quantile buckets
+
+
+def test_one_hot_encoder(session):
+    X = np.asarray([[0, 1.5], [1, 2.5], [2, 3.5], [1, 4.5]], dtype=np.float32)
+    dom = Domain([DiscreteVariable("cat", ("a", "b", "c")), ContinuousVariable("x")])
+    t = TpuTable.from_numpy(dom, X, session=session)
+    out = OneHotEncoder(input_cols=("cat",), drop_last=False).fit(t).transform(t)
+    names = [v.name for v in out.domain.attributes]
+    assert names == ["x", "cat_a", "cat_b", "cat_c"]
+    got = out.to_numpy()[0]
+    np.testing.assert_array_equal(got[:, 1:], np.eye(3)[[0, 1, 2, 1]])
+    # drop_last=True (Spark default) drops the final category column
+    out2 = OneHotEncoder(input_cols=("cat",)).fit(t).transform(t)
+    assert [v.name for v in out2.domain.attributes] == ["x", "cat_a", "cat_b"]
+
+
+def test_string_indexer(session):
+    X = np.zeros((5, 1), dtype=np.float32)
+    dom = Domain([ContinuousVariable("x")], None, [StringVariable("city")])
+    metas = np.asarray(["nyc", "sf", "nyc", "la", "nyc"], dtype=object)
+    t = TpuTable.from_numpy(dom, X, metas=metas, session=session)
+    model = StringIndexer(input_col="city").fit(t)
+    assert model.labels[0] == "nyc"  # most frequent first
+    out = model.transform(t)
+    idx = np.asarray(out.column("city_idx"))[:5]
+    assert idx[0] == idx[2] == idx[4] == 0.0
+
+
+def test_string_indexer_unseen_label(session):
+    X = np.zeros((2, 1), dtype=np.float32)
+    dom = Domain([ContinuousVariable("x")], None, [StringVariable("c")])
+    t = TpuTable.from_numpy(dom, X, metas=np.asarray(["a", "b"], dtype=object), session=session)
+    model = StringIndexer(input_col="c").fit(t)
+    t2 = TpuTable.from_numpy(dom, X, metas=np.asarray(["a", "zzz"], dtype=object), session=session)
+    with pytest.raises(ValueError, match="unseen"):
+        model.transform(t2)
+    model_keep = StringIndexer(input_col="c", handle_invalid="keep").fit(t)
+    out = model_keep.transform(t2)
+    assert np.asarray(out.column("c_idx"))[1] == 2.0
+
+
+def test_normalizer(session):
+    t, X = _table(session)
+    got = Normalizer(p=2.0).transform(t).to_numpy()[0]
+    np.testing.assert_allclose(np.linalg.norm(got, axis=1), 1.0, rtol=1e-5)
+
+
+def test_binarizer(session):
+    t, X = _table(session)
+    got = Binarizer(threshold=0.0).transform(t).to_numpy()[0]
+    np.testing.assert_array_equal(got, (X > 0).astype(np.float32))
+
+
+def test_vector_assembler(session):
+    t, X = _table(session)
+    out = VectorAssembler(["x2", "x0"]).transform(t)
+    assert [v.name for v in out.domain.attributes] == ["x2", "x0"]
+
+
+def test_feature_hasher(session):
+    X = np.asarray([[0, 2.0], [1, 3.0]], dtype=np.float32)
+    dom = Domain([DiscreteVariable("cat", ("a", "b")), ContinuousVariable("val")])
+    t = TpuTable.from_numpy(dom, X, session=session)
+    out = FeatureHasher(num_features=16).transform(t)
+    got = out.to_numpy()[0]
+    assert got.shape == (2, 16)
+    # row sums: 1.0 (category) + value
+    np.testing.assert_allclose(got.sum(1), [3.0, 4.0], rtol=1e-5)
+
+
+# ----------------------------------------------------------------- evaluators
+def test_evaluators_vs_sklearn(session):
+    from orange3_spark_tpu.models.evaluation import (
+        BinaryClassificationEvaluator,
+        MulticlassClassificationEvaluator,
+        RegressionEvaluator,
+    )
+    from orange3_spark_tpu.models.logistic_regression import LogisticRegression
+
+    t = make_classification(400, 6, n_classes=2, seed=4, noise=1.0, session=session)
+    model = LogisticRegression(max_iter=50).fit(t)
+    scored = model.transform(t)
+    y = t.to_numpy()[1][:, 0]
+    proba = model.predict_proba(t)[:, 1]
+    pred = model.predict(t)
+
+    from sklearn.metrics import accuracy_score, f1_score, roc_auc_score
+
+    auc = BinaryClassificationEvaluator().evaluate(scored)
+    np.testing.assert_allclose(auc, roc_auc_score(y, proba), atol=2e-3)
+
+    acc = MulticlassClassificationEvaluator(metric_name="accuracy").evaluate(scored)
+    np.testing.assert_allclose(acc, accuracy_score(y, pred), atol=1e-6)
+
+    f1 = MulticlassClassificationEvaluator(metric_name="f1").evaluate(scored)
+    np.testing.assert_allclose(f1, f1_score(y, pred, average="weighted"), atol=1e-4)
+
+    # regression evaluator on a synthetic column pair
+    rng = np.random.default_rng(5)
+    yy = rng.standard_normal(200).astype(np.float32)
+    ph = yy + 0.1 * rng.standard_normal(200).astype(np.float32)
+    dom = Domain([ContinuousVariable("prediction")], ContinuousVariable("label"))
+    tt = TpuTable.from_numpy(dom, ph[:, None], yy, session=session)
+    from sklearn.metrics import mean_squared_error, r2_score
+
+    rmse = RegressionEvaluator(metric_name="rmse", label_col="label").evaluate(tt)
+    np.testing.assert_allclose(rmse, np.sqrt(mean_squared_error(yy, ph)), rtol=1e-4)
+    r2 = RegressionEvaluator(metric_name="r2", label_col="label").evaluate(tt)
+    np.testing.assert_allclose(r2, r2_score(yy, ph), rtol=1e-4)
+
+
+def test_clustering_evaluator(session):
+    from orange3_spark_tpu.datasets import make_blobs
+    from orange3_spark_tpu.models.evaluation import ClusteringEvaluator
+    from orange3_spark_tpu.models.kmeans import KMeans
+
+    t, _ = make_blobs(500, 4, n_centers=3, seed=12, spread=0.3, session=session)
+    out = KMeans(k=3, max_iter=50, n_init=3).fit(t).transform(t)
+    sil = ClusteringEvaluator().evaluate(out)
+    assert sil > 0.6  # tight blobs: strongly positive silhouette
+
+
+def test_auc_tied_scores_order_independent(session):
+    """All-equal scores must give AUC 0.5 regardless of label order."""
+    import jax.numpy as jnp
+
+    from orange3_spark_tpu.models.evaluation import _weighted_auc
+
+    score = jnp.full((8,), 0.5)
+    w = jnp.ones((8,))
+    for labels in ([1, 1, 1, 1, 0, 0, 0, 0], [0, 0, 0, 0, 1, 1, 1, 1]):
+        auc = float(_weighted_auc(score, jnp.asarray(labels, jnp.float32), w))
+        np.testing.assert_allclose(auc, 0.5, atol=1e-6)
+
+
+def test_auc_pr_matches_sklearn(session):
+    from orange3_spark_tpu.models.evaluation import BinaryClassificationEvaluator
+    from orange3_spark_tpu.models.logistic_regression import LogisticRegression
+
+    t = make_classification(300, 5, n_classes=2, seed=13, noise=1.5, session=session)
+    model = LogisticRegression(max_iter=50).fit(t)
+    scored = model.transform(t)
+    pr = BinaryClassificationEvaluator(metric_name="areaUnderPR").evaluate(scored)
+
+    from sklearn.metrics import average_precision_score
+
+    y = t.to_numpy()[1][:, 0]
+    ap = average_precision_score(y, model.predict_proba(t)[:, 1])
+    np.testing.assert_allclose(pr, ap, atol=5e-3)
+
+
+def test_quantile_q0_ignores_padding(session):
+    import jax.numpy as jnp
+
+    from orange3_spark_tpu.ops.stats import weighted_quantiles
+
+    X = jnp.asarray([[5.0], [6.0], [7.0], [0.0], [0.0]])
+    w = jnp.asarray([1.0, 1.0, 1.0, 0.0, 0.0])
+    q = weighted_quantiles(X, w, jnp.asarray([0.0, 0.5, 1.0]))
+    np.testing.assert_allclose(np.asarray(q)[:, 0], [5.0, 6.0, 7.0])
+    # all-dead column -> defined 0.0
+    q2 = weighted_quantiles(X, jnp.zeros((5,)), jnp.asarray([0.5]))
+    np.testing.assert_allclose(np.asarray(q2)[0, 0], 0.0)
+
+
+def test_string_indexer_ignores_filtered_rows(session):
+    import jax.numpy as jnp
+
+    X = np.zeros((4, 1), dtype=np.float32)
+    dom = Domain([ContinuousVariable("x")], None, [StringVariable("c")])
+    metas = np.asarray(["rare", "common", "common", "rare"], dtype=object)
+    t = TpuTable.from_numpy(dom, X, metas=metas, session=session)
+    # filter out the 'rare' rows; fit must not see them, transform must not error
+    filtered = t.filter(jnp.asarray([False, True, True, False] + [False] * (t.n_pad - 4)))
+    model = StringIndexer(input_col="c").fit(filtered)
+    assert model.labels == ("common",) or model.labels == ["common"] or list(model.labels) == ["common"]
+    model.transform(filtered)  # must not raise on dead 'rare' rows
+
+
+def test_ohe_unseen_category_errors(session):
+    X = np.asarray([[0.0], [1.0]], dtype=np.float32)
+    dom = Domain([DiscreteVariable("cat", ("a", "b"))])
+    t = TpuTable.from_numpy(dom, X, session=session)
+    model = OneHotEncoder(input_cols=("cat",)).fit(t)
+    t2 = TpuTable.from_numpy(dom, np.asarray([[0.0], [2.0]], dtype=np.float32), session=session)
+    with pytest.raises(ValueError, match="unseen"):
+        model.transform(t2)
+
+
+def test_minmax_custom_range_roundtrips_state(session):
+    t, X = _table(session)
+    model = MinMaxScaler(min=-1.0, max=1.0).fit(t)
+    state = {k: np.asarray(v) for k, v in model.state_pytree.items()}
+    from orange3_spark_tpu.models.preprocess import MinMaxScalerModel
+    import jax.numpy as jnp
+
+    restored = MinMaxScalerModel(model.params, jnp.asarray(state["idxs"]),
+                                 jnp.asarray(state["shift"]), jnp.asarray(state["scale"]))
+    got = restored.transform(t).to_numpy()[0]
+    assert got.min() >= -1 - 1e-5 and got.max() <= 1 + 1e-5
+    assert got.min() < -0.5  # actually uses the custom range
