@@ -1,0 +1,3 @@
+from orange3_spark_tpu.io.readers import CsvReaderParams, read_csv, read_parquet
+
+__all__ = ["CsvReaderParams", "read_csv", "read_parquet"]

@@ -1,0 +1,132 @@
+"""Sharded data ingest — the ``spark.read`` csv/parquet role.
+
+Spark reads files split-per-executor; the TPU-native path is: host parses
+(pyarrow CSV/parquet readers — C++ under the hood, multithreaded), columns
+land in numpy, one ``jax.device_put`` shards rows over the mesh
+(SURVEY.md §2b "Data ingest"; reconstructed, mount empty). On multi-host
+deployments each process would read its slice and
+``jax.make_array_from_process_local_data`` assembles the global array — same
+call sites, gated on process count.
+
+Schema inference: numeric columns → ContinuousVariable; string columns with
+few uniques → DiscreteVariable (value-indexed); other strings → metas. The
+class column is chosen by name (``class_col``) like the reference's reader
+widgets let the user pick a target.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from orange3_spark_tpu.core.domain import (
+    ContinuousVariable,
+    DiscreteVariable,
+    Domain,
+    StringVariable,
+)
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models.base import Params
+
+MAX_DISCRETE_VALUES = 64  # string columns above this many uniques become metas
+
+
+@dataclasses.dataclass(frozen=True)
+class CsvReaderParams(Params):
+    path: str = ""
+    class_col: str = ""          # name of the target column ("" = none)
+    header: bool = True          # Spark option("header", ...)
+    delimiter: str = ","         # Spark option("sep", ...)
+
+
+def _table_from_columns(
+    names: list[str],
+    columns: dict[str, np.ndarray],
+    class_col: str,
+    session=None,
+) -> TpuTable:
+    if class_col and class_col not in names:
+        raise ValueError(
+            f"class_col {class_col!r} not found; columns are {names}"
+        )
+    attrs, attr_cols = [], []
+    class_var, class_vals = None, None
+    metas_vars, meta_cols = [], []
+    for name in names:
+        col = columns[name]
+        is_target = name == class_col
+        if np.issubdtype(col.dtype, np.number) or col.dtype == bool:
+            var = ContinuousVariable(name)
+            vals = col.astype(np.float32)
+        else:
+            # pyarrow yields object arrays with None for missing cells; those
+            # (and empty strings) are MISSING, never a category of their own
+            raw = np.asarray(col, dtype=object)
+            missing = np.asarray([s is None or s == "" or (isinstance(s, float) and s != s) for s in raw])
+            strings = np.asarray(["" if m else str(s) for s, m in zip(raw, missing)])
+            uniq = np.unique(strings[~missing])
+            if len(uniq) <= MAX_DISCRETE_VALUES or is_target:
+                var = DiscreteVariable(name, tuple(uniq.tolist()))
+                lut = {s: float(i) for i, s in enumerate(var.values)}
+                vals = np.asarray(
+                    [np.nan if m else lut[s] for s, m in zip(strings, missing)],
+                    dtype=np.float32,
+                )
+            else:
+                metas_vars.append(StringVariable(name))
+                meta_cols.append(raw)
+                continue
+        if is_target:
+            # a numeric target stays continuous; a string target is discrete
+            class_var, class_vals = var, vals
+        else:
+            attrs.append(var)
+            attr_cols.append(vals)
+    X = np.stack(attr_cols, axis=1) if attr_cols else np.zeros((len(next(iter(columns.values()))), 0), np.float32)
+    metas = np.stack(meta_cols, axis=1) if meta_cols else None
+    domain = Domain(attrs, class_var, metas_vars)
+    return TpuTable.from_numpy(domain, X, class_vals, metas, session=session)
+
+
+def read_csv(
+    path: str = "",
+    class_col: str = "",
+    *,
+    params: CsvReaderParams | None = None,
+    session=None,
+) -> TpuTable:
+    """CSV → sharded TpuTable via pyarrow's multithreaded C++ parser."""
+    import pyarrow.csv as pacsv
+
+    p = params or CsvReaderParams(path=path, class_col=class_col)
+    table = pacsv.read_csv(
+        p.path or path,
+        parse_options=pacsv.ParseOptions(delimiter=p.delimiter),
+        read_options=pacsv.ReadOptions(autogenerate_column_names=not p.header),
+    )
+    names = table.column_names
+    columns = {n: table.column(n).to_numpy(zero_copy_only=False) for n in names}
+    return _table_from_columns(names, columns, p.class_col or class_col, session)
+
+
+def read_parquet(path: str, class_col: str = "", *, session=None) -> TpuTable:
+    """Parquet → sharded TpuTable (spark.read.parquet role)."""
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(path)
+    names = table.column_names
+    columns = {n: table.column(n).to_numpy(zero_copy_only=False) for n in names}
+    return _table_from_columns(names, columns, class_col, session)
+
+
+def write_csv(table: TpuTable, path: str) -> None:
+    """Collect + write (df.write.csv role; host boundary by design)."""
+    X, Y, _ = table.to_numpy()
+    names = [v.name for v in table.domain.attributes]
+    data = X
+    if Y is not None:
+        names += [v.name for v in table.domain.class_vars]
+        data = np.concatenate([X, Y], axis=1)
+    header = ",".join(names)
+    np.savetxt(path, data, delimiter=",", header=header, comments="", fmt="%.9g")

@@ -242,6 +242,8 @@ class BucketizerParams(Params):
 class Bucketizer(Transformer):
     """Stateless: bin one column by explicit split points (MLlib Bucketizer)."""
 
+    ParamsCls = BucketizerParams
+
     def __init__(self, params: BucketizerParams | None = None, **kwargs):
         self.params = params or BucketizerParams(**kwargs)
         if len(self.params.splits) < 3:
@@ -447,6 +449,8 @@ class NormalizerParams(Params):
 
 
 class Normalizer(Transformer):
+    ParamsCls = NormalizerParams
+
     def __init__(self, params: NormalizerParams | None = None, **kwargs):
         self.params = params or NormalizerParams(**kwargs)
 
@@ -464,6 +468,8 @@ class BinarizerParams(Params):
 
 
 class Binarizer(Transformer):
+    ParamsCls = BinarizerParams
+
     def __init__(self, params: BinarizerParams | None = None, **kwargs):
         self.params = params or BinarizerParams(**kwargs)
 
@@ -492,6 +498,7 @@ class FeatureHasherParams(Params):
 
 
 class FeatureHasher(Transformer):
+    ParamsCls = FeatureHasherParams
     """MLlib FeatureHasher: continuous cols add their value at hash(name);
     discrete cols add 1.0 at hash(name + '=' + category).
 

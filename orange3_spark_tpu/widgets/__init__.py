@@ -1,0 +1,4 @@
+from orange3_spark_tpu.widgets.base import Input, Output, Widget
+from orange3_spark_tpu.widgets.catalog import WIDGET_REGISTRY, widget_for_estimator
+
+__all__ = ["Input", "Output", "Widget", "WIDGET_REGISTRY", "widget_for_estimator"]

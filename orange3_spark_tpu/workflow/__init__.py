@@ -1,0 +1,4 @@
+from orange3_spark_tpu.workflow.graph import Edge, Node, WorkflowGraph
+from orange3_spark_tpu.workflow.staging import stage_transform_path
+
+__all__ = ["Edge", "Node", "WorkflowGraph", "stage_transform_path"]

@@ -1,0 +1,131 @@
+"""Whole-workflow staging: fuse a widget chain into ONE XLA computation.
+
+The north-star requirement (BASELINE.json): "the Orange widget signal graph
+is traced and staged into a single XLA computation". The eager signal manager
+(graph.py) fires widgets one by one, each dispatching its own jitted ops —
+correct, but every boundary is a dispatch and a missed fusion. Staging
+re-traces the DATA PATH of an already-run graph as one function
+``(X, Y, W) -> (X', Y', W')`` and jits it once: XLA then fuses the whole
+chain (imputer + scaler + one-hot + model.transform + ...) into a single
+program — elementwise work folds into matmul epilogues, intermediates never
+round-trip HBM between widgets, and there is exactly one device dispatch per
+batch.
+
+Estimator widgets contribute their FITTED model's transform (fit already
+happened in the eager run — Spark's analogue is the fitted PipelineModel);
+the fitted state pytrees are closed over as constants. Widgets that leave the
+device (views, evaluators, info) cannot be staged and terminate the path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.workflow.graph import WorkflowGraph
+
+
+class StagedTransform:
+    """A single jitted XLA program covering a workflow's data path."""
+
+    def __init__(self, fn, in_domain, out_domain, session, template: TpuTable):
+        self._jitted = jax.jit(fn)
+        self.in_domain = in_domain
+        self.out_domain = out_domain
+        self.session = session
+        self._template = template  # shape/domain reference for validation
+
+    def __call__(self, table: TpuTable) -> TpuTable:
+        if table.domain != self.in_domain:
+            raise ValueError("table domain does not match the staged input domain")
+        X, Y, W = self._jitted(table.X, table.Y, table.W)
+        return TpuTable(
+            self.out_domain, X, Y, W, table.metas, table.n_rows, self.session
+        )
+
+    def lower_text(self) -> str:
+        """StableHLO of the fused program (one module = one XLA computation)."""
+        t = self._template
+        return str(self._jitted.lower(t.X, t.Y, t.W).compiler_ir("stablehlo"))
+
+
+def _staged_step(node) -> Callable[[TpuTable], TpuTable] | None:
+    """Device-pure table->table function for one run node, or None."""
+    widget = node.widget
+    outs = node.outputs
+    if outs is None:
+        raise ValueError("run the graph before staging (models must be fitted)")
+    if "data" not in (outs or {}):
+        return None
+    model = outs.get("model")
+    if model is not None:
+        return model.transform          # fitted estimator widget
+    if hasattr(widget, "transformer"):
+        return widget.transformer.transform  # stateless transformer widget
+    if widget.name == "OWApplyModel":
+        return None  # handled by caller (needs its model input edge)
+    return None
+
+
+def stage_transform_path(
+    graph: WorkflowGraph, source: int, sink: int
+) -> StagedTransform:
+    """Fuse the data path source→sink of an already-run graph into one jit.
+
+    ``source`` must be a data-emitting node (its cached 'data' output is the
+    template); every node along the 'data' edges to ``sink`` must be a
+    transformer/fitted-estimator/apply widget.
+    """
+    outputs = graph.run()
+    # walk the unique 'data'-port chain from source to sink
+    chain: list[int] = []
+    cur = source
+    while cur != sink:
+        nxt = [e for e in graph.edges if e.src == cur and e.src_port == "data"]
+        nxt = [e for e in nxt if _reaches(graph, e.dst, sink)]
+        if not nxt:
+            raise ValueError(f"no data path from node {cur} to sink {sink}")
+        cur = nxt[0].dst
+        chain.append(cur)
+
+    template: TpuTable = outputs[source]["data"]
+    steps: list[Callable[[TpuTable], TpuTable]] = []
+    for nid in chain:
+        node = graph.nodes[nid]
+        if node.widget.name == "OWApplyModel":
+            model_edge = [
+                e for e in graph.edges if e.dst == nid and e.dst_port == "model"
+            ][0]
+            model = outputs[model_edge.src][model_edge.src_port]
+            steps.append(model.transform)
+            continue
+        step = _staged_step(node)
+        if step is None:
+            raise ValueError(
+                f"node {nid} ({node.widget.name}) is not stageable "
+                "(leaves the device or emits no data)"
+            )
+        steps.append(step)
+
+    session = template.session
+    in_domain = template.domain
+    out_domain = outputs[sink]["data"].domain
+    n_rows = template.n_rows
+
+    def fused(X, Y, W):
+        t = TpuTable(in_domain, X, Y, W, None, n_rows, session)
+        for step in steps:
+            t = step(t)
+        return t.X, t.Y, t.W
+
+    return StagedTransform(fused, in_domain, out_domain, session, template)
+
+
+def _reaches(graph: WorkflowGraph, start: int, target: int) -> bool:
+    if start == target:
+        return True
+    return any(
+        _reaches(graph, e.dst, target) for e in graph.edges if e.src == start
+    )
