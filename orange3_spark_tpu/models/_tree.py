@@ -1,0 +1,206 @@
+"""Histogram-based decision-tree induction as fixed-shape XLA.
+
+MLlib grows RandomForest/GBT trees with distributed binned histograms: rows
+carry a node id, each iteration aggregates per-(node, feature, bin) label
+statistics across executors, the driver picks best splits, repeat per level
+(SURVEY.md §2b row "RandomForest / GBTClassifier"; reconstructed, mount
+empty). That design is ALREADY the TPU-shaped one — everything here keeps it
+but removes the driver round-trip:
+
+* features are quantile-binned once to int32 bins (max_bins ≤ 256);
+* the tree is a PERFECT binary tree of static depth D — no data-dependent
+  shapes, dead nodes just stop splitting (split_bin = n_bins routes all rows
+  left), so the whole growth loop jits;
+* per-level histograms are ``segment_sum``s keyed by (node, bin), scanned
+  over features so the transient is [N] not [N·d]; the row-axis reduction is
+  GSPMD's ICI all-reduce (MLlib's executor→driver aggregate);
+* split selection = cumsum over bins + argmax over (feature, bin) — all on
+  device, no host in the loop;
+* the whole per-tree growth is vmappable: a forest fits as ONE program over a
+  tree axis (bootstrap weights + per-node feature masks differ by RNG key).
+
+Gain modes: 'gini' (classification, stats = per-class weighted counts),
+'variance' (MLlib regression/GBT residual splits, stats = [Σwy, Σwy², Σw]),
+'newton' (XGBoost-style grad/hess, stats = [G, H, Σw]) — GBT here uses
+newton gains + leaf values, a strict upgrade over MLlib's variance splits.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from orange3_spark_tpu.ops.stats import weighted_quantiles
+
+EPS = 1e-12
+
+
+class Tree(NamedTuple):
+    """Perfect binary tree of depth D over binned features.
+
+    feature:    i32[2^D - 1]   split feature per internal node (level order)
+    split_bin:  i32[2^D - 1]   go left iff bin <= split_bin (n_bins => leaf)
+    threshold:  f32[2^D - 1]   raw-value threshold (edges[feature, split_bin])
+    leaf_value: f32[2^D, s_out] value at each depth-D leaf
+    """
+
+    feature: jax.Array
+    split_bin: jax.Array
+    threshold: jax.Array
+    leaf_value: jax.Array
+
+    @property
+    def depth(self) -> int:
+        # leaf axis is second-to-last so this stays correct on stacked
+        # forests ([T, L, s]) as well as single trees ([L, s])
+        return self.leaf_value.shape[-2].bit_length() - 1
+
+
+def compute_bin_edges(X, W, max_bins: int):
+    """Weighted-quantile bin boundaries: f32[d, max_bins - 1]."""
+    qs = jnp.linspace(0.0, 1.0, max_bins + 1)[1:-1]
+    return weighted_quantiles(X, W, qs).T  # [d, max_bins-1]
+
+
+@jax.jit
+def bin_features(X, edges):
+    """int32 bins: B[n, f] = #edges strictly below X[n, f]  (0..max_bins-1)."""
+    return jnp.sum(X[:, :, None] > edges[None, :, :], axis=2).astype(jnp.int32)
+
+
+def _impurity_gain(Hc, gain_mode: str, reg: float, min_instances: float):
+    """Split gains from cumulative histograms.
+
+    Hc: f32[d, nodes, bins, s] cumulative-over-bins stats; candidate 'split at
+    bin b' sends bins <= b left. Returns gains f32[d, nodes, bins] with
+    invalid candidates at -inf.
+    """
+    total = Hc[:, :, -1:, :]
+    left, right = Hc, total - Hc
+
+    if gain_mode == "gini":
+        def gini_w(S):  # S [..., k] class counts -> weighted gini * count
+            c = jnp.sum(S, axis=-1)
+            p2 = jnp.sum(S * S, axis=-1) / jnp.maximum(c, EPS)
+            return c - p2  # = c * (1 - sum p_i^2)
+        gain = gini_w(total)[..., 0][:, :, None] - gini_w(left) - gini_w(right)
+        wl = jnp.sum(left, -1)
+        wr = jnp.sum(right, -1)
+    elif gain_mode == "variance":
+        def var_w(S):  # [Σwy, Σwy², Σw] -> weighted variance * count
+            s1, s2, c = S[..., 0], S[..., 1], S[..., 2]
+            return s2 - s1 * s1 / jnp.maximum(c, EPS)
+        gain = var_w(total)[..., 0][:, :, None] - var_w(left) - var_w(right)
+        wl, wr = left[..., 2], right[..., 2]
+    elif gain_mode == "newton":
+        def score(S):  # [G, H, Σw] -> -loss reduction potential
+            return S[..., 0] ** 2 / jnp.maximum(S[..., 1] + reg, EPS)
+        gain = 0.5 * (score(left) + score(right) - score(total)[..., 0][:, :, None])
+        wl, wr = left[..., 2], right[..., 2]
+    else:  # pragma: no cover
+        raise ValueError(gain_mode)
+
+    valid = (wl >= min_instances) & (wr >= min_instances)
+    # node weight for MLlib-style NORMALIZED min-gain thresholds: minInfoGain
+    # compares the per-weight impurity decrease, not the count-scaled sum
+    if gain_mode == "gini":
+        node_w = jnp.sum(total, axis=-1)[0, :, 0]  # [nodes]
+    else:
+        node_w = total[0, :, 0, 2]                 # [nodes]
+    return jnp.where(valid, gain, -jnp.inf), node_w
+
+
+@partial(
+    jax.jit,
+    static_argnames=("depth", "n_bins", "gain_mode", "min_instances"),
+)
+def grow_tree(
+    B,            # i32[N, d] binned features
+    S,            # f32[N, s] per-row stats (class one-hots * w, or [g, h, w])
+    edges,        # f32[d, n_bins - 1] raw bin boundaries
+    feat_keep,    # f32[depth_levels_max, d] per-level feature masks (1 keep)
+                  # pass ones for no subsetting; [2^l-wide masks broadcast]
+    min_gain,     # f32[] minimum gain to split (MLlib minInfoGain)
+    *,
+    depth: int,
+    n_bins: int,
+    gain_mode: str,
+    reg: float = 1.0,
+    min_instances: float = 1.0,
+):
+    """Grow one depth-D tree; vmap over (B-bootstrap stats, keys) for forests."""
+    N, d = B.shape
+    s = S.shape[1]
+    n_internal = 2**depth - 1
+    feature = jnp.zeros((n_internal,), jnp.int32)
+    split_bin = jnp.full((n_internal,), n_bins, jnp.int32)  # default: leaf
+    threshold = jnp.full((n_internal,), jnp.inf, jnp.float32)
+    pos = jnp.zeros((N,), jnp.int32)  # node position within current level
+
+    for level in range(depth):
+        nodes = 2**level
+        # ---- histograms: scan features, one segment_sum per feature ----
+        def hist_one_feature(j):
+            key = pos * n_bins + B[:, j]
+            return jax.ops.segment_sum(S, key, num_segments=nodes * n_bins)
+        H = jax.vmap(hist_one_feature)(jnp.arange(d))        # [d, nodes*bins, s]
+        H = H.reshape(d, nodes, n_bins, s)
+        Hc = jnp.cumsum(H, axis=2)
+        gains, node_w = _impurity_gain(Hc, gain_mode, reg, min_instances)
+        gains = jnp.where(feat_keep[level][:, None, None] > 0, gains, -jnp.inf)
+        flat = gains.transpose(1, 0, 2).reshape(nodes, d * n_bins)
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], 1)[:, 0]
+        bf = (best // n_bins).astype(jnp.int32)              # [nodes]
+        bb = (best % n_bins).astype(jnp.int32)
+        # MLlib minInfoGain semantics: threshold the PER-WEIGHT gain
+        do_split = best_gain > min_gain * jnp.maximum(node_w, EPS)
+        bf = jnp.where(do_split, bf, 0)
+        bb = jnp.where(do_split, bb, n_bins)                 # leaf: all go left
+        # raw threshold (last bin index means +inf)
+        thr = jnp.where(
+            bb < n_bins - 1,
+            edges[bf, jnp.clip(bb, 0, n_bins - 2)],
+            jnp.inf,
+        )
+        thr = jnp.where(do_split, thr, jnp.inf)
+        off = nodes - 1  # level-order offset of this level
+        feature = jax.lax.dynamic_update_slice(feature, bf, (off,))
+        split_bin = jax.lax.dynamic_update_slice(split_bin, bb, (off,))
+        threshold = jax.lax.dynamic_update_slice(threshold, thr, (off,))
+        # ---- route rows ----
+        go_right = B[jnp.arange(N), bf[pos]] > bb[pos]
+        pos = 2 * pos + go_right.astype(jnp.int32)
+
+    leaf_stats = jax.ops.segment_sum(S, pos, num_segments=2**depth)
+    return Tree(feature, split_bin, threshold, leaf_value=leaf_stats), pos
+
+
+@jax.jit
+def tree_apply(X, tree: Tree):
+    """Leaf index per row on RAW features (serving path, no binning needed)."""
+    N = X.shape[0]
+    depth = tree.leaf_value.shape[0].bit_length() - 1
+    node = jnp.zeros((N,), jnp.int32)  # global level-order node id
+    for _ in range(depth):
+        f = tree.feature[node]
+        thr = tree.threshold[node]
+        go_right = X[jnp.arange(N), f] > thr
+        node = 2 * node + 1 + go_right.astype(jnp.int32)
+    return node - (tree.leaf_value.shape[0] - 1)  # leaf index in [0, 2^D)
+
+
+def leaf_class_probs(leaf_stats):
+    """Per-leaf class distribution from one-hot count stats."""
+    tot = jnp.sum(leaf_stats, axis=-1, keepdims=True)
+    k = leaf_stats.shape[-1]
+    return jnp.where(tot > 0, leaf_stats / jnp.maximum(tot, EPS), 1.0 / k)
+
+
+def leaf_newton_values(leaf_stats, reg: float):
+    """-G/(H + reg) per leaf from [G, H, w] stats."""
+    G, H = leaf_stats[..., 0], leaf_stats[..., 1]
+    return -G / jnp.maximum(H + reg, EPS)

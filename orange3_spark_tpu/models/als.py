@@ -1,0 +1,225 @@
+"""ALS matrix factorization — parity with ``pyspark.ml.recommendation.ALS``.
+
+MLlib's ALS partitions users/items into blocks, shuffles rating blocks
+between executors each half-iteration, and solves per-entity normal equations
+with ALS-WR weighted regularization (SURVEY.md §2b row "ALS"; reconstructed,
+mount empty). TPU-native redesign:
+
+* ratings live as three row-sharded vectors (user_idx, item_idx, rating) —
+  COO, P('data') — never a dense matrix;
+* each half-step gathers the fixed side's factors for every rating, forms
+  per-rating outer products and ``segment_sum``s them into per-entity normal
+  equations A·x=b — XLA turns the segment reduction over the sharded row axis
+  into local scatter-adds plus one ICI all-reduce (MLlib's block shuffle,
+  collapsed into a collective);
+* the rating stream is processed in fixed-size chunks under ``lax.scan`` so
+  the [chunk, k, k] outer-product tensor stays HBM-resident at chunk size,
+  never [N, k, k];
+* all per-entity solves are one batched Cholesky (``jnp.linalg.solve`` on
+  [n_entities, k, k]) — MXU-batched, no per-user Python;
+* the full fit (both sides × max_iter) is a single jitted ``lax.scan``.
+
+Implicit feedback uses MLlib's confidence weighting c = 1 + alpha·r with the
+VᵀV precompute trick (one [k,k] Gramian + corrections only for observed
+entries).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models.base import Estimator, Model, Params
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSParams(Params):
+    rank: int = 10                 # MLlib rank
+    max_iter: int = 10             # MLlib maxIter
+    reg_param: float = 0.1         # MLlib regParam (ALS-WR: scaled by n_ratings)
+    implicit_prefs: bool = False   # MLlib implicitPrefs
+    alpha: float = 1.0             # MLlib alpha (implicit confidence)
+    nonnegative: bool = False      # MLlib nonnegative (NNLS) — not implemented
+    seed: int = 0                  # MLlib seed
+    user_col: str = "user"         # MLlib userCol
+    item_col: str = "item"         # MLlib itemCol
+    rating_col: str = "rating"     # MLlib ratingCol
+    cold_start_strategy: str = "nan"  # MLlib coldStartStrategy: 'nan' | 'drop'
+    chunk_size: int = 1 << 18      # ratings per scan chunk (HBM knob)
+
+
+def _solve_side(idx, other_idx, rating, w, other_factors, n_entities: int,
+                reg: float, implicit: bool, alpha: float, chunk: int):
+    """Normal-equation solve for one side given the other side's factors."""
+    k = other_factors.shape[1]
+    n = idx.shape[0]
+    n_chunks = max(1, -(-n // chunk))
+    pad = n_chunks * chunk - n
+    idx_p = jnp.pad(idx, (0, pad)).reshape(n_chunks, chunk)
+    oidx_p = jnp.pad(other_idx, (0, pad)).reshape(n_chunks, chunk)
+    r_p = jnp.pad(rating, (0, pad)).reshape(n_chunks, chunk)
+    w_p = jnp.pad(w, (0, pad)).reshape(n_chunks, chunk)  # 0 on padding
+
+    def body(carry, args):
+        A, b, cnt = carry
+        ci, coi, cr, cw = args
+        V = other_factors[coi]                       # [chunk, k] gather
+        if implicit:
+            # MLlib implicit: confidence c = 1 + alpha*|r| (negative feedback
+            # raises confidence too), preference p = 1 iff r > 0
+            conf = 1.0 + alpha * jnp.abs(cr)
+            pref = (cr > 0).astype(jnp.float32)
+            outer = jnp.einsum("ni,nj->nij", V, V) * ((conf - 1.0) * cw)[:, None, None]
+            rhs = V * (conf * pref * cw)[:, None]
+        else:
+            outer = jnp.einsum("ni,nj->nij", V, V) * cw[:, None, None]
+            rhs = V * (cr * cw)[:, None]
+        A = A + jax.ops.segment_sum(outer.reshape(chunk, k * k), ci,
+                                    num_segments=n_entities).reshape(n_entities, k, k)
+        b = b + jax.ops.segment_sum(rhs, ci, num_segments=n_entities)
+        cnt = cnt + jax.ops.segment_sum(cw, ci, num_segments=n_entities)
+        return (A, b, cnt), None
+
+    A0 = jnp.zeros((n_entities, k, k), jnp.float32)
+    b0 = jnp.zeros((n_entities, k), jnp.float32)
+    c0 = jnp.zeros((n_entities,), jnp.float32)
+    (A, b, cnt), _ = jax.lax.scan(body, (A0, b0, c0), (idx_p, oidx_p, r_p, w_p))
+
+    if implicit:
+        # global VᵀV base + per-entry corrections already in A
+        VtV = other_factors.T @ other_factors
+        A = A + VtV[None, :, :]
+        lam = reg  # implicit MLlib: plain lambda (no WR scaling)
+    else:
+        lam = reg  # multiplied by per-entity rating count below (ALS-WR)
+    eye = jnp.eye(k, dtype=jnp.float32)
+    reg_scale = cnt if not implicit else jnp.ones_like(cnt)
+    A = A + (lam * jnp.maximum(reg_scale, 1.0))[:, None, None] * eye
+    return jnp.linalg.solve(A, b[..., None])[..., 0]  # [n_entities, k]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_users", "n_items", "rank", "max_iter", "implicit", "chunk"),
+)
+def _als_fit(user_idx, item_idx, rating, w, *, n_users: int, n_items: int,
+             rank: int, max_iter: int, reg: float, implicit: bool,
+             alpha: float, chunk: int, seed: int = 0):
+    key_u, key_v = jax.random.split(jax.random.PRNGKey(seed))
+    # MLlib init: abs(normal)/sqrt(rank) keeps initial predictions positive
+    U = jnp.abs(jax.random.normal(key_u, (n_users, rank))) / jnp.sqrt(rank)
+    V = jnp.abs(jax.random.normal(key_v, (n_items, rank))) / jnp.sqrt(rank)
+
+    def one_iter(carry, _):
+        U, V = carry
+        U = _solve_side(user_idx, item_idx, rating, w, V, n_users,
+                        reg, implicit, alpha, chunk)
+        V = _solve_side(item_idx, user_idx, rating, w, U, n_items,
+                        reg, implicit, alpha, chunk)
+        return (U, V), None
+
+    (U, V), _ = jax.lax.scan(one_iter, (U, V), None, length=max_iter)
+    return U, V
+
+
+@jax.jit
+def _predict_pairs(U, V, user_idx, item_idx):
+    return jnp.sum(U[user_idx] * V[item_idx], axis=1)
+
+
+class ALSModel(Model):
+    def __init__(self, params, user_factors, item_factors):
+        self.params = params
+        self.user_factors = user_factors  # f32[n_users, k]
+        self.item_factors = item_factors  # f32[n_items, k]
+
+    @property
+    def state_pytree(self):
+        return {"user_factors": self.user_factors, "item_factors": self.item_factors}
+
+    def _cols(self, table: TpuTable):
+        p = self.params
+        u = table.column(p.user_col).astype(jnp.int32)
+        i = table.column(p.item_col).astype(jnp.int32)
+        return u, i
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        """Append 'prediction' (Spark: predicted rating per (user,item) row).
+
+        Cold-start rows (unseen user/item index) follow cold_start_strategy:
+        'nan' marks them NaN; 'drop' zero-weights them (static shapes — the
+        Spark row-drop equivalent under our filter semantics).
+        """
+        u, i = self._cols(table)
+        n_u = self.user_factors.shape[0]
+        n_i = self.item_factors.shape[0]
+        pred = _predict_pairs(self.user_factors, self.item_factors,
+                              jnp.clip(u, 0, n_u - 1), jnp.clip(i, 0, n_i - 1))
+        cold = (u < 0) | (u >= n_u) | (i < 0) | (i >= n_i)
+        W = table.W
+        if self.params.cold_start_strategy == "drop":
+            W = jnp.where(cold, 0.0, W)
+        else:
+            pred = jnp.where(cold, jnp.nan, pred)
+        new_domain = Domain(
+            list(table.domain.attributes) + [ContinuousVariable("prediction")],
+            table.domain.class_vars, table.domain.metas,
+        )
+        X = jnp.concatenate([table.X, pred[:, None]], axis=1)
+        out = table.with_X(X, new_domain)
+        return out.with_weights(W)
+
+    def recommend_for_all_users(self, num_items: int) -> np.ndarray:
+        """Top-N items per user: one U@Vᵀ MXU matmul + device top_k.
+
+        Returns int32 [n_users, num_items]. (MLlib recommendForAllUsers.)
+        """
+        scores = self.user_factors @ self.item_factors.T
+        _, top = jax.lax.top_k(scores, num_items)
+        return np.asarray(top)
+
+    def recommend_for_all_items(self, num_users: int) -> np.ndarray:
+        scores = self.item_factors @ self.user_factors.T
+        _, top = jax.lax.top_k(scores, num_users)
+        return np.asarray(top)
+
+
+class ALS(Estimator):
+    ParamsCls = ALSParams
+    params: ALSParams
+
+    def _fit(self, table: TpuTable) -> ALSModel:
+        p = self.params
+        if p.nonnegative:
+            raise NotImplementedError(
+                "nonnegative=True (NNLS solves) is not implemented yet"
+            )
+        u = table.column(p.user_col).astype(jnp.int32)
+        i = table.column(p.item_col).astype(jnp.int32)
+        r = table.column(p.rating_col)
+        n_users = int(np.asarray(jnp.max(jnp.where(table.W > 0, u, 0))).item()) + 1
+        n_items = int(np.asarray(jnp.max(jnp.where(table.W > 0, i, 0))).item()) + 1
+        U, V = _als_fit(
+            u, i, r, table.W,
+            n_users=n_users, n_items=n_items, rank=p.rank, max_iter=p.max_iter,
+            reg=p.reg_param, implicit=p.implicit_prefs, alpha=p.alpha,
+            chunk=min(p.chunk_size, table.n_pad), seed=p.seed,
+        )
+        return ALSModel(p, U, V)
+
+
+def ratings_table(ratings: np.ndarray, session=None, *,
+                  user_col="user", item_col="item", rating_col="rating") -> TpuTable:
+    """[n,3] (user, item, rating) float array -> ALS-ready TpuTable."""
+    domain = Domain([
+        ContinuousVariable(user_col),
+        ContinuousVariable(item_col),
+        ContinuousVariable(rating_col),
+    ])
+    return TpuTable.from_numpy(domain, ratings, session=session)

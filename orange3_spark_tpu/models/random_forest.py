@@ -1,0 +1,202 @@
+"""RandomForest — parity with ``pyspark.ml.classification.RandomForestClassifier``
+(and RandomForestRegressor).
+
+MLlib grows all trees together with distributed binned histograms
+(SURVEY.md §2b; reconstructed, mount empty). Here the ENTIRE forest fits as
+one XLA program: ``jax.vmap`` of the fixed-shape tree grower over a tree
+axis — per-tree Poisson bootstrap weights (the with-replacement resample in
+expectation) and per-(tree, level) Bernoulli feature masks (MLlib's
+featureSubsetStrategy, applied per level rather than per node) come from a
+split PRNG key, so T trees cost one fused device program, not T dispatches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models._tree import (
+    Tree,
+    bin_features,
+    compute_bin_edges,
+    grow_tree,
+    leaf_class_probs,
+    tree_apply,
+)
+from orange3_spark_tpu.models.base import Estimator, Model, Params
+
+
+def _subset_fraction(strategy: str, d: int, is_classification: bool) -> float:
+    if strategy == "auto":
+        strategy = "sqrt" if is_classification else "onethird"
+    return {
+        "all": 1.0,
+        "sqrt": np.sqrt(d) / d,
+        "log2": max(np.log2(max(d, 2)) / d, 1.0 / d),
+        "onethird": 1.0 / 3.0,
+    }[strategy]
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomForestParams(Params):
+    num_trees: int = 20            # MLlib numTrees
+    max_depth: int = 5             # MLlib maxDepth
+    max_bins: int = 32             # MLlib maxBins
+    min_instances_per_node: float = 1.0  # MLlib minInstancesPerNode
+    min_info_gain: float = 0.0     # MLlib minInfoGain
+    subsampling_rate: float = 1.0  # MLlib subsamplingRate (Poisson lambda)
+    feature_subset_strategy: str = "auto"  # MLlib featureSubsetStrategy
+    seed: int = 0                  # MLlib seed
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_trees", "depth", "n_bins", "k", "gain_mode",
+                     "min_instances"),
+)
+def _fit_forest(B, edges, Ystats, W, keep_p, min_gain, seed, *, num_trees: int,
+                depth: int, n_bins: int, k: int, gain_mode: str,
+                min_instances: float, subsample: float):
+    d = B.shape[1]
+    key = jax.random.PRNGKey(seed)
+
+    def fit_one(tkey):
+        kb, kf = jax.random.split(tkey)
+        boot = jax.random.poisson(kb, subsample, (B.shape[0],)).astype(jnp.float32)
+        w_t = W * boot
+        keep = jax.random.bernoulli(kf, keep_p, (depth, d)).astype(jnp.float32)
+        # never mask every feature of a level
+        keep = jnp.where(jnp.sum(keep, 1, keepdims=True) > 0, keep, 1.0)
+        S = Ystats * w_t[:, None]
+        tree, _ = grow_tree(
+            B, S, edges, keep, min_gain,
+            depth=depth, n_bins=n_bins, gain_mode=gain_mode,
+            min_instances=min_instances,
+        )
+        return tree
+
+    return jax.vmap(fit_one)(jax.random.split(key, num_trees))
+
+
+@jax.jit
+def _forest_probs(X, forest: Tree):
+    """Mean of per-tree leaf class distributions (MLlib probability vote)."""
+    leaves = jax.vmap(lambda t: tree_apply(X, t))(forest)          # [T, N]
+    probs = leaf_class_probs(forest.leaf_value)                    # [T, L, k]
+    per_tree = jnp.take_along_axis(probs, leaves[:, :, None], 1)   # [T, N, k]
+    return jnp.mean(per_tree, axis=0)
+
+
+class RandomForestClassifierModel(Model):
+    def __init__(self, params, forest: Tree, class_values):
+        self.params = params
+        self.forest = forest
+        self.class_values = tuple(class_values)
+
+    @property
+    def state_pytree(self):
+        return dict(self.forest._asdict())
+
+    def predict_proba(self, table: TpuTable) -> np.ndarray:
+        return np.asarray(_forest_probs(table.X, self.forest))[: table.n_rows]
+
+    def predict(self, table: TpuTable) -> np.ndarray:
+        probs = _forest_probs(table.X, self.forest)
+        return np.asarray(jnp.argmax(probs, 1).astype(jnp.float32))[: table.n_rows]
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        probs = _forest_probs(table.X, self.forest)
+        pred = jnp.argmax(probs, axis=1).astype(jnp.float32)
+        new_attrs = list(table.domain.attributes) + [
+            ContinuousVariable(f"probability_{c}") for c in self.class_values
+        ] + [DiscreteVariable("prediction", self.class_values)]
+        new_domain = Domain(new_attrs, table.domain.class_vars, table.domain.metas)
+        X = jnp.concatenate([table.X, probs, pred[:, None]], axis=1)
+        return table.with_X(X, new_domain)
+
+
+class RandomForestClassifier(Estimator):
+    ParamsCls = RandomForestParams
+    params: RandomForestParams
+
+    def _fit(self, table: TpuTable) -> RandomForestClassifierModel:
+        p = self.params
+        y = table.y
+        cvar = table.domain.class_var
+        class_values = (
+            cvar.values if isinstance(cvar, DiscreteVariable) and cvar.values
+            else tuple(str(i) for i in range(int(np.asarray(jnp.max(y)).item()) + 1))
+        )
+        k = len(class_values)
+        edges = compute_bin_edges(table.X, table.W, p.max_bins)
+        B = bin_features(table.X, edges)
+        Ystats = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=jnp.float32)
+        keep_p = _subset_fraction(p.feature_subset_strategy, table.n_attrs, True)
+        forest = _fit_forest(
+            B, edges, Ystats, table.W, keep_p,
+            jnp.float32(p.min_info_gain), p.seed,
+            num_trees=p.num_trees, depth=p.max_depth, n_bins=p.max_bins,
+            k=k, gain_mode="gini", min_instances=p.min_instances_per_node,
+            subsample=p.subsampling_rate,
+        )
+        return RandomForestClassifierModel(p, forest, class_values)
+
+
+# ---------------------------------------------------------------- regressor
+@jax.jit
+def _forest_means(X, forest: Tree):
+    leaves = jax.vmap(lambda t: tree_apply(X, t))(forest)          # [T, N]
+    s1 = forest.leaf_value[..., 0]
+    c = jnp.maximum(forest.leaf_value[..., 2], 1e-12)
+    means = s1 / c                                                  # [T, L]
+    per_tree = jnp.take_along_axis(means, leaves, axis=1)           # [T, N]
+    return jnp.mean(per_tree, axis=0)
+
+
+class RandomForestRegressorModel(Model):
+    def __init__(self, params, forest: Tree):
+        self.params = params
+        self.forest = forest
+
+    @property
+    def state_pytree(self):
+        return dict(self.forest._asdict())
+
+    def predict(self, table: TpuTable) -> np.ndarray:
+        return np.asarray(_forest_means(table.X, self.forest))[: table.n_rows]
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        yhat = _forest_means(table.X, self.forest)
+        new_domain = Domain(
+            list(table.domain.attributes) + [ContinuousVariable("prediction")],
+            table.domain.class_vars, table.domain.metas,
+        )
+        X = jnp.concatenate([table.X, yhat[:, None]], axis=1)
+        return table.with_X(X, new_domain)
+
+
+class RandomForestRegressor(Estimator):
+    ParamsCls = RandomForestParams
+    params: RandomForestParams
+
+    def _fit(self, table: TpuTable) -> RandomForestRegressorModel:
+        p = self.params
+        y = table.y
+        edges = compute_bin_edges(table.X, table.W, p.max_bins)
+        B = bin_features(table.X, edges)
+        Ystats = jnp.stack([y, y * y, jnp.ones_like(y)], axis=1)  # [Σwy,Σwy²,Σw]
+        keep_p = _subset_fraction(p.feature_subset_strategy, table.n_attrs, False)
+        forest = _fit_forest(
+            B, edges, Ystats, table.W, keep_p,
+            jnp.float32(p.min_info_gain), p.seed,
+            num_trees=p.num_trees, depth=p.max_depth, n_bins=p.max_bins,
+            k=3, gain_mode="variance", min_instances=p.min_instances_per_node,
+            subsample=p.subsampling_rate,
+        )
+        return RandomForestRegressorModel(p, forest)

@@ -1,0 +1,113 @@
+"""ALS tests: RMSE convergence on synthetic low-rank ratings (config 4 shape)."""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.datasets import make_ratings
+from orange3_spark_tpu.models.als import ALS, ratings_table
+from orange3_spark_tpu.models.evaluation import RegressionEvaluator
+
+
+def _fit_rmse(session, n_users=300, n_items=200, n_ratings=20000, rank=6,
+              fit_rank=6, max_iter=8, noise=0.05, implicit=False, seed=0):
+    ratings = make_ratings(n_users, n_items, n_ratings, rank=rank, seed=seed, noise=noise)
+    t = ratings_table(ratings, session)
+    est = ALS(rank=fit_rank, max_iter=max_iter, reg_param=0.01,
+              implicit_prefs=implicit, seed=1)
+    model = est.fit(t)
+    scored = model.transform(t)
+    rmse = RegressionEvaluator(metric_name="rmse", label_col="rating").evaluate(scored)
+    return model, rmse, ratings
+
+
+def test_als_recovers_low_rank_structure(session):
+    model, rmse, ratings = _fit_rmse(session)
+    # should fit down to near the noise floor (0.05), far below rating std
+    assert rmse < 0.1, f"rmse {rmse}"
+    assert rmse < np.std(ratings[:, 2]) / 3
+
+
+def test_als_more_iters_help(session):
+    _, rmse2, _ = _fit_rmse(session, max_iter=2)
+    _, rmse8, _ = _fit_rmse(session, max_iter=8)
+    assert rmse8 <= rmse2 + 1e-6
+
+
+def test_als_predictions_correlate(session):
+    model, _, ratings = _fit_rmse(session)
+    t = ratings_table(ratings, session)
+    pred = np.asarray(model.transform(t).column("prediction"))[: len(ratings)]
+    corr = np.corrcoef(pred, ratings[:, 2])[0, 1]
+    assert corr > 0.95
+
+
+def test_als_cold_start_nan_and_drop(session):
+    model, _, ratings = _fit_rmse(session, n_users=50, n_items=40, n_ratings=3000)
+    bad = ratings.copy()[:10]
+    bad[:, 0] = 9999  # unseen user
+    t = ratings_table(bad, session)
+    scored = model.transform(t)
+    pred = np.asarray(scored.column("prediction"))[:10]
+    assert np.all(np.isnan(pred))
+    model.params = model.params.replace(cold_start_strategy="drop")
+    scored2 = model.transform(t)
+    assert scored2.count() == 0  # all rows cold -> zero live rows
+
+
+def test_als_implicit_ranks_observed_higher(session):
+    rng = np.random.default_rng(3)
+    n_users, n_items = 60, 50
+    # implicit data: observed (u,i) pairs with confidence counts
+    obs = make_ratings(n_users, n_items, 4000, rank=4, seed=3, noise=0.0)
+    obs[:, 2] = np.abs(obs[:, 2]) * 3 + 0.5  # positive "counts"
+    t = ratings_table(obs, session)
+    model = ALS(rank=8, max_iter=5, reg_param=0.05, implicit_prefs=True, alpha=2.0).fit(t)
+    scores = np.asarray(model.user_factors @ model.item_factors.T)
+    observed_pairs = {(int(u), int(i)) for u, i in obs[:, :2]}
+    obs_scores = [scores[u, i] for (u, i) in list(observed_pairs)[:500]]
+    all_mean = scores.mean()
+    assert np.mean(obs_scores) > all_mean  # observed pairs score higher
+
+
+def test_als_recommend_topk(session):
+    model, _, ratings = _fit_rmse(session, n_users=40, n_items=30, n_ratings=2000)
+    top = model.recommend_for_all_users(5)
+    assert top.shape == (model.user_factors.shape[0], 5)
+    assert top.min() >= 0 and top.max() < model.item_factors.shape[0]
+    # top-1 item really is the argmax of that user's scores
+    scores = np.asarray(model.user_factors @ model.item_factors.T)
+    np.testing.assert_array_equal(top[:, 0], scores.argmax(axis=1))
+
+
+def test_als_nonnegative_not_silently_ignored(session):
+    ratings = make_ratings(20, 20, 200, seed=5)
+    t = ratings_table(ratings, session)
+    with pytest.raises(NotImplementedError):
+        ALS(nonnegative=True).fit(t)
+
+
+def test_als_respects_filter(session):
+    """Zero-weight ratings must not influence the factors."""
+    import jax.numpy as jnp
+
+    ratings = make_ratings(50, 40, 3000, rank=4, seed=6, noise=0.02)
+    corrupt = ratings.copy()
+    corrupt[2000:, 2] = 100.0  # absurd ratings, filtered below
+    t = ratings_table(corrupt, session)
+    filtered = t.filter(jnp.arange(t.n_pad) < 2000)
+    model = ALS(rank=4, max_iter=6, reg_param=0.01, seed=1).fit(filtered)
+    clean = ratings_table(ratings[:2000], session)
+    scored = model.transform(clean)
+    rmse = RegressionEvaluator(metric_name="rmse", label_col="rating").evaluate(scored)
+    assert rmse < 0.2, f"corrupt filtered rows leaked: rmse {rmse}"
+
+
+def test_als_implicit_negative_feedback_stays_finite(session):
+    """MLlib implicit semantics: c = 1 + alpha*|r|, preference = (r > 0)."""
+    ratings = make_ratings(40, 30, 1500, rank=4, seed=7, noise=0.0)
+    ratings[::3, 2] = -3.0  # negative feedback
+    t = ratings_table(ratings, session)
+    model = ALS(rank=4, max_iter=4, implicit_prefs=True, alpha=1.0).fit(t)
+    U = np.asarray(model.user_factors)
+    V = np.asarray(model.item_factors)
+    assert np.isfinite(U).all() and np.isfinite(V).all()

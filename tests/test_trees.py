@@ -1,0 +1,153 @@
+"""RandomForest / GBT tests vs sklearn (BASELINE config 3 shape: HIGGS-style)."""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.datasets import load_iris, make_classification
+from orange3_spark_tpu.models.gbt import GBTClassifier, GBTRegressor
+from orange3_spark_tpu.models.random_forest import (
+    RandomForestClassifier,
+    RandomForestRegressor,
+)
+
+
+def _nonlinear_binary(session, n=2000, seed=0):
+    """XOR-ish data no linear model can fit — trees must."""
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, 6)).astype(np.float32)
+    y = ((X[:, 0] * X[:, 1] > 0) ^ (X[:, 2] > 0.5)).astype(np.float32)
+    t = TpuTable.from_arrays(X, y, class_values=("0", "1"), session=session)
+    return t, X, y
+
+
+def test_rf_fits_nonlinear(session):
+    t, X, y = _nonlinear_binary(session)
+    model = RandomForestClassifier(num_trees=20, max_depth=6, seed=0).fit(t)
+    acc = np.mean(model.predict(t) == y)
+    assert acc > 0.9, acc
+
+
+def test_rf_close_to_sklearn(session):
+    t, X, y = _nonlinear_binary(session, n=1500, seed=1)
+    model = RandomForestClassifier(num_trees=30, max_depth=7, seed=0).fit(t)
+    acc = np.mean(model.predict(t) == y)
+
+    from sklearn.ensemble import RandomForestClassifier as SkRF
+
+    sk = SkRF(n_estimators=30, max_depth=7, random_state=0).fit(X, y)
+    sk_acc = sk.score(X, y)
+    assert acc >= sk_acc - 0.07, f"ours {acc} vs sklearn {sk_acc}"
+
+
+def test_rf_multiclass_iris(session, iris):
+    model = RandomForestClassifier(num_trees=20, max_depth=5, seed=0).fit(iris)
+    y = iris.to_numpy()[1][:, 0]
+    acc = np.mean(model.predict(iris) == y)
+    assert acc > 0.95
+    probs = model.predict_proba(iris)
+    np.testing.assert_allclose(probs.sum(1), 1.0, atol=1e-5)
+
+
+def test_rf_transform_appends_columns(session, iris):
+    out = RandomForestClassifier(num_trees=5, max_depth=3).fit(iris).transform(iris)
+    names = [v.name for v in out.domain.attributes]
+    assert "prediction" in names and "probability_setosa" in names
+
+
+def test_rf_respects_filter(session):
+    t, X, y = _nonlinear_binary(session, n=1000, seed=2)
+    ycorrupt = y.copy()
+    ycorrupt[500:] = 1 - ycorrupt[500:]
+    t2 = TpuTable.from_arrays(X, ycorrupt, class_values=("0", "1"), session=session)
+    import jax.numpy as jnp
+
+    filtered = t2.filter(jnp.arange(t2.n_pad) < 500)
+    model = RandomForestClassifier(num_trees=10, max_depth=6, seed=0).fit(filtered)
+    acc_clean_half = np.mean(model.predict(t2)[:500] == y[:500])
+    assert acc_clean_half > 0.85  # corrupt (filtered) half did not poison trees
+
+
+def test_rf_regressor(session):
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((1500, 5)).astype(np.float32)
+    y = (np.sin(X[:, 0]) + X[:, 1] ** 2).astype(np.float32)
+    t = TpuTable.from_arrays(X, y, session=session)
+    model = RandomForestRegressor(num_trees=20, max_depth=7, seed=0).fit(t)
+    pred = model.predict(t)
+    r2 = 1 - np.sum((pred - y) ** 2) / np.sum((y - y.mean()) ** 2)
+    assert r2 > 0.8, r2
+
+
+def test_gbt_fits_nonlinear(session):
+    t, X, y = _nonlinear_binary(session, n=2000, seed=4)
+    model = GBTClassifier(max_iter=30, max_depth=5, step_size=0.3).fit(t)
+    acc = np.mean(model.predict(t) == y)
+    assert acc > 0.93, acc
+
+
+def test_gbt_close_to_sklearn(session):
+    t, X, y = _nonlinear_binary(session, n=1500, seed=5)
+    model = GBTClassifier(max_iter=30, max_depth=4, step_size=0.3).fit(t)
+    acc = np.mean(model.predict(t) == y)
+
+    from sklearn.ensemble import GradientBoostingClassifier as SkGBT
+
+    sk = SkGBT(n_estimators=30, max_depth=4, learning_rate=0.3, random_state=0).fit(X, y)
+    assert acc >= sk.score(X, y) - 0.05, f"ours {acc} vs sklearn {sk.score(X, y)}"
+
+
+def test_gbt_rejects_multiclass(session, iris):
+    with pytest.raises(ValueError, match="binary"):
+        GBTClassifier().fit(iris)
+
+
+def test_gbt_probabilities_monotone_in_margin(session):
+    t, X, y = _nonlinear_binary(session, n=500, seed=6)
+    model = GBTClassifier(max_iter=10, max_depth=4).fit(t)
+    proba = model.predict_proba(t)
+    np.testing.assert_allclose(proba.sum(1), 1.0, atol=1e-5)
+    assert ((proba[:, 1] > 0.5) == (model.predict(t) == 1)).all()
+
+
+def test_gbt_regressor(session):
+    rng = np.random.default_rng(7)
+    X = rng.standard_normal((1200, 4)).astype(np.float32)
+    y = (X[:, 0] ** 2 + np.abs(X[:, 1])).astype(np.float32)
+    t = TpuTable.from_arrays(X, y, session=session)
+    model = GBTRegressor(max_iter=40, max_depth=4, step_size=0.3).fit(t)
+    pred = model.predict(t)
+    r2 = 1 - np.sum((pred - y) ** 2) / np.sum((y - y.mean()) ** 2)
+    assert r2 > 0.85, r2
+
+
+def test_gbt_more_rounds_reduce_training_error(session):
+    t, X, y = _nonlinear_binary(session, n=800, seed=8)
+    few = GBTClassifier(max_iter=3, max_depth=4, step_size=0.3).fit(t)
+    many = GBTClassifier(max_iter=25, max_depth=4, step_size=0.3).fit(t)
+    assert np.mean(many.predict(t) == y) >= np.mean(few.predict(t) == y)
+
+
+def test_min_info_gain_is_normalized(session):
+    """MLlib minInfoGain thresholds the per-weight gain: a modest normalized
+    threshold must actually prune on large-count nodes."""
+    t, X, y = _nonlinear_binary(session, n=2000, seed=9)
+    free = RandomForestClassifier(num_trees=1, max_depth=6, seed=0,
+                                  feature_subset_strategy="all").fit(t)
+    pruned = RandomForestClassifier(num_trees=1, max_depth=6, seed=0,
+                                    feature_subset_strategy="all",
+                                    min_info_gain=0.2).fit(t)
+    n_splits_free = int(np.sum(np.asarray(free.forest.split_bin) < free.params.max_bins))
+    n_splits_pruned = int(np.sum(np.asarray(pruned.forest.split_bin) < pruned.params.max_bins))
+    assert n_splits_pruned < n_splits_free
+
+
+def test_gbt_round_jit_cache_shared_across_fits(session):
+    """Second fit with identical shapes+params must not retrace."""
+    from orange3_spark_tpu.models.gbt import _gbt_round
+
+    t, X, y = _nonlinear_binary(session, n=400, seed=10)
+    GBTClassifier(max_iter=3, max_depth=3).fit(t)
+    misses_after_first = _gbt_round._cache_size()
+    GBTClassifier(max_iter=3, max_depth=3).fit(t)
+    assert _gbt_round._cache_size() == misses_after_first
