@@ -59,10 +59,25 @@ class Model(Transformer):
     """A fitted model: hyper-params + a pytree of device arrays.
 
     Subclasses set ``self.params`` and expose fitted state through
-    ``state_pytree`` for checkpointing (utils/checkpoint.py).
+    ``state_pytree`` for checkpointing (utils/checkpoint.py). Pickling
+    converts every jax array (including ones nested in pytrees like tree
+    ensembles) to numpy so checkpoints are host-portable; jnp ops re-promote
+    them lazily on first use after load.
     """
 
     params: Params
+
+    def __getstate__(self):
+        return jax.tree.map(
+            lambda x: np.asarray(x) if isinstance(x, jax.Array) else x,
+            dict(self.__dict__),
+            is_leaf=lambda x: isinstance(x, jax.Array) or not isinstance(
+                x, (dict, list, tuple)
+            ),
+        )
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
 
     @property
     def state_pytree(self) -> dict[str, Any]:

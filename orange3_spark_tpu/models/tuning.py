@@ -1,0 +1,154 @@
+"""Model selection — ``pyspark.ml.tuning`` parity (ParamGridBuilder,
+CrossValidator, TrainValidationSplit).
+
+Folds are weight masks (static shapes: every fold sees the same padded
+arrays, train/val membership is carried in W), so one XLA program shape
+serves all folds — no per-fold recompilation, the TPU analogue of Spark's
+per-fold DataFrame filters. (SURVEY.md §2b; reconstructed, mount empty.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models.base import Estimator, Model, Params
+
+
+class ParamGridBuilder:
+    """pyspark.ml.tuning.ParamGridBuilder: cartesian grid over param names."""
+
+    def __init__(self):
+        self._grid: dict[str, Sequence[Any]] = {}
+
+    def add_grid(self, name: str, values: Sequence[Any]) -> "ParamGridBuilder":
+        self._grid[name] = list(values)
+        return self
+
+    def build(self) -> list[dict[str, Any]]:
+        import itertools
+
+        names = list(self._grid)
+        combos = itertools.product(*(self._grid[n] for n in names))
+        return [dict(zip(names, c)) for c in combos]
+
+
+def _with_params(estimator: Estimator, point: dict[str, Any]) -> Estimator:
+    """Clone an estimator with grid-point params applied.
+
+    Shallow-copies the instance (preserving constructor extras like Pipeline
+    stages) and swaps the frozen params; unknown param names raise from
+    dataclasses.replace with a clear message.
+    """
+    import copy
+
+    clone = copy.copy(estimator)
+    clone.params = estimator.params.replace(**point) if point else estimator.params
+    return clone
+
+
+def _metric_larger_better(evaluator) -> bool:
+    metric = getattr(evaluator.params, "metric_name", "") or getattr(
+        evaluator, "default_metric", ""
+    )
+    return metric not in ("rmse", "mse", "mae")
+
+
+@dataclasses.dataclass(frozen=True)
+class CrossValidatorParams(Params):
+    num_folds: int = 3   # MLlib numFolds
+    seed: int = 0
+    parallel_folds: bool = True  # reserved (folds already share one program)
+
+
+class CrossValidatorModel(Model):
+    def __init__(self, params, best_model: Model, best_params: dict,
+                 avg_metrics: list[float]):
+        self.params = params
+        self.best_model = best_model
+        self.best_params = best_params
+        self.avg_metrics = avg_metrics  # one per grid point (MLlib avgMetrics)
+
+    @property
+    def state_pytree(self):
+        return self.best_model.state_pytree
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        return self.best_model.transform(table)
+
+
+class CrossValidator(Estimator):
+    """estimator + param grid + evaluator -> best refit model (MLlib CV)."""
+
+    ParamsCls = CrossValidatorParams
+
+    def __init__(self, estimator: Estimator, param_grid: list[dict],
+                 evaluator, num_folds: int = 3, seed: int = 0):
+        super().__init__(CrossValidatorParams(num_folds=num_folds, seed=seed))
+        self.estimator = estimator
+        self.param_grid = param_grid or [{}]
+        self.evaluator = evaluator
+
+    def _fold_masks(self, table: TpuTable):
+        p = self.params
+        fold_of = jax.random.randint(
+            jax.random.PRNGKey(p.seed), (table.n_pad,), 0, p.num_folds
+        )
+        return fold_of
+
+    def _fit(self, table: TpuTable) -> CrossValidatorModel:
+        p = self.params
+        fold_of = self._fold_masks(table)
+        larger_better = _metric_larger_better(self.evaluator)
+        avg_metrics: list[float] = []
+        for point in self.param_grid:
+            est = _with_params(self.estimator, point)
+            scores = []
+            for f in range(p.num_folds):
+                train = table.with_weights(jnp.where(fold_of != f, table.W, 0.0))
+                val = table.with_weights(jnp.where(fold_of == f, table.W, 0.0))
+                model = est.fit(train)
+                scores.append(self.evaluator.evaluate(model.transform(val)))
+            avg_metrics.append(float(np.mean(scores)))
+        best_i = int(np.argmax(avg_metrics) if larger_better else np.argmin(avg_metrics))
+        best_params = self.param_grid[best_i]
+        best_model = _with_params(self.estimator, best_params).fit(table)
+        # ^ refit on ALL data (MLlib behavior)
+        return CrossValidatorModel(p, best_model, best_params, avg_metrics)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainValidationSplitParams(Params):
+    train_ratio: float = 0.75  # MLlib trainRatio
+    seed: int = 0
+
+
+class TrainValidationSplit(Estimator):
+    ParamsCls = TrainValidationSplitParams
+
+    def __init__(self, estimator: Estimator, param_grid: list[dict],
+                 evaluator, train_ratio: float = 0.75, seed: int = 0):
+        super().__init__(TrainValidationSplitParams(train_ratio=train_ratio, seed=seed))
+        self.estimator = estimator
+        self.param_grid = param_grid or [{}]
+        self.evaluator = evaluator
+
+    def _fit(self, table: TpuTable) -> CrossValidatorModel:
+        from orange3_spark_tpu.ops.relational import train_test_split
+
+        p = self.params
+        train, val = train_test_split(table, 1.0 - p.train_ratio, p.seed)
+        larger_better = _metric_larger_better(self.evaluator)
+        metrics = []
+        for point in self.param_grid:
+            model = _with_params(self.estimator, point).fit(train)
+            metrics.append(float(self.evaluator.evaluate(model.transform(val))))
+        best_i = int(np.argmax(metrics) if larger_better else np.argmin(metrics))
+        best_params = self.param_grid[best_i]
+        best_model = _with_params(self.estimator, best_params).fit(table)
+        return CrossValidatorModel(p, best_model, best_params, metrics)

@@ -1,0 +1,247 @@
+"""Relational DataFrame ops — the ``pyspark.sql`` wrangling subset.
+
+The reference's widgets expose Spark DataFrame data wrangling: groupBy-agg,
+joins, sort, sample, union, distinct counts (SURVEY.md §2b row "Distributed
+dataframe"; reconstructed, mount empty). TPU-native redesign under the
+static-shape rule:
+
+* ``group_by``: keys must be discrete (known category count k) → the result
+  is a FIXED k-row table computed with ``segment_sum``-style one-hot matmuls
+  over the sharded rows — the shuffle becomes one ICI all-reduce;
+* ``join``: dimension-table join (right side keyed by a discrete column with
+  unique keys) → output keeps the LEFT shape, right columns arrive via a
+  device gather. Many-to-many joins are data-dependent-shape by nature and
+  deliberately unsupported on device (documented; compose at the host
+  boundary if truly needed);
+* ``sort``/``sample``/``union``: one device argsort / bernoulli weight mask /
+  host re-concat respectively.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.ops.stats import EPS_TOTAL_WEIGHT
+
+AGG_FNS = ("sum", "mean", "count", "min", "max")
+
+
+def group_by(table: TpuTable, key: str, aggs: dict[str, str]) -> TpuTable:
+    """df.groupBy(key).agg({col: fn}) with discrete key → k-row table.
+
+    Output columns: the key (as its category index) + one column per (col, fn)
+    named ``fn_col``; rows ordered by category index. Groups with no live rows
+    get count 0 and NaN for mean/min/max (Spark: such groups are absent; a
+    fixed-shape table keeps them with null-like stats instead).
+    """
+    kvar = table.domain[key]
+    if not isinstance(kvar, DiscreteVariable) or not kvar.values:
+        raise ValueError(
+            f"group_by key {key!r} must be a DiscreteVariable with known values"
+        )
+    k = len(kvar.values)
+    key_idx = table.column(key).astype(jnp.int32)
+    for col, fn in aggs.items():
+        if fn not in AGG_FNS:
+            raise ValueError(f"unknown agg {fn!r}; supported: {AGG_FNS}")
+        table.domain[col]  # raises KeyError on unknown column
+
+    cols = {col: table.column(col) for col in aggs}
+    out = _group_kernel(
+        key_idx, table.W,
+        jnp.stack(list(cols.values()), 1) if cols else jnp.zeros((table.n_pad, 0)),
+        k,
+    )
+    counts, sums, mins, maxs = out
+    counts_np = np.asarray(counts)
+
+    # the key keeps its discrete identity (values included) so the result can
+    # feed joins / value_counts / one-hot downstream
+    new_attrs: list = [DiscreteVariable(key, kvar.values)]
+    data = [np.arange(k, dtype=np.float32)]
+    for j, (col, fn) in enumerate(aggs.items()):
+        new_attrs.append(ContinuousVariable(f"{fn}_{col}"))
+        if fn == "count":
+            data.append(counts_np)
+        elif fn == "sum":
+            data.append(np.asarray(sums[:, j]))
+        elif fn == "mean":
+            data.append(np.where(
+                counts_np > 0,
+                np.asarray(sums[:, j]) / np.maximum(counts_np, EPS_TOTAL_WEIGHT),
+                np.nan,
+            ))
+        elif fn == "min":
+            data.append(np.where(counts_np > 0, np.asarray(mins[:, j]), np.nan))
+        elif fn == "max":
+            data.append(np.where(counts_np > 0, np.asarray(maxs[:, j]), np.nan))
+    X = np.stack(data, axis=1).astype(np.float32)
+    return TpuTable.from_numpy(Domain(new_attrs), X, session=table.session)
+
+
+from functools import partial  # noqa: E402
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _group_kernel(key_idx, W, V, k: int):
+    """Per-group (count, sum, min, max) for every value column, one pass.
+
+    The count/sum path is a one-hot matmul [N,k]ᵀ@[N,c] — MXU work whose
+    row-axis contraction GSPMD all-reduces (the groupBy shuffle, collapsed).
+    """
+    onehot = jax.nn.one_hot(key_idx, k, dtype=jnp.float32) * W[:, None]  # [N,k]
+    counts = jnp.sum(onehot, axis=0)
+    sums = onehot.T @ V
+    live = (W > 0)[:, None]
+    big = jnp.float32(np.finfo(np.float32).max)
+    # min/max per group via masked segment reductions
+    mins = jax.ops.segment_min(
+        jnp.where(live, V, big), key_idx, num_segments=k
+    )
+    maxs = jax.ops.segment_max(
+        jnp.where(live, V, -big), key_idx, num_segments=k
+    )
+    return counts, sums, mins, maxs
+
+
+def join(left: TpuTable, right: TpuTable, on: str, how: str = "left") -> TpuTable:
+    """Dimension-table join: right side keyed uniquely by discrete column `on`.
+
+    Keeps the left table's (static) shape; right's other attribute columns are
+    gathered per left row. how='left': unmatched keys get NaN; how='inner':
+    unmatched rows are weight-zeroed (the static-shape row drop).
+    """
+    if how not in ("left", "inner"):
+        raise ValueError("how must be 'left' or 'inner'")
+    kvar = left.domain[on]
+    rvar = right.domain[on]
+    if not isinstance(kvar, DiscreteVariable) or not isinstance(rvar, DiscreteVariable):
+        raise ValueError(f"join key {on!r} must be discrete on both sides")
+
+    rX, _, rW = right.to_numpy()
+    r_key_col = [v.name for v in right.domain.attributes].index(on)
+    r_keys = rX[:, r_key_col].astype(np.int64)
+    live = rW > 0
+    r_keys = r_keys[live]
+    if len(np.unique(r_keys)) != len(r_keys):
+        raise ValueError(
+            "right side has duplicate keys; only unique-key (dimension-table) "
+            "joins are supported on device — aggregate the right side first"
+        )
+    # category-index remap if the two sides enumerate values differently
+    remap = {v: i for i, v in enumerate(rvar.values)}
+    key_lut = np.full((len(kvar.values),), -1, dtype=np.int64)
+    for i, v in enumerate(kvar.values):
+        if v in remap:
+            key_lut[i] = remap[v]
+
+    other_cols = [
+        j for j, v in enumerate(right.domain.attributes) if v.name != on
+    ]
+    left_names = {v.name for v in left.domain.variables}
+    clashes = [right.domain.attributes[j].name for j in other_cols
+               if right.domain.attributes[j].name in left_names]
+    if clashes:
+        raise ValueError(
+            f"join would duplicate column names {clashes}; rename the right "
+            "side's columns first (Spark would defer this to an ambiguity "
+            "error at first use — we fail at the join)"
+        )
+    n_right = int(np.max(r_keys)) + 1 if len(r_keys) else 1
+    lut = np.full((n_right + 1, len(other_cols)), np.nan, dtype=np.float32)
+    matched = np.zeros((n_right + 1,), dtype=np.float32)
+    lut[r_keys] = rX[live][:, other_cols]
+    matched[r_keys] = 1.0
+
+    left_key = left.column(on).astype(jnp.int32)
+    mapped = jnp.asarray(key_lut)[jnp.clip(left_key, 0, len(key_lut) - 1)]
+    safe = jnp.clip(mapped, 0, n_right)  # -1 (no match) -> slot 0? guard below
+    gathered = jnp.asarray(lut)[jnp.where(mapped < 0, n_right, safe)]
+    hit = jnp.asarray(matched)[jnp.where(mapped < 0, n_right, safe)]
+
+    new_attrs = list(left.domain.attributes) + [
+        ContinuousVariable(right.domain.attributes[j].name) for j in other_cols
+    ]
+    X = jnp.concatenate([left.X, gathered], axis=1)
+    W = left.W
+    if how == "inner":
+        W = jnp.where(hit > 0, W, 0.0)
+    out = TpuTable(
+        Domain(new_attrs, left.domain.class_vars, left.domain.metas),
+        X, left.Y, W, left.metas, left.n_rows, left.session,
+    )
+    return out
+
+
+def sort(table: TpuTable, by: str, ascending: bool = True) -> TpuTable:
+    """Full device sort of all rows by one column (df.orderBy).
+
+    Filtered/padding rows sort to the end regardless of value.
+    """
+    key = table.column(by)
+    big = jnp.float32(np.finfo(np.float32).max)
+    key = jnp.where(table.W > 0, key if ascending else -key, big)
+    order = jnp.argsort(key)
+    X = table.X[order]
+    Y = table.Y[order] if table.Y is not None else None
+    W = table.W[order]
+    metas = None
+    if table.metas is not None:
+        ho = np.asarray(jax.device_get(order))
+        ho = ho[ho < len(table.metas)]
+        metas = table.metas[ho]
+    return TpuTable(table.domain, X, Y, W, metas, table.n_rows, table.session)
+
+
+def sample(table: TpuTable, fraction: float, seed: int = 0) -> TpuTable:
+    """df.sample(fraction): bernoulli row mask folded into weights."""
+    keep = jax.random.bernoulli(
+        jax.random.PRNGKey(seed), fraction, (table.n_pad,)
+    )
+    return table.with_weights(jnp.where(keep, table.W, 0.0))
+
+
+def union(a: TpuTable, b: TpuTable) -> TpuTable:
+    """df.union: host re-concat (a repartition boundary, like Spark's)."""
+    if a.domain != b.domain:
+        raise ValueError("union requires identical domains")
+    Xa, Ya, Wa = a.to_numpy()
+    Xb, Yb, Wb = b.to_numpy()
+    metas = None
+    if a.metas is not None and b.metas is not None:
+        metas = np.concatenate([a.metas, b.metas], axis=0)
+    return TpuTable.from_numpy(
+        a.domain,
+        np.concatenate([Xa, Xb], 0),
+        np.concatenate([Ya, Yb], 0) if Ya is not None else None,
+        metas,
+        np.concatenate([Wa, Wb], 0),
+        a.session,
+    )
+
+
+def value_counts(table: TpuTable, col: str) -> dict[str, float]:
+    """Weighted category counts for one discrete column (df.groupBy.count)."""
+    var = table.domain[col]
+    if not isinstance(var, DiscreteVariable):
+        raise ValueError(f"{col!r} is not discrete")
+    k = len(var.values)
+    idx = table.column(col).astype(jnp.int32)
+    onehot = jax.nn.one_hot(idx, k, dtype=jnp.float32) * table.W[:, None]
+    counts = np.asarray(jnp.sum(onehot, axis=0))
+    return {v: float(c) for v, c in zip(var.values, counts)}
+
+
+def train_test_split(table: TpuTable, test_fraction: float = 0.25, seed: int = 0):
+    """df.randomSplit([1-f, f]) — weight-mask complementary split."""
+    keep = jax.random.bernoulli(
+        jax.random.PRNGKey(seed), 1.0 - test_fraction, (table.n_pad,)
+    )
+    return (
+        table.with_weights(jnp.where(keep, table.W, 0.0)),
+        table.with_weights(jnp.where(keep, 0.0, table.W)),
+    )

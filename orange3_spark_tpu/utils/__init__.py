@@ -1,0 +1,4 @@
+from orange3_spark_tpu.utils.checkpoint import load_model, save_model
+from orange3_spark_tpu.utils.profiling import debug_unjitted, profile_trace, timed
+
+__all__ = ["load_model", "save_model", "debug_unjitted", "profile_trace", "timed"]

@@ -1,0 +1,65 @@
+"""Checkpoint / resume — MLlib model save-load + fault tolerance.
+
+The reference's fault story is Spark lineage recompute plus MLlib
+``model.save/load`` (SURVEY.md §5 "Failure/elastic" + "Checkpoint/resume";
+reconstructed, mount empty). TPU-native story: fitted models are pytrees of
+device arrays — serialize them host-side (numpy) with params/metadata, and
+recovery = reload + resume, no lineage. A fitted WORKFLOW checkpoints as its
+.ows-equivalent JSON plus each fitted node's model payload; restoring
+reattaches the fitted models so ``run()`` serves without refitting —
+the kill-and-resume drill in tests/test_checkpoint.py is the fault-injection
+test SURVEY §5 calls for.
+
+Format: a directory with ``meta.pkl`` (pickle of the model object whose jax
+arrays were converted to numpy — Model.__getstate__ guarantees that).
+Orbax is available in the image for sharded multi-host checkpoints of very
+large states; these tabular-ML states are small (coefs, centers, trees), so
+plain pickle keeps zero moving parts.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+from orange3_spark_tpu.models.base import Model
+from orange3_spark_tpu.workflow.graph import WorkflowGraph
+
+MODEL_FILE = "model.pkl"
+WORKFLOW_FILE = "workflow.json"
+
+
+def save_model(model: Model, path: str) -> None:
+    """Persist a fitted model (MLlib model.save equivalent)."""
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, MODEL_FILE), "wb") as f:
+        pickle.dump(model, f)
+
+
+def load_model(path: str) -> Model:
+    """Reload a fitted model (MLlib Model.load equivalent)."""
+    with open(os.path.join(path, MODEL_FILE), "rb") as f:
+        return pickle.load(f)
+
+
+def save_workflow(graph: WorkflowGraph, path: str) -> None:
+    """Checkpoint a RUN workflow: spec JSON + every fitted node model."""
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, WORKFLOW_FILE), "w") as f:
+        f.write(graph.to_json())
+    for nid, node in graph.nodes.items():
+        model = (node.outputs or {}).get("model")
+        if isinstance(model, Model):
+            save_model(model, os.path.join(path, f"node{nid}"))
+
+
+def load_workflow(path: str) -> WorkflowGraph:
+    """Restore a checkpointed workflow: estimator nodes get their fitted
+    models back and will SERVE (not refit) on the next run()."""
+    with open(os.path.join(path, WORKFLOW_FILE)) as f:
+        graph = WorkflowGraph.from_json(f.read())
+    for nid, node in graph.nodes.items():
+        mdir = os.path.join(path, f"node{nid}")
+        if os.path.isdir(mdir):
+            node.widget.fitted_model = load_model(mdir)
+    return graph

@@ -1,0 +1,61 @@
+"""Tracing / profiling / debug hooks (SURVEY §5 auxiliary subsystems).
+
+* ``profile_trace(dir)`` — wraps ``jax.profiler.trace``: the Spark-UI
+  replacement; open the dump in TensorBoard/XProf to see per-op device time.
+* ``timed`` — structured per-call wall-clock logging (the per-widget logging
+  the reference gets from Spark event logs).
+* ``debug_unjitted()`` — run any workflow eagerly op-by-op with jit disabled:
+  the "debug mode running the whole graph un-jitted" SURVEY §5 calls for
+  (XLA is deterministic, so this replaces a race detector: divergence between
+  jitted and unjitted runs localizes compiler-boundary bugs).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from functools import wraps
+
+import jax
+
+log = logging.getLogger("orange3_spark_tpu")
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """Device+host profile into log_dir (view with TensorBoard's profile tab)."""
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+@contextlib.contextmanager
+def debug_unjitted():
+    """Execute everything op-by-op (no XLA staging) for debugging."""
+    with jax.disable_jit():
+        yield
+
+
+def timed(fn=None, *, name: str | None = None):
+    """Decorator: log wall-clock (+ rows/sec when the first arg is a table)."""
+
+    def deco(f):
+        label = name or f.__qualname__
+
+        @wraps(f)
+        def wrapper(*args, **kwargs):
+            t0 = time.perf_counter()
+            out = f(*args, **kwargs)
+            dt = time.perf_counter() - t0
+            extra = ""
+            for a in args:
+                n = getattr(a, "n_rows", None)
+                if isinstance(n, int):
+                    extra = f" ({n / max(dt, 1e-9):,.0f} rows/s)"
+                    break
+            log.info("%s: %.3fs%s", label, dt, extra)
+            return out
+
+        return wrapper
+
+    return deco(fn) if fn is not None else deco

@@ -100,7 +100,12 @@ class WorkflowGraph:
 
     def invalidate(self, node_id: int) -> None:
         """Mark a node and everything downstream dirty (signal change)."""
-        self.nodes[node_id].outputs = None
+        node = self.nodes[node_id]
+        node.outputs = None
+        if getattr(node.widget, "fitted_model", None) is not None:
+            # a checkpoint-restored model is stale once ANY upstream signal
+            # changes — it must refit on the new inputs, not serve blindly
+            node.widget.fitted_model = None
         for e in self.edges:
             if e.src == node_id and self.nodes[e.dst].outputs is not None:
                 self.invalidate(e.dst)
@@ -109,7 +114,7 @@ class WorkflowGraph:
         """Change a widget's settings — refires it and downstream on next run."""
         w = self.nodes[node_id].widget
         w.params = w.params.replace(**kwargs)
-        self.invalidate(node_id)
+        self.invalidate(node_id)  # also clears any checkpoint-restored model
 
     def run(self, verbose: bool = False) -> dict[int, dict[str, Any]]:
         """Fire dirty widgets in topological order; return all node outputs."""

@@ -1,0 +1,156 @@
+"""Relational ops (groupBy/join/sort/sample/union) vs pandas semantics."""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.ops.relational import (
+    group_by,
+    join,
+    sample,
+    sort,
+    train_test_split,
+    union,
+    value_counts,
+)
+
+
+def _sales_table(session, n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    region = rng.integers(0, 3, n).astype(np.float32)
+    amount = rng.gamma(2.0, 10.0, n).astype(np.float32)
+    qty = rng.integers(1, 9, n).astype(np.float32)
+    dom = Domain([
+        DiscreteVariable("region", ("east", "west", "north")),
+        ContinuousVariable("amount"),
+        ContinuousVariable("qty"),
+    ])
+    X = np.stack([region, amount, qty], 1)
+    return TpuTable.from_numpy(dom, X, session=session), region, amount, qty
+
+
+def test_group_by_matches_pandas(session):
+    t, region, amount, qty = _sales_table(session)
+    out = group_by(t, "region", {"amount": "sum", "qty": "mean"})
+    import pandas as pd
+
+    df = pd.DataFrame({"region": region, "amount": amount, "qty": qty})
+    exp = df.groupby("region").agg(amount=("amount", "sum"), qty=("qty", "mean"))
+    X, _, _ = out.to_numpy()
+    np.testing.assert_allclose(X[:, 1], exp["amount"].values, rtol=1e-4)
+    np.testing.assert_allclose(X[:, 2], exp["qty"].values, rtol=1e-5)
+
+
+def test_group_by_count_min_max(session):
+    t, region, amount, _ = _sales_table(session)
+    out = group_by(t, "region", {"amount": "count", "qty": "min"})
+    X, _, _ = out.to_numpy()
+    np.testing.assert_allclose(X[:, 1], np.bincount(region.astype(int), minlength=3))
+
+
+def test_group_by_respects_filter(session):
+    t, region, amount, _ = _sales_table(session)
+    filtered = t.filter(lambda tb: tb.column("region") != 0)
+    out = group_by(filtered, "region", {"amount": "count"})
+    X, _, _ = out.to_numpy()
+    assert X[0, 1] == 0  # region 'east' fully filtered
+
+
+def test_group_by_empty_group_nan_mean(session):
+    t, region, _, _ = _sales_table(session)
+    filtered = t.filter(lambda tb: tb.column("region") != 1)
+    out = group_by(filtered, "region", {"amount": "mean"})
+    X, _, _ = out.to_numpy()
+    assert np.isnan(X[1, 1])
+
+
+def test_group_by_rejects_continuous_key(session):
+    t, *_ = _sales_table(session)
+    with pytest.raises(ValueError, match="Discrete"):
+        group_by(t, "amount", {"qty": "sum"})
+
+
+def test_join_dimension_table(session):
+    t, region, amount, _ = _sales_table(session)
+    dim = TpuTable.from_numpy(
+        Domain([DiscreteVariable("region", ("east", "west", "north")),
+                ContinuousVariable("tax_rate")]),
+        np.asarray([[0, 0.05], [1, 0.08], [2, 0.02]], dtype=np.float32),
+        session=session,
+    )
+    out = join(t, dim, on="region")
+    X, _, _ = out.to_numpy()
+    rates = {0: 0.05, 1: 0.08, 2: 0.02}
+    np.testing.assert_allclose(
+        X[:, 3], [rates[int(r)] for r in region], rtol=1e-6
+    )
+
+
+def test_join_inner_drops_unmatched(session):
+    t, region, _, _ = _sales_table(session)
+    dim = TpuTable.from_numpy(
+        Domain([DiscreteVariable("region", ("east", "west", "north")),
+                ContinuousVariable("tax")]),
+        np.asarray([[0, 0.05]], dtype=np.float32),  # only 'east' present
+        session=session,
+    )
+    left_out = join(t, dim, on="region", how="left")
+    assert np.isnan(left_out.to_numpy()[0][:, 3]).sum() == np.sum(region != 0)
+    inner = join(t, dim, on="region", how="inner")
+    assert inner.count() == int(np.sum(region == 0))
+
+
+def test_join_rejects_duplicate_keys(session):
+    t, *_ = _sales_table(session)
+    dup = TpuTable.from_numpy(
+        Domain([DiscreteVariable("region", ("east", "west", "north")),
+                ContinuousVariable("v")]),
+        np.asarray([[0, 1.0], [0, 2.0]], dtype=np.float32),
+        session=session,
+    )
+    with pytest.raises(ValueError, match="duplicate"):
+        join(t, dup, on="region")
+
+
+def test_sort(session):
+    t, _, amount, _ = _sales_table(session, n=50)
+    out = sort(t, "amount")
+    X, _, W = out.to_numpy()
+    live = X[W > 0]
+    assert np.all(np.diff(live[:, 1]) >= 0)
+    out_d = sort(t, "amount", ascending=False)
+    Xd, _, Wd = out_d.to_numpy()
+    assert np.all(np.diff(Xd[Wd > 0][:, 1]) <= 0)
+
+
+def test_sample_fraction(session):
+    t, *_ = _sales_table(session, n=2000)
+    s = sample(t, 0.3, seed=1)
+    frac = s.count() / t.count()
+    assert 0.25 < frac < 0.35
+
+
+def test_union(session):
+    a, *_ = _sales_table(session, n=30, seed=1)
+    b, *_ = _sales_table(session, n=20, seed=2)
+    u = union(a, b)
+    assert u.count() == 50
+
+
+def test_value_counts(session):
+    t, region, *_ = _sales_table(session)
+    vc = value_counts(t, "region")
+    assert vc["east"] == float(np.sum(region == 0))
+
+
+def test_train_test_split_complementary(session):
+    t, *_ = _sales_table(session, n=500)
+    tr, te = train_test_split(t, 0.25, seed=3)
+    assert tr.count() + te.count() == 500
+    # no row live in both
+    import jax
+
+    wtr = np.asarray(jax.device_get(tr.W))
+    wte = np.asarray(jax.device_get(te.W))
+    assert np.all((wtr > 0) * (wte > 0) == 0)

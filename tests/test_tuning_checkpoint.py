@@ -1,0 +1,225 @@
+"""CrossValidator / TrainValidationSplit + checkpoint kill-and-resume drill."""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.datasets import load_iris, make_classification
+from orange3_spark_tpu.models.evaluation import MulticlassClassificationEvaluator
+from orange3_spark_tpu.models.logistic_regression import LogisticRegression
+from orange3_spark_tpu.models.tuning import (
+    CrossValidator,
+    ParamGridBuilder,
+    TrainValidationSplit,
+)
+
+
+def test_param_grid_builder():
+    grid = (
+        ParamGridBuilder()
+        .add_grid("reg_param", [0.0, 0.1])
+        .add_grid("max_iter", [10, 20])
+        .build()
+    )
+    assert len(grid) == 4
+    assert {"reg_param": 0.1, "max_iter": 20} in grid
+
+
+def test_cross_validator_picks_sane_param(session):
+    t = make_classification(600, 8, n_classes=2, seed=30, noise=0.3, session=session)
+    grid = ParamGridBuilder().add_grid("reg_param", [0.0, 10.0]).build()
+    cv = CrossValidator(
+        LogisticRegression(max_iter=50),
+        grid,
+        MulticlassClassificationEvaluator(),
+        num_folds=3,
+        seed=0,
+    )
+    model = cv.fit(t)
+    # absurd regularization must lose to none
+    assert model.best_params == {"reg_param": 0.0}
+    assert len(model.avg_metrics) == 2
+    assert model.avg_metrics[0] > model.avg_metrics[1]
+    # best model refit on all data serves transform
+    out = model.transform(t)
+    assert "prediction" in [v.name for v in out.domain.attributes]
+
+
+def test_train_validation_split(session):
+    t = make_classification(500, 6, n_classes=2, seed=31, noise=0.3, session=session)
+    grid = ParamGridBuilder().add_grid("reg_param", [0.0, 10.0]).build()
+    tvs = TrainValidationSplit(
+        LogisticRegression(max_iter=50), grid,
+        MulticlassClassificationEvaluator(), train_ratio=0.75, seed=0,
+    )
+    model = tvs.fit(t)
+    assert model.best_params == {"reg_param": 0.0}
+
+
+def test_rmse_evaluator_smaller_is_better(session):
+    from orange3_spark_tpu.models.evaluation import RegressionEvaluator
+    from orange3_spark_tpu.models.linear_regression import LinearRegression
+    from orange3_spark_tpu.core.table import TpuTable
+
+    rng = np.random.default_rng(32)
+    X = rng.standard_normal((400, 5)).astype(np.float32)
+    y = (X @ rng.standard_normal(5)).astype(np.float32)
+    t = TpuTable.from_arrays(X, y, session=session)
+    grid = ParamGridBuilder().add_grid("reg_param", [0.0, 100.0]).build()
+    cv = CrossValidator(
+        LinearRegression(solver="normal"), grid,
+        RegressionEvaluator(metric_name="rmse", label_col="y"), num_folds=3,
+    )
+    model = cv.fit(t)
+    assert model.best_params == {"reg_param": 0.0}  # lower rmse must win
+
+
+# ----------------------------------------------------------- checkpointing
+def test_model_save_load_roundtrip(session, iris, tmp_path):
+    from orange3_spark_tpu.utils.checkpoint import load_model, save_model
+
+    model = LogisticRegression(max_iter=50).fit(iris)
+    save_model(model, str(tmp_path / "lr"))
+    restored = load_model(str(tmp_path / "lr"))
+    np.testing.assert_allclose(
+        restored.predict_proba(iris), model.predict_proba(iris), rtol=1e-6
+    )
+    assert restored.params.max_iter == 50
+
+
+def test_forest_save_load_roundtrip(session, iris, tmp_path):
+    from orange3_spark_tpu.models.random_forest import RandomForestClassifier
+    from orange3_spark_tpu.utils.checkpoint import load_model, save_model
+
+    model = RandomForestClassifier(num_trees=5, max_depth=4, seed=0).fit(iris)
+    save_model(model, str(tmp_path / "rf"))
+    restored = load_model(str(tmp_path / "rf"))
+    np.testing.assert_allclose(
+        restored.predict_proba(iris), model.predict_proba(iris), rtol=1e-6
+    )
+
+
+def test_workflow_kill_and_resume(session, iris, tmp_path):
+    """Fault-injection drill (SURVEY §5): fit a workflow, checkpoint it,
+    'crash', restore in a fresh graph, and serve WITHOUT refitting."""
+    from orange3_spark_tpu.utils.checkpoint import load_workflow, save_workflow
+    from orange3_spark_tpu.widgets.catalog import WIDGET_REGISTRY, OWTable
+    from orange3_spark_tpu.workflow.graph import WorkflowGraph
+
+    g = WorkflowGraph()
+    src = g.add(OWTable(iris))
+    sc = g.add(WIDGET_REGISTRY["OWStandardScaler"](with_mean=True))
+    lr = g.add(WIDGET_REGISTRY["OWLogisticRegression"](max_iter=60))
+    g.connect(src, "data", sc, "data")
+    g.connect(sc, "data", lr, "data")
+    g.run()
+    coef_before = np.asarray(g.nodes[lr].outputs["model"].coef)
+    save_workflow(g, str(tmp_path / "wf"))
+
+    # --- simulated crash: everything dropped; restore from disk ---
+    g2 = load_workflow(str(tmp_path / "wf"))
+    src2 = [n for n, v in g2.nodes.items() if v.widget.name == "OWTable"][0]
+    lr2 = [n for n, v in g2.nodes.items()
+           if v.widget.name == "OWLogisticRegression"][0]
+    g2.nodes[src2].widget.table = iris
+    g2.run()
+    model2 = g2.nodes[lr2].outputs["model"]
+    np.testing.assert_allclose(np.asarray(model2.coef), coef_before, rtol=1e-6)
+    # the restored model must be the checkpointed one, not a refit:
+    # refitting with different max_iter would differ; confirm served-not-refit
+    # by checking the widget still holds the restored model object
+    assert g2.nodes[lr2].widget.fitted_model is model2
+
+
+def test_resume_then_param_change_refits(session, iris, tmp_path):
+    from orange3_spark_tpu.utils.checkpoint import load_workflow, save_workflow
+    from orange3_spark_tpu.widgets.catalog import WIDGET_REGISTRY, OWTable
+    from orange3_spark_tpu.workflow.graph import WorkflowGraph
+
+    g = WorkflowGraph()
+    src = g.add(OWTable(iris))
+    lr = g.add(WIDGET_REGISTRY["OWLogisticRegression"](max_iter=60))
+    g.connect(src, "data", lr, "data")
+    g.run()
+    save_workflow(g, str(tmp_path / "wf2"))
+    g2 = load_workflow(str(tmp_path / "wf2"))
+    src2 = [n for n, v in g2.nodes.items() if v.widget.name == "OWTable"][0]
+    lr2 = [n for n, v in g2.nodes.items()
+           if v.widget.name == "OWLogisticRegression"][0]
+    g2.nodes[src2].widget.table = iris
+    g2.run()
+    g2.set_params(lr2, max_iter=5)  # invalidates the restored checkpoint
+    g2.run()
+    model = g2.nodes[lr2].outputs["model"]
+    assert model.n_iter_ <= 5  # actually refit with the new setting
+
+
+def test_cv_works_with_pipeline(session):
+    """MLlib's primary CV use case: the estimator is a Pipeline."""
+    from orange3_spark_tpu.models.base import Pipeline
+    from orange3_spark_tpu.models.preprocess import StandardScaler
+
+    t = make_classification(400, 5, n_classes=2, seed=33, noise=0.3, session=session)
+    cv = CrossValidator(
+        Pipeline([StandardScaler(), LogisticRegression(max_iter=40)]),
+        [{}],
+        MulticlassClassificationEvaluator(),
+        num_folds=2,
+    )
+    model = cv.fit(t)
+    assert model.avg_metrics[0] > 0.8
+
+
+def test_resume_then_upstream_change_refits(session, iris, tmp_path):
+    """Changing an UPSTREAM widget must not let a restored model serve stale."""
+    from orange3_spark_tpu.utils.checkpoint import load_workflow, save_workflow
+    from orange3_spark_tpu.widgets.catalog import WIDGET_REGISTRY, OWTable
+    from orange3_spark_tpu.workflow.graph import WorkflowGraph
+
+    g = WorkflowGraph()
+    src = g.add(OWTable(iris))
+    sc = g.add(WIDGET_REGISTRY["OWStandardScaler"](with_mean=True))
+    lr = g.add(WIDGET_REGISTRY["OWLogisticRegression"](max_iter=40))
+    g.connect(src, "data", sc, "data")
+    g.connect(sc, "data", lr, "data")
+    g.run()
+    save_workflow(g, str(tmp_path / "wf3"))
+    g2 = load_workflow(str(tmp_path / "wf3"))
+    src2 = [n for n, v in g2.nodes.items() if v.widget.name == "OWTable"][0]
+    sc2 = [n for n, v in g2.nodes.items() if v.widget.name == "OWStandardScaler"][0]
+    lr2 = [n for n, v in g2.nodes.items()
+           if v.widget.name == "OWLogisticRegression"][0]
+    g2.nodes[src2].widget.table = iris
+    g2.run()
+    assert g2.nodes[lr2].widget.fitted_model is not None  # served checkpoint
+    g2.set_params(sc2, with_mean=False)  # upstream change
+    g2.run()
+    assert g2.nodes[lr2].widget.fitted_model is None  # checkpoint discarded
+
+
+def test_group_by_result_joins_back(session):
+    """The documented duplicate-key remediation: aggregate then join."""
+    from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.ops.relational import group_by, join
+
+    rng = np.random.default_rng(34)
+    region = rng.integers(0, 3, 100).astype(np.float32)
+    amt = rng.random(100).astype(np.float32)
+    dom = Domain([DiscreteVariable("region", ("a", "b", "c")),
+                  ContinuousVariable("amt")])
+    t = TpuTable.from_numpy(dom, np.stack([region, amt], 1), session=session)
+    agg = group_by(t, "region", {"amt": "mean"})
+    out = join(t, agg, on="region")  # must not raise (key stayed discrete)
+    assert "mean_amt" in [v.name for v in out.domain.attributes]
+
+
+def test_join_name_collision_errors(session):
+    from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.ops.relational import join
+
+    dom = Domain([DiscreteVariable("k", ("a", "b")), ContinuousVariable("v")])
+    left = TpuTable.from_numpy(dom, np.asarray([[0, 1.0]], np.float32), session=session)
+    right = TpuTable.from_numpy(dom, np.asarray([[0, 2.0], [1, 3.0]], np.float32), session=session)
+    with pytest.raises(ValueError, match="duplicate column names"):
+        join(left, right, on="k")
