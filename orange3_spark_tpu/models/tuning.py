@@ -41,14 +41,61 @@ class ParamGridBuilder:
 def _with_params(estimator: Estimator, point: dict[str, Any]) -> Estimator:
     """Clone an estimator with grid-point params applied.
 
-    Shallow-copies the instance (preserving constructor extras like Pipeline
-    stages) and swaps the frozen params; unknown param names raise from
-    dataclasses.replace with a clear message.
+    Shallow-copies the instance (preserving constructor extras) and swaps the
+    frozen params; unknown param names raise with a clear message.
+
+    For a ``Pipeline`` estimator the grid keys are routed INTO the stages —
+    MLlib's primary CV pattern, where grid Params belong to individual
+    pipeline stages. A plain key (``"reg_param"``) goes to the LAST stage
+    whose params declare that field (the final estimator, typically); an
+    explicit ``"<stage_index>__reg_param"`` key pins a specific stage.
     """
     import copy
 
+    from orange3_spark_tpu.models.base import Pipeline
+
     clone = copy.copy(estimator)
-    clone.params = estimator.params.replace(**point) if point else estimator.params
+    if not point:
+        return clone
+    if isinstance(estimator, Pipeline):
+        stages = [copy.copy(s) for s in estimator.stages]
+        for name, value in point.items():
+            if "__" in name:
+                idx_str, field = name.split("__", 1)
+                try:
+                    idx = int(idx_str)
+                except ValueError:
+                    raise ValueError(
+                        f"grid key {name!r}: stage prefix must be an integer "
+                        f"index ('<stage_index>__param'), got {idx_str!r}"
+                    ) from None
+                if not 0 <= idx < len(stages):
+                    raise ValueError(f"grid key {name!r}: no pipeline stage {idx}")
+                stage_params = getattr(stages[idx], "params", None)
+                if stage_params is None or field not in {
+                    f.name for f in dataclasses.fields(stage_params)
+                }:
+                    raise ValueError(
+                        f"grid key {name!r}: stage {idx} "
+                        f"({type(stages[idx]).__name__}) has no param {field!r}"
+                    )
+            else:
+                field = name
+                matches = [
+                    i for i, s in enumerate(stages)
+                    if getattr(s, "params", None) is not None
+                    and field in {f.name for f in dataclasses.fields(s.params)}
+                ]
+                if not matches:
+                    raise ValueError(
+                        f"grid param {name!r} matches no pipeline stage; stages: "
+                        f"{[type(s).__name__ for s in stages]}"
+                    )
+                idx = matches[-1]
+            stages[idx].params = stages[idx].params.replace(**{field: value})
+        clone.stages = stages
+        return clone
+    clone.params = estimator.params.replace(**point)
     return clone
 
 

@@ -183,9 +183,21 @@ def sort(table: TpuTable, by: str, ascending: bool = True) -> TpuTable:
     Filtered/padding rows sort to the end regardless of value.
     """
     key = table.column(by)
-    big = jnp.float32(np.finfo(np.float32).max)
-    key = jnp.where(table.W > 0, key if ascending else -key, big)
-    order = jnp.argsort(key)
+    nan = jnp.isnan(key)  # this codebase's missing-value encoding
+    key = jnp.where(nan, 0.0, key)  # neutralized; NaN ordering lives in rank
+    key = key if ascending else -key
+    order_by_key = jnp.argsort(key)
+    # Stable second pass on a 4-level rank keeps key order within each class
+    # while forcing: live non-NaN / live NaN ordered per Spark's
+    # NaN-is-largest rule (NaN last ascending, first descending — NOT folded
+    # into the key, where it would tie with a genuine ±inf value), then
+    # filtered rows (W==0 but inside the live region — they must stay inside
+    # the first n_rows so metas and to_numpy()'s unpadded window remain
+    # aligned), padding strictly last.
+    nan_rank = nan.astype(jnp.int32) if ascending else (~nan).astype(jnp.int32)
+    idx = jnp.arange(table.n_pad)
+    rank = jnp.where(table.W > 0, nan_rank, jnp.where(idx < table.n_rows, 2, 3))
+    order = order_by_key[jnp.argsort(rank[order_by_key], stable=True)]
     X = table.X[order]
     Y = table.Y[order] if table.Y is not None else None
     W = table.W[order]
@@ -211,9 +223,25 @@ def union(a: TpuTable, b: TpuTable) -> TpuTable:
         raise ValueError("union requires identical domains")
     Xa, Ya, Wa = a.to_numpy()
     Xb, Yb, Wb = b.to_numpy()
+    if (Ya is None) != (Yb is None):
+        # unreachable via from_numpy (it rejects class_vars without Y), but a
+        # hand-built TpuTable could get here — fail loudly, don't drop labels
+        raise ValueError("union: one table has Y and the other does not")
     metas = None
-    if a.metas is not None and b.metas is not None:
-        metas = np.concatenate([a.metas, b.metas], axis=0)
+    if a.metas is not None or b.metas is not None:
+        # one-sided metas: pad the missing side with None rows instead of
+        # silently dropping the present side's host data
+        ma = a.metas if a.metas is not None else np.full(
+            (len(Xa), b.metas.shape[1]), None, dtype=object
+        )
+        mb = b.metas if b.metas is not None else np.full(
+            (len(Xb), ma.shape[1]), None, dtype=object
+        )
+        if ma.shape[1] != mb.shape[1]:
+            raise ValueError(
+                f"union: metas width mismatch ({ma.shape[1]} vs {mb.shape[1]})"
+            )
+        metas = np.concatenate([ma, mb], axis=0)
     return TpuTable.from_numpy(
         a.domain,
         np.concatenate([Xa, Xb], 0),

@@ -98,8 +98,19 @@ class WorkflowGraph:
             raise ValueError("workflow graph has a cycle")
         return order
 
-    def invalidate(self, node_id: int) -> None:
-        """Mark a node and everything downstream dirty (signal change)."""
+    def invalidate(self, node_id: int, _visited: set[int] | None = None) -> None:
+        """Mark a node and everything downstream dirty (signal change).
+
+        Always walks the full downstream cone (with a visited set, not
+        dirtiness, as the recursion stop): a node can be dirty yet still hold
+        a checkpoint-restored ``fitted_model`` — pruning at dirty nodes would
+        leave such a model live past them and serve it against changed inputs.
+        """
+        if _visited is None:
+            _visited = set()
+        if node_id in _visited:
+            return
+        _visited.add(node_id)
         node = self.nodes[node_id]
         node.outputs = None
         if getattr(node.widget, "fitted_model", None) is not None:
@@ -107,8 +118,8 @@ class WorkflowGraph:
             # changes — it must refit on the new inputs, not serve blindly
             node.widget.fitted_model = None
         for e in self.edges:
-            if e.src == node_id and self.nodes[e.dst].outputs is not None:
-                self.invalidate(e.dst)
+            if e.src == node_id:
+                self.invalidate(e.dst, _visited)
 
     def set_params(self, node_id: int, **kwargs) -> None:
         """Change a widget's settings — refires it and downstream on next run."""

@@ -154,3 +154,65 @@ def test_train_test_split_complementary(session):
     wtr = np.asarray(jax.device_get(tr.W))
     wte = np.asarray(jax.device_get(te.W))
     assert np.all((wtr > 0) * (wte > 0) == 0)
+
+
+def test_sort_keeps_nan_rows_live(session):
+    """A live NaN sort-key must not sort past the padding zone and vanish."""
+    dom = Domain([ContinuousVariable("a"), ContinuousVariable("b")])
+    X = np.asarray(
+        [[3.0, 0], [np.nan, 1], [1.0, 2], [2.0, 3], [0.5, 4]], np.float32
+    )
+    t = TpuTable.from_numpy(dom, X, session=session)
+    s = sort(t, "a")
+    assert s.count() == 5
+    out = s.to_numpy()[0]
+    assert out.shape[0] == 5
+    # NaN sorts last among live rows (Spark NaN-is-largest), ascending
+    np.testing.assert_allclose(out[:4, 0], [0.5, 1.0, 2.0, 3.0])
+    assert np.isnan(out[4, 0])
+    assert out[4, 1] == 1  # companion column stayed aligned with the NaN row
+    # descending: NaN first
+    out_d = sort(t, "a", ascending=False).to_numpy()[0]
+    assert np.isnan(out_d[0, 0])
+    np.testing.assert_allclose(out_d[1:, 0], [3.0, 2.0, 1.0, 0.5])
+
+
+def test_union_one_sided_metas_padded(session):
+    dom = Domain([ContinuousVariable("x")])
+    a = TpuTable.from_numpy(
+        dom, np.asarray([[1.0], [2.0]], np.float32),
+        metas=np.asarray([["r1"], ["r2"]], object), session=session,
+    )
+    b = TpuTable.from_numpy(dom, np.asarray([[3.0]], np.float32), session=session)
+    u = union(a, b)
+    assert u.metas is not None and u.metas.shape == (3, 1)
+    assert list(u.metas[:, 0]) == ["r1", "r2", None]
+    u2 = union(b, a)  # metas only on the right side
+    assert list(u2.metas[:, 0]) == [None, "r1", "r2"]
+
+
+def test_sort_filtered_rows_stay_inside_live_window(session):
+    """Filtered (W==0) rows must sort after weighted rows but BEFORE padding,
+    so metas and to_numpy()'s unpadded window stay aligned."""
+    dom = Domain([ContinuousVariable("a")])
+    X = np.asarray([[3.0], [1.0], [10.0], [2.0], [0.5]], np.float32)
+    metas = np.asarray([["m3"], ["m1"], ["m10"], ["m2"], ["m05"]], object)
+    t = TpuTable.from_numpy(dom, X, metas=metas, session=session)
+    t = t.filter(lambda tb: tb.column("a") < 9.0)  # drops the 10.0 row
+    s = sort(t, "a")
+    out, _, w = s.to_numpy()
+    # weighted rows in key order; the filtered row still inside the window
+    np.testing.assert_allclose(out[:4, 0], [0.5, 1.0, 2.0, 3.0])
+    assert out[4, 0] == 10.0 and w[4] == 0.0
+    assert list(s.metas[:, 0]) == ["m05", "m1", "m2", "m3", "m10"]
+
+
+def test_sort_nan_beats_inf(session):
+    """Spark NaN-is-largest: NaN outranks a genuine +inf value."""
+    dom = Domain([ContinuousVariable("a")])
+    X = np.asarray([[np.inf], [np.nan], [1.0]], np.float32)
+    t = TpuTable.from_numpy(dom, X, session=session)
+    up = sort(t, "a").to_numpy()[0][:, 0]
+    assert up[0] == 1.0 and up[1] == np.inf and np.isnan(up[2])
+    down = sort(t, "a", ascending=False).to_numpy()[0][:, 0]
+    assert np.isnan(down[0]) and down[1] == np.inf and down[2] == 1.0

@@ -223,3 +223,63 @@ def test_join_name_collision_errors(session):
     right = TpuTable.from_numpy(dom, np.asarray([[0, 2.0], [1, 3.0]], np.float32), session=session)
     with pytest.raises(ValueError, match="duplicate column names"):
         join(left, right, on="k")
+
+
+def test_cv_pipeline_grid_routes_to_stage(session):
+    """Non-empty grid over a Pipeline: keys must reach the owning stage."""
+    from orange3_spark_tpu.models.base import Pipeline
+    from orange3_spark_tpu.models.preprocess import StandardScaler
+
+    t = make_classification(400, 5, n_classes=2, seed=35, noise=0.3, session=session)
+    grid = ParamGridBuilder().add_grid("reg_param", [0.0, 10.0]).build()
+    cv = CrossValidator(
+        Pipeline([StandardScaler(), LogisticRegression(max_iter=40)]),
+        grid,
+        MulticlassClassificationEvaluator(),
+        num_folds=2,
+    )
+    model = cv.fit(t)
+    assert model.best_params == {"reg_param": 0.0}  # heavy reg loses
+    assert len(model.avg_metrics) == 2
+
+    # explicit stage pinning with "<idx>__param"
+    grid2 = ParamGridBuilder().add_grid("1__reg_param", [0.0, 10.0]).build()
+    model2 = CrossValidator(
+        Pipeline([StandardScaler(), LogisticRegression(max_iter=40)]),
+        grid2, MulticlassClassificationEvaluator(), num_folds=2,
+    ).fit(t)
+    assert model2.best_params == {"1__reg_param": 0.0}
+
+    with pytest.raises(ValueError, match="matches no pipeline stage"):
+        CrossValidator(
+            Pipeline([StandardScaler(), LogisticRegression(max_iter=5)]),
+            [{"not_a_param": 1}], MulticlassClassificationEvaluator(), num_folds=2,
+        ).fit(t)
+
+
+def test_resume_then_upstream_change_before_first_run_refits(session, iris, tmp_path):
+    """Upstream change BEFORE the first post-restore run must still discard
+    the checkpoint-restored model (invalidate must not prune at dirty nodes)."""
+    from orange3_spark_tpu.utils.checkpoint import load_workflow, save_workflow
+    from orange3_spark_tpu.widgets.catalog import WIDGET_REGISTRY, OWTable
+    from orange3_spark_tpu.workflow.graph import WorkflowGraph
+
+    g = WorkflowGraph()
+    src = g.add(OWTable(iris))
+    sc = g.add(WIDGET_REGISTRY["OWStandardScaler"](with_mean=True))
+    lr = g.add(WIDGET_REGISTRY["OWLogisticRegression"](max_iter=40))
+    g.connect(src, "data", sc, "data")
+    g.connect(sc, "data", lr, "data")
+    g.run()
+    save_workflow(g, str(tmp_path / "wf4"))
+
+    g2 = load_workflow(str(tmp_path / "wf4"))
+    src2 = [n for n, v in g2.nodes.items() if v.widget.name == "OWTable"][0]
+    sc2 = [n for n, v in g2.nodes.items() if v.widget.name == "OWStandardScaler"][0]
+    lr2 = [n for n, v in g2.nodes.items()
+           if v.widget.name == "OWLogisticRegression"][0]
+    g2.nodes[src2].widget.table = iris
+    g2.set_params(sc2, with_mean=False)  # BEFORE any post-restore run
+    assert g2.nodes[lr2].widget.fitted_model is None  # checkpoint discarded
+    g2.run()  # refits cleanly on the changed preprocessing
+    assert g2.nodes[lr2].outputs["model"] is not None
