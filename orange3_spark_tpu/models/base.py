@@ -178,6 +178,23 @@ class PipelineModel(Model):
             stage.load_state_pytree(sub)
 
 
+def infer_class_values(table: TpuTable) -> tuple[str, ...]:
+    """Class labels from the domain, or '0'..'max(y)' when untyped.
+
+    The fallback max only looks at LIVE rows (W > 0) — filtered rows' labels
+    must not inflate the class count.
+    """
+    import jax.numpy as jnp
+
+    cvar = table.domain.class_var
+    from orange3_spark_tpu.core.domain import DiscreteVariable
+
+    if isinstance(cvar, DiscreteVariable) and cvar.values:
+        return tuple(cvar.values)
+    y_max = jnp.max(jnp.where(table.W > 0, table.y, 0.0))
+    return tuple(str(i) for i in range(int(np.asarray(y_max).item()) + 1))
+
+
 def predictions_to_numpy(table: TpuTable, column: str = "prediction") -> np.ndarray:
     """Collect one prediction column to host, stripping padding."""
     col = table.column(column)

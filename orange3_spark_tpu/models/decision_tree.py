@@ -1,0 +1,153 @@
+"""DecisionTree — parity with ``pyspark.ml.classification.DecisionTreeClassifier``
+and ``pyspark.ml.regression.DecisionTreeRegressor``.
+
+MLlib's single tree is the degenerate forest (numTrees=1, no bootstrap, all
+features at every node); it shares the distributed binned-histogram grower
+(SURVEY.md §2b row "RandomForest / GBTClassifier" — reconstructed, mount
+empty). Same here: one call into the fixed-shape ``grow_tree`` program of
+``_tree.py`` with unit weights and a full feature mask — the whole induction
+is a single jitted XLA computation whose per-level ``segment_sum`` histograms
+all-reduce over ICI via GSPMD.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models._tree import (
+    Tree,
+    bin_features,
+    compute_bin_edges,
+    grow_tree,
+    leaf_class_probs,
+    tree_apply,
+)
+from orange3_spark_tpu.models.base import Estimator, Model, Params, infer_class_values
+
+
+@dataclasses.dataclass(frozen=True)
+class DecisionTreeParams(Params):
+    max_depth: int = 5                   # MLlib maxDepth
+    max_bins: int = 32                   # MLlib maxBins
+    min_instances_per_node: float = 1.0  # MLlib minInstancesPerNode
+    min_info_gain: float = 0.0           # MLlib minInfoGain
+    impurity: str = "auto"               # 'gini' (clf) / 'variance' (reg)
+    seed: int = 0
+
+
+def _grow_single(table: TpuTable, Ystats, p: DecisionTreeParams, gain_mode: str):
+    edges = compute_bin_edges(table.X, table.W, p.max_bins)
+    B = bin_features(table.X, edges)
+    keep = jnp.ones((p.max_depth, table.n_attrs), jnp.float32)
+    tree, _ = grow_tree(
+        B, Ystats * table.W[:, None], edges, keep,
+        jnp.float32(p.min_info_gain),
+        depth=p.max_depth, n_bins=p.max_bins, gain_mode=gain_mode,
+        min_instances=p.min_instances_per_node,
+    )
+    return tree
+
+
+class DecisionTreeClassifierModel(Model):
+    def __init__(self, params, tree: Tree, class_values):
+        self.params = params
+        self.tree = tree
+        self.class_values = tuple(class_values)
+
+    @property
+    def state_pytree(self):
+        return dict(self.tree._asdict())
+
+    def load_state_pytree(self, state):
+        self.tree = Tree(**{k: state[k] for k in Tree._fields})
+
+    def _probs(self, X):
+        leaves = tree_apply(X, self.tree)                    # [N]
+        probs = leaf_class_probs(self.tree.leaf_value)       # [L, k]
+        return probs[leaves]
+
+    def predict_proba(self, table: TpuTable) -> np.ndarray:
+        return np.asarray(self._probs(table.X))[: table.n_rows]
+
+    def predict(self, table: TpuTable) -> np.ndarray:
+        probs = self._probs(table.X)
+        return np.asarray(jnp.argmax(probs, 1).astype(jnp.float32))[: table.n_rows]
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        probs = self._probs(table.X)
+        pred = jnp.argmax(probs, axis=1).astype(jnp.float32)
+        new_attrs = list(table.domain.attributes) + [
+            ContinuousVariable(f"probability_{c}") for c in self.class_values
+        ] + [DiscreteVariable("prediction", self.class_values)]
+        new_domain = Domain(new_attrs, table.domain.class_vars, table.domain.metas)
+        X = jnp.concatenate([table.X, probs, pred[:, None]], axis=1)
+        return table.with_X(X, new_domain)
+
+
+class DecisionTreeClassifier(Estimator):
+    ParamsCls = DecisionTreeParams
+    params: DecisionTreeParams
+
+    def _fit(self, table: TpuTable) -> DecisionTreeClassifierModel:
+        p = self.params
+        if p.impurity not in ("auto", "gini"):
+            raise ValueError(f"classifier impurity must be 'gini', got {p.impurity!r}")
+        y = table.y
+        class_values = infer_class_values(table)
+        k = len(class_values)
+        Ystats = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=jnp.float32)
+        tree = _grow_single(table, Ystats, p, "gini")
+        return DecisionTreeClassifierModel(p, tree, class_values)
+
+
+class DecisionTreeRegressorModel(Model):
+    def __init__(self, params, tree: Tree):
+        self.params = params
+        self.tree = tree
+
+    @property
+    def state_pytree(self):
+        return dict(self.tree._asdict())
+
+    def load_state_pytree(self, state):
+        self.tree = Tree(**{k: state[k] for k in Tree._fields})
+
+    def predict(self, table: TpuTable) -> np.ndarray:
+        leaves = tree_apply(table.X, self.tree)
+        s1 = self.tree.leaf_value[:, 0]
+        c = jnp.maximum(self.tree.leaf_value[:, 2], 1e-12)
+        return np.asarray((s1 / c)[leaves])[: table.n_rows]
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        leaves = tree_apply(table.X, self.tree)
+        s1 = self.tree.leaf_value[:, 0]
+        c = jnp.maximum(self.tree.leaf_value[:, 2], 1e-12)
+        yhat = (s1 / c)[leaves]
+        new_domain = Domain(
+            list(table.domain.attributes) + [ContinuousVariable("prediction")],
+            table.domain.class_vars, table.domain.metas,
+        )
+        X = jnp.concatenate([table.X, yhat[:, None]], axis=1)
+        return table.with_X(X, new_domain)
+
+
+class DecisionTreeRegressor(Estimator):
+    ParamsCls = DecisionTreeParams
+    params: DecisionTreeParams
+
+    def _fit(self, table: TpuTable) -> DecisionTreeRegressorModel:
+        p = self.params
+        if p.impurity not in ("auto", "variance"):
+            raise ValueError(
+                f"regressor impurity must be 'variance', got {p.impurity!r}"
+            )
+        y = table.y
+        Ystats = jnp.stack([y, y * y, jnp.ones_like(y)], axis=1)
+        tree = _grow_single(table, Ystats, p, "variance")
+        return DecisionTreeRegressorModel(p, tree)

@@ -23,7 +23,7 @@ import numpy as np
 from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
 from orange3_spark_tpu.core.table import TpuTable
 from orange3_spark_tpu.models._linear import column_inv_std, fit_linear
-from orange3_spark_tpu.models.base import Estimator, Model, Params
+from orange3_spark_tpu.models.base import Estimator, Model, Params, infer_class_values
 
 
 @dataclasses.dataclass(frozen=True)
@@ -101,13 +101,7 @@ class LogisticRegression(Estimator):
                 "elastic_net_param != 0 (L1) is not implemented yet; use reg_param (L2)"
             )
         y = table.y
-        cvar = table.domain.class_var
-        if isinstance(cvar, DiscreteVariable) and cvar.values:
-            class_values = cvar.values
-        else:
-            class_values = tuple(
-                str(int(v)) for v in range(int(np.asarray(jnp.max(y)).item()) + 1)
-            )
+        class_values = infer_class_values(table)
         k = len(class_values)
         if p.family == "binomial" and k != 2:
             raise ValueError(f"binomial family needs 2 classes, got {k}")

@@ -1,0 +1,159 @@
+"""NaiveBayes — parity with ``pyspark.ml.classification.NaiveBayes``.
+
+MLlib supports modelType ∈ {multinomial, bernoulli, gaussian, complement}
+and fits by one pass of per-class aggregation over the data (a treeAggregate
+summing per-class feature counts; SURVEY.md §2b pattern — reconstructed,
+mount empty). TPU-native redesign: every per-class aggregate is the single
+matmul ``one_hot(y)ᵀ @ X`` ([k,N]@[N,d] on the MXU) whose row-axis
+contraction GSPMD all-reduces over ICI — the entire fit is one fused XLA
+program, and prediction is one ``X @ thetaᵀ`` matmul against the log-factor
+matrix (no per-row Python, no per-class loop).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models.base import Estimator, Model, Params, infer_class_values
+
+_EPS = 1e-12
+
+
+@dataclasses.dataclass(frozen=True)
+class NaiveBayesParams(Params):
+    smoothing: float = 1.0        # MLlib smoothing (Laplace/Lidstone)
+    model_type: str = "multinomial"  # MLlib modelType:
+                                  # multinomial | bernoulli | gaussian | complement
+    seed: int = 0
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _class_aggregates(X, y, w, *, k: int):
+    """Per-class weighted sums via MXU matmuls: counts[k], sums[k,d], sq[k,d]."""
+    onehot = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=jnp.float32) * w[:, None]
+    counts = jnp.sum(onehot, axis=0)                     # [k]  Σw per class
+    sums = onehot.T @ X                                  # [k,d] Σw·x per class
+    sq = onehot.T @ (X * X)                              # [k,d] Σw·x² per class
+    return counts, sums, sq
+
+
+def _fit_factors(counts, sums, sq, smoothing: float, model_type: str):
+    """log-prior pi[k] and the per-class log factors used at predict time."""
+    pi = jnp.log(jnp.maximum(counts, _EPS)) - jnp.log(
+        jnp.maximum(jnp.sum(counts), _EPS)
+    )
+    if model_type == "multinomial":
+        num = sums + smoothing
+        theta = jnp.log(num) - jnp.log(jnp.sum(num, axis=1, keepdims=True))
+        return pi, {"theta": theta}
+    if model_type == "complement":
+        # CNB (Rennie et al. 2003, as in MLlib): weight by counts of all OTHER
+        # classes, negated so argmax semantics match multinomial's.
+        comp = jnp.sum(sums, axis=0, keepdims=True) - sums
+        num = comp + smoothing
+        theta = -(jnp.log(num) - jnp.log(jnp.sum(num, axis=1, keepdims=True)))
+        return pi, {"theta": theta}
+    if model_type == "bernoulli":
+        p1 = (sums + smoothing) / (counts[:, None] + 2.0 * smoothing)
+        return pi, {"log_p1": jnp.log(p1), "log_p0": jnp.log1p(-p1)}
+    if model_type == "gaussian":
+        mean = sums / jnp.maximum(counts[:, None], _EPS)
+        var = sq / jnp.maximum(counts[:, None], _EPS) - mean * mean
+        # MLlib-style variance flooring: epsilon scaled to the largest variance
+        var_floor = 1e-9 * jnp.maximum(jnp.max(var), _EPS)
+        var = jnp.maximum(var, var_floor)
+        return pi, {"mean": mean, "var": var}
+    raise ValueError(f"unknown model_type {model_type!r}")
+
+
+@partial(jax.jit, static_argnames=("model_type",))
+def _log_joint(X, pi, factors, *, model_type: str):
+    """Per-row per-class log joint likelihood — all matmul-shaped."""
+    if model_type in ("multinomial", "complement"):
+        return X @ factors["theta"].T + pi
+    if model_type == "bernoulli":
+        lp1, lp0 = factors["log_p1"], factors["log_p0"]
+        return X @ (lp1 - lp0).T + jnp.sum(lp0, axis=1) + pi
+    # gaussian: Σ_j -(x-μ)²/(2σ²) - ½log(2πσ²), expanded so the x-dependent
+    # terms are two matmuls (x² @ a + x @ b) instead of an [N,k,d] broadcast
+    mean, var = factors["mean"], factors["var"]
+    a = -0.5 / var                                       # [k,d]
+    b = mean / var                                       # [k,d]
+    const = jnp.sum(-0.5 * mean * mean / var - 0.5 * jnp.log(2.0 * jnp.pi * var), 1)
+    return (X * X) @ a.T + X @ b.T + const + pi
+
+
+class NaiveBayesModel(Model):
+    def __init__(self, params, pi, factors, class_values):
+        self.params = params
+        self.pi = pi                    # f32[k] log prior
+        self.factors = factors          # dict of f32[k,d] log-factor arrays
+        self.class_values = tuple(class_values)
+
+    @property
+    def state_pytree(self):
+        return {"pi": self.pi, **self.factors}
+
+    def load_state_pytree(self, state):
+        state = dict(state)
+        self.pi = state.pop("pi")
+        self.factors = state
+
+    def _scores(self, X):
+        return _log_joint(X, self.pi, self.factors,
+                          model_type=self.params.model_type)
+
+    def predict(self, table: TpuTable) -> np.ndarray:
+        s = self._scores(table.X)
+        return np.asarray(jnp.argmax(s, 1).astype(jnp.float32))[: table.n_rows]
+
+    def predict_proba(self, table: TpuTable) -> np.ndarray:
+        s = self._scores(table.X)
+        return np.asarray(jax.nn.softmax(s, axis=-1))[: table.n_rows]
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        s = self._scores(table.X)
+        prob = jax.nn.softmax(s, axis=-1)
+        pred = jnp.argmax(s, axis=1).astype(jnp.float32)
+        new_attrs = list(table.domain.attributes) + [
+            ContinuousVariable(f"probability_{c}") for c in self.class_values
+        ] + [DiscreteVariable("prediction", self.class_values)]
+        new_domain = Domain(new_attrs, table.domain.class_vars, table.domain.metas)
+        X = jnp.concatenate([table.X, prob, pred[:, None]], axis=1)
+        return table.with_X(X, new_domain)
+
+
+class NaiveBayes(Estimator):
+    ParamsCls = NaiveBayesParams
+    params: NaiveBayesParams
+
+    def _fit(self, table: TpuTable) -> NaiveBayesModel:
+        p = self.params
+        y = table.y
+        class_values = infer_class_values(table)
+        k = len(class_values)
+        if p.model_type in ("multinomial", "complement", "bernoulli"):
+            # MLlib requires nonnegative features for these model types
+            if bool(jnp.any((table.X < 0) & (table.W[:, None] > 0))):
+                raise ValueError(
+                    f"model_type={p.model_type!r} requires nonnegative features"
+                )
+        if p.model_type == "bernoulli":
+            # MLlib raises on non-0/1 values for bernoulli (p1 > 1 would turn
+            # log1p(-p1) into NaN and poison every posterior)
+            live = table.W[:, None] > 0
+            if bool(jnp.any(live & (table.X != 0.0) & (table.X != 1.0))):
+                raise ValueError(
+                    "model_type='bernoulli' requires 0/1 features; "
+                    "binarize first (Binarizer)"
+                )
+        counts, sums, sq = _class_aggregates(table.X, y, table.W, k=k)
+        pi, factors = _fit_factors(counts, sums, sq, p.smoothing, p.model_type)
+        return NaiveBayesModel(p, pi, factors, class_values)

@@ -1,0 +1,112 @@
+"""OneVsRest — parity with ``pyspark.ml.classification.OneVsRest``.
+
+MLlib reduces a k-class problem to k binary fits of a caller-supplied base
+classifier, then predicts the class whose binary model is most confident
+(SURVEY.md §2b Estimator protocol row — reconstructed, mount empty). Spark
+runs the k fits as k separate Spark jobs; here each relabeling is a pure
+device op (``y == c`` — no data copy, the [N,d] features are shared across
+all k fits) and the per-class confidences stack into one [N,k] argmax. The
+base estimator is arbitrary, so the k fits run as k XLA program launches
+over the same sharded arrays rather than one vmapped program — the data
+stays resident on device between them, which is the part Spark pays shuffle
+for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models.base import Estimator, Model, Params, infer_class_values
+
+
+@dataclasses.dataclass(frozen=True)
+class OneVsRestParams(Params):
+    parallelism: int = 1  # MLlib parallelism (thread pool); device fits are
+                          # already async-dispatched, so this is accepted for
+                          # API parity but not a throughput lever here
+
+
+def _binary_table(table: TpuTable, cls_index: int) -> TpuTable:
+    """Relabel y -> 1{y == cls_index} without touching X (device-only op)."""
+    y_bin = (table.y == float(cls_index)).astype(jnp.float32)[:, None]
+    domain = Domain(
+        table.domain.attributes,
+        DiscreteVariable("_ovr_target", ("rest", "this")),
+        table.domain.metas,
+    )
+    return TpuTable(domain, table.X, y_bin, table.W, table.metas,
+                    table.n_rows, table.session)
+
+
+def _confidence(model: Model, table: TpuTable) -> np.ndarray:
+    """Per-row confidence for the positive class of a fitted binary model."""
+    proba = getattr(model, "predict_proba", None)
+    if proba is not None:
+        return np.asarray(proba(table))[:, 1]
+    dec = getattr(model, "decision_function", None)
+    if dec is not None:
+        return np.asarray(dec(table))
+    raise TypeError(
+        f"{type(model).__name__} exposes neither predict_proba nor "
+        "decision_function; OneVsRest cannot rank its confidence"
+    )
+
+
+class OneVsRestModel(Model):
+    def __init__(self, params, models, class_values):
+        self.params = params
+        self.models = list(models)      # k fitted binary models
+        self.class_values = tuple(class_values)
+
+    @property
+    def state_pytree(self):
+        return {
+            f"class{i}": m.state_pytree for i, m in enumerate(self.models)
+        }
+
+    def load_state_pytree(self, state):
+        for key, sub in state.items():
+            self.models[int(key.removeprefix("class"))].load_state_pytree(sub)
+
+    def _scores(self, table: TpuTable) -> np.ndarray:
+        return np.stack(
+            [_confidence(m, table) for m in self.models], axis=1
+        )  # [n, k] host-side stack of device-computed confidences
+
+    def predict(self, table: TpuTable) -> np.ndarray:
+        s = self._scores(table)
+        return np.argmax(s, axis=1).astype(np.float32)[: table.n_rows]
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        s = self._scores(table)  # [n_rows, k] — base models strip padding
+        pred = np.zeros((table.n_pad,), np.float32)
+        pred[: table.n_rows] = np.argmax(s, axis=1)[: table.n_rows]
+        new_attrs = list(table.domain.attributes) + [
+            DiscreteVariable("prediction", self.class_values)
+        ]
+        new_domain = Domain(new_attrs, table.domain.class_vars, table.domain.metas)
+        X = jnp.concatenate([table.X, jnp.asarray(pred)[:, None]], axis=1)
+        return table.with_X(X, new_domain)
+
+
+class OneVsRest(Estimator):
+    ParamsCls = OneVsRestParams
+    params: OneVsRestParams
+
+    def __init__(self, classifier: Estimator, params=None, **kwargs):
+        super().__init__(params, **kwargs)
+        self.classifier = classifier  # MLlib's `classifier` Param
+
+    def _fit(self, table: TpuTable) -> OneVsRestModel:
+        class_values = infer_class_values(table)
+        base_params = self.classifier.params
+        models = []
+        for c in range(len(class_values)):
+            est = type(self.classifier)(base_params)
+            models.append(est.fit(_binary_table(table, c)))
+        return OneVsRestModel(self.params, models, class_values)

@@ -29,7 +29,7 @@ from orange3_spark_tpu.models._tree import (
     leaf_class_probs,
     tree_apply,
 )
-from orange3_spark_tpu.models.base import Estimator, Model, Params
+from orange3_spark_tpu.models.base import Estimator, Model, Params, infer_class_values
 
 
 def _subset_fraction(strategy: str, d: int, is_classification: bool) -> float:
@@ -128,11 +128,7 @@ class RandomForestClassifier(Estimator):
     def _fit(self, table: TpuTable) -> RandomForestClassifierModel:
         p = self.params
         y = table.y
-        cvar = table.domain.class_var
-        class_values = (
-            cvar.values if isinstance(cvar, DiscreteVariable) and cvar.values
-            else tuple(str(i) for i in range(int(np.asarray(jnp.max(y)).item()) + 1))
-        )
+        class_values = infer_class_values(table)
         k = len(class_values)
         edges = compute_bin_edges(table.X, table.W, p.max_bins)
         B = bin_features(table.X, edges)

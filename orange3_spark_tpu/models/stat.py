@@ -1,0 +1,236 @@
+"""Statistics — parity with ``pyspark.ml.stat`` (Correlation, ChiSquareTest,
+Summarizer, KolmogorovSmirnovTest).
+
+MLlib computes these with one treeAggregate pass per statistic (Pearson via
+a Gramian aggregate, chi-square via per-feature contingency counts;
+SURVEY.md §2b/§5 — reconstructed, mount empty). TPU-native redesign: each
+statistic is a single jitted program whose row-axis contractions are MXU
+matmuls / segment-sums that GSPMD all-reduces over ICI. Spearman's rank
+transform — a full shuffle-sort in Spark — is a device ``argsort`` chain
+with tie-averaging via segment ops, no host round-trip. P-values come from
+``jax.scipy.special`` on device (no scipy dependency).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.ops.stats import EPS_TOTAL_WEIGHT, weighted_moments
+
+_BIG = jnp.float32(np.finfo(np.float32).max)
+
+
+# ------------------------------------------------------------- correlation
+@jax.jit
+def _pearson_kernel(X, w):
+    """Weighted Pearson correlation matrix [d, d] of row-sharded X."""
+    mean, var, tot = weighted_moments(X, w)
+    Xc = X - mean
+    cov = (Xc * w[:, None]).T @ Xc / tot            # [d,d] MXU Gramian
+    std = jnp.sqrt(jnp.maximum(var, 0.0))
+    denom = jnp.outer(std, std)
+    corr = jnp.where(denom > EPS_TOTAL_WEIGHT, cov / jnp.maximum(denom, EPS_TOTAL_WEIGHT), 0.0)
+    # exact 1.0 diagonal regardless of fp rounding
+    return jnp.fill_diagonal(jnp.clip(corr, -1.0, 1.0), 1.0, inplace=False)
+
+
+@jax.jit
+def _tie_averaged_ranks(X, w):
+    """Per-column fractional (tie-averaged) ranks of the LIVE rows.
+
+    Padding/filtered rows (w == 0) are pushed to +inf so they occupy the top
+    ranks and never perturb live-row ranks; callers must mask them out via w.
+    """
+    N = X.shape[0]
+    Xm = jnp.where(w[:, None] > 0, X, _BIG)
+    order = jnp.argsort(Xm, axis=0)                            # [N, d]
+    Xs = jnp.take_along_axis(Xm, order, axis=0)
+    pos = jnp.arange(1, N + 1, dtype=jnp.float32)[:, None] * jnp.ones_like(Xs)
+    new_group = jnp.concatenate(
+        [jnp.ones((1, X.shape[1]), bool), Xs[1:] != Xs[:-1]], axis=0
+    )
+    gid = jnp.cumsum(new_group.astype(jnp.int32), axis=0) - 1  # [N, d]
+    def per_col(g, p):
+        s = jax.ops.segment_sum(p, g, num_segments=N)
+        c = jax.ops.segment_sum(jnp.ones_like(p), g, num_segments=N)
+        return (s / jnp.maximum(c, 1.0))[g]
+    avg_sorted = jax.vmap(per_col, in_axes=1, out_axes=1)(gid, pos)
+    inv = jnp.argsort(order, axis=0)                           # undo the sort
+    return jnp.take_along_axis(avg_sorted, inv, axis=0)
+
+
+class Correlation:
+    """``pyspark.ml.stat.Correlation.corr`` equivalent."""
+
+    @staticmethod
+    def corr(table: TpuTable, method: str = "pearson") -> np.ndarray:
+        X, w = table.X, table.W
+        if method == "pearson":
+            return np.asarray(_pearson_kernel(X, w))
+        if method == "spearman":
+            ranks = _tie_averaged_ranks(X, w)
+            return np.asarray(_pearson_kernel(ranks, w))
+        raise ValueError(f"method must be 'pearson' or 'spearman', got {method!r}")
+
+
+# ----------------------------------------------------------- chi-square test
+class ChiSquareResult(NamedTuple):
+    p_values: np.ndarray          # f64[n_features]
+    degrees_of_freedom: np.ndarray  # i64[n_features]
+    statistics: np.ndarray        # f64[n_features]
+
+
+def _chi2_sf(stat, dof):
+    """Chi-square survival function via the regularized upper gamma."""
+    return jax.scipy.special.gammaincc(jnp.maximum(dof, 1.0) / 2.0, stat / 2.0)
+
+
+@partial(jax.jit, static_argnames=("m", "k"))
+def _contingency(f, y, w, *, m: int, k: int):
+    """Weighted [m, k] contingency table of one categorical feature vs label."""
+    fh = jax.nn.one_hot(f.astype(jnp.int32), m, dtype=jnp.float32) * w[:, None]
+    yh = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=jnp.float32)
+    return fh.T @ yh                                           # [m, k] on MXU
+
+
+class ChiSquareTest:
+    """``pyspark.ml.stat.ChiSquareTest.test`` equivalent.
+
+    Pearson's independence test of each categorical feature column against
+    the (categorical) class column; feature values must be small nonnegative
+    integers (bin with Bucketizer/QuantileDiscretizer first, as in Spark).
+    """
+
+    @staticmethod
+    def test(table: TpuTable, feature_cols: Sequence[str] | None = None) -> ChiSquareResult:
+        y = table.y
+        w = table.W
+        names = list(feature_cols) if feature_cols is not None else [
+            v.name for v in table.domain.attributes
+        ]
+        # ONE host sync for every cardinality, ONE compile of the contingency
+        # kernel: all maxes in a fused device call, m shared across features
+        # (padded; empty categories drop out of the statistic below)
+        cols = [table.column(name) for name in names]
+        live = w > 0
+        maxes = np.asarray(jax.jit(
+            lambda cs, yy: jnp.stack(
+                [jnp.max(jnp.where(live, c, 0.0)) for c in cs]
+                + [jnp.max(jnp.where(live, yy, 0.0))]
+            )
+        )(cols, y))
+        k = int(maxes[-1]) + 1
+        m = int(maxes[:-1].max()) + 1 if names else 1
+        stats, dofs, ps = [], [], []
+        for f in cols:
+            obs = _contingency(f, y, w, m=m, k=k)
+            obs_np = np.asarray(obs, dtype=np.float64)
+            row = obs_np.sum(1, keepdims=True)
+            col = obs_np.sum(0, keepdims=True)
+            tot = max(obs_np.sum(), EPS_TOTAL_WEIGHT)
+            exp = row @ col / tot
+            live = (row > 0) & (col > 0)
+            stat = float(((obs_np - exp) ** 2 / np.where(live, exp, 1.0))[live].sum())
+            dof = max((int((row > 0).sum()) - 1) * (int((col > 0).sum()) - 1), 0)
+            p = float(_chi2_sf(jnp.float32(stat), jnp.float32(dof))) if dof > 0 else 1.0
+            stats.append(stat)
+            dofs.append(dof)
+            ps.append(p)
+        return ChiSquareResult(np.array(ps), np.array(dofs), np.array(stats))
+
+
+# ---------------------------------------------------------------- summarizer
+class Summary(NamedTuple):
+    mean: np.ndarray        # weighted mean per column
+    variance: np.ndarray    # unbiased weighted variance (MLlib convention)
+    std: np.ndarray
+    count: int              # live row count
+    weight_sum: float
+    num_non_zeros: np.ndarray
+    max: np.ndarray
+    min: np.ndarray
+    norm_l1: np.ndarray     # Σ w·|x|
+    norm_l2: np.ndarray     # sqrt(Σ w·x²)
+    sum: np.ndarray         # Σ w·x
+
+
+@jax.jit
+def _summary_kernel(X, w):
+    mean, var_pop, tot = weighted_moments(X, w)
+    wcol = w[:, None]
+    live = wcol > 0
+    count = jnp.sum(live.astype(jnp.float32)[:, 0])
+    # MLlib MultivariateOnlineSummarizer divides M2 by (Σw - 1): unbiased
+    var = var_pop * tot / jnp.maximum(tot - 1.0, EPS_TOTAL_WEIGHT)
+    nnz = jnp.sum((jnp.abs(X) > 0) & live, axis=0).astype(jnp.float32)
+    mx = jnp.max(jnp.where(live, X, -_BIG), axis=0)
+    mn = jnp.min(jnp.where(live, X, _BIG), axis=0)
+    l1 = jnp.sum(jnp.abs(X) * wcol, axis=0)
+    l2 = jnp.sqrt(jnp.sum(X * X * wcol, axis=0))
+    s = jnp.sum(X * wcol, axis=0)
+    return mean, var, count, tot, nnz, mx, mn, l1, l2, s
+
+
+class Summarizer:
+    """``pyspark.ml.stat.Summarizer`` equivalent — one fused pass."""
+
+    @staticmethod
+    def metrics(table: TpuTable) -> Summary:
+        mean, var, count, tot, nnz, mx, mn, l1, l2, s = _summary_kernel(
+            table.X, table.W
+        )
+        return Summary(
+            mean=np.asarray(mean), variance=np.asarray(var),
+            std=np.sqrt(np.maximum(np.asarray(var), 0.0)),
+            count=int(count), weight_sum=float(tot),
+            num_non_zeros=np.asarray(nnz), max=np.asarray(mx), min=np.asarray(mn),
+            norm_l1=np.asarray(l1), norm_l2=np.asarray(l2), sum=np.asarray(s),
+        )
+
+
+# ------------------------------------------------------ Kolmogorov–Smirnov
+class KSTestResult(NamedTuple):
+    p_value: float
+    statistic: float
+
+
+@jax.jit
+def _ks_kernel(x, w, mu, sigma):
+    """One-sample KS statistic vs Normal(mu, sigma) over live rows."""
+    N = x.shape[0]
+    live = w > 0
+    n = jnp.maximum(jnp.sum(live.astype(jnp.float32)), 1.0)
+    xs = jnp.sort(jnp.where(live, x, _BIG))           # live values first
+    cdf = jax.scipy.stats.norm.cdf(xs, loc=mu, scale=sigma)
+    i = jnp.arange(1, N + 1, dtype=jnp.float32)
+    in_range = i <= n                                  # ignore padding slots
+    d_plus = jnp.where(in_range, i / n - cdf, -1.0)
+    d_minus = jnp.where(in_range, cdf - (i - 1.0) / n, -1.0)
+    return jnp.maximum(jnp.max(d_plus), jnp.max(d_minus)), n
+
+
+def _ks_pvalue(d: float, n: float) -> float:
+    """Asymptotic Kolmogorov distribution tail, Q(√n·D)."""
+    t = (np.sqrt(n) + 0.12 + 0.11 / np.sqrt(n)) * d
+    j = np.arange(1, 101)
+    return float(np.clip(2.0 * np.sum((-1.0) ** (j - 1) * np.exp(-2.0 * j**2 * t**2)), 0.0, 1.0))
+
+
+class KolmogorovSmirnovTest:
+    """``pyspark.ml.stat.KolmogorovSmirnovTest.test`` equivalent ('norm')."""
+
+    @staticmethod
+    def test(table: TpuTable, col: str, dist: str = "norm",
+             loc: float = 0.0, scale: float = 1.0) -> KSTestResult:
+        if dist != "norm":
+            raise ValueError(f"only dist='norm' is supported, got {dist!r}")
+        d, n = _ks_kernel(table.column(col), table.W,
+                          jnp.float32(loc), jnp.float32(scale))
+        d, n = float(d), float(n)
+        return KSTestResult(p_value=_ks_pvalue(d, n), statistic=d)

@@ -1,0 +1,277 @@
+"""NaiveBayes / DecisionTree / OneVsRest / ml.stat vs sklearn+scipy (SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.datasets import make_classification
+from orange3_spark_tpu.models.decision_tree import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+)
+from orange3_spark_tpu.models.naive_bayes import NaiveBayes
+from orange3_spark_tpu.models.one_vs_rest import OneVsRest
+from orange3_spark_tpu.models.stat import (
+    ChiSquareTest,
+    Correlation,
+    KolmogorovSmirnovTest,
+    Summarizer,
+)
+
+
+def _counts_table(session, n=300, d=6, k=3, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, k, size=n)
+    # class-dependent Poisson rates -> multinomial-like count features
+    rates = rng.uniform(0.5, 5.0, size=(k, d))
+    X = rng.poisson(rates[y]).astype(np.float32)
+    domain = Domain(
+        [ContinuousVariable(f"f{i}") for i in range(d)],
+        DiscreteVariable("y", tuple(str(i) for i in range(k))),
+    )
+    return TpuTable.from_numpy(domain, X, y.astype(np.float32), session=session), X, y
+
+
+# ------------------------------------------------------------------ NaiveBayes
+def test_nb_multinomial_matches_sklearn(session):
+    t, X, y = _counts_table(session)
+    model = NaiveBayes(smoothing=1.0, model_type="multinomial").fit(t)
+
+    from sklearn.naive_bayes import MultinomialNB
+
+    sk = MultinomialNB(alpha=1.0).fit(X, y)
+    np.testing.assert_allclose(
+        model.predict_proba(t), sk.predict_proba(X), rtol=1e-3, atol=1e-4
+    )
+    assert np.mean(model.predict(t) == sk.predict(X)) == 1.0
+
+
+def test_nb_bernoulli_matches_sklearn(session):
+    rng = np.random.default_rng(1)
+    n, d = 400, 8
+    y = rng.integers(0, 2, size=n)
+    p = np.where(y[:, None] == 1, 0.7, 0.3)
+    X = (rng.uniform(size=(n, d)) < p).astype(np.float32)
+    t = TpuTable.from_arrays(X, y.astype(np.float32), class_values=("0", "1"))
+    model = NaiveBayes(smoothing=1.0, model_type="bernoulli").fit(t)
+
+    from sklearn.naive_bayes import BernoulliNB
+
+    sk = BernoulliNB(alpha=1.0).fit(X, y)
+    np.testing.assert_allclose(
+        model.predict_proba(t), sk.predict_proba(X), rtol=1e-3, atol=1e-4
+    )
+
+
+def test_nb_gaussian_matches_sklearn(session, iris):
+    model = NaiveBayes(model_type="gaussian").fit(iris)
+
+    from sklearn.naive_bayes import GaussianNB
+
+    X, Y, _ = iris.to_numpy()
+    sk = GaussianNB().fit(X, Y[:, 0])
+    assert np.mean(model.predict(iris) == sk.predict(X)) > 0.98
+
+
+def test_nb_complement_runs(session):
+    t, X, y = _counts_table(session, seed=3)
+    model = NaiveBayes(model_type="complement").fit(t)
+    assert np.mean(model.predict(t) == y) > 0.5
+
+
+def test_nb_rejects_negative_features(session):
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((50, 3)).astype(np.float32)
+    y = rng.integers(0, 2, 50).astype(np.float32)
+    t = TpuTable.from_arrays(X, y, class_values=("0", "1"))
+    with pytest.raises(ValueError, match="nonnegative"):
+        NaiveBayes(model_type="multinomial").fit(t)
+
+
+def test_nb_checkpoint_roundtrip(session, iris):
+    import pickle
+
+    model = NaiveBayes(model_type="gaussian").fit(iris)
+    clone = pickle.loads(pickle.dumps(model))
+    np.testing.assert_allclose(clone.predict(iris), model.predict(iris))
+
+
+# ---------------------------------------------------------------- DecisionTree
+def test_dt_classifier_iris(session, iris):
+    model = DecisionTreeClassifier(max_depth=4, max_bins=64).fit(iris)
+    X, Y, _ = iris.to_numpy()
+    assert np.mean(model.predict(iris) == Y[:, 0]) > 0.95
+
+
+def test_dt_classifier_close_to_sklearn(session):
+    t = make_classification(600, 6, n_classes=3, seed=9, noise=0.2, session=session)
+    model = DecisionTreeClassifier(max_depth=5, max_bins=64).fit(t)
+
+    from sklearn.tree import DecisionTreeClassifier as SkDT
+
+    X, Y, _ = t.to_numpy()
+    sk = SkDT(max_depth=5, random_state=0).fit(X, Y[:, 0])
+    ours = np.mean(model.predict(t) == Y[:, 0])
+    theirs = np.mean(sk.predict(X) == Y[:, 0])
+    assert ours > theirs - 0.05  # binned splits vs exact splits
+
+
+def test_dt_regressor(session):
+    rng = np.random.default_rng(2)
+    X = rng.uniform(-2, 2, size=(500, 3)).astype(np.float32)
+    y = (np.sign(X[:, 0]) + 0.5 * np.sign(X[:, 1])).astype(np.float32)
+    t = TpuTable.from_arrays(X, y)
+    model = DecisionTreeRegressor(max_depth=4, max_bins=32).fit(t)
+    pred = model.predict(t)
+    assert np.mean((pred - y) ** 2) < 0.05
+
+
+def test_dt_transform_appends_prediction(session, iris):
+    out = DecisionTreeClassifier(max_depth=3).fit(iris).transform(iris)
+    assert "prediction" in [v.name for v in out.domain.attributes]
+
+
+def test_dt_bad_impurity_raises(session, iris):
+    with pytest.raises(ValueError, match="gini"):
+        DecisionTreeClassifier(impurity="variance").fit(iris)
+
+
+# ------------------------------------------------------------------ OneVsRest
+def test_ovr_with_linear_svc(session, iris):
+    from orange3_spark_tpu.models.linear_svc import LinearSVC
+
+    model = OneVsRest(LinearSVC(max_iter=100, reg_param=0.01)).fit(iris)
+    X, Y, _ = iris.to_numpy()
+    assert np.mean(model.predict(iris) == Y[:, 0]) > 0.9
+
+
+def test_ovr_with_logreg_matches_direct_quality(session, iris):
+    from orange3_spark_tpu.models.logistic_regression import LogisticRegression
+
+    model = OneVsRest(LogisticRegression(max_iter=100)).fit(iris)
+    X, Y, _ = iris.to_numpy()
+    assert np.mean(model.predict(iris) == Y[:, 0]) > 0.93
+    assert len(model.models) == 3
+
+
+def test_ovr_transform_on_padded_table(session, iris):
+    from orange3_spark_tpu.models.logistic_regression import LogisticRegression
+
+    # iris has 150 rows -> padded to 152 on the 8-device mesh; transform must
+    # emit a full padded column, not crash on the length mismatch
+    model = OneVsRest(LogisticRegression(max_iter=50)).fit(iris)
+    out = model.transform(iris)
+    assert out.n_pad == iris.n_pad
+    assert "prediction" in [v.name for v in out.domain.attributes]
+
+
+def test_nb_bernoulli_rejects_non_binary(session):
+    rng = np.random.default_rng(11)
+    X = rng.integers(0, 3, size=(60, 4)).astype(np.float32)  # has 2s
+    y = rng.integers(0, 2, 60).astype(np.float32)
+    t = TpuTable.from_arrays(X, y, class_values=("0", "1"))
+    with pytest.raises(ValueError, match="0/1"):
+        NaiveBayes(model_type="bernoulli").fit(t)
+
+
+# -------------------------------------------------------------------- ml.stat
+def test_pearson_matches_numpy(session):
+    rng = np.random.default_rng(4)
+    X = rng.standard_normal((300, 5)).astype(np.float32)
+    X[:, 1] = 0.8 * X[:, 0] + 0.2 * X[:, 1]
+    t = TpuTable.from_arrays(X)
+    corr = Correlation.corr(t, "pearson")
+    np.testing.assert_allclose(corr, np.corrcoef(X.T), rtol=1e-3, atol=1e-4)
+
+
+def test_spearman_matches_scipy(session):
+    rng = np.random.default_rng(5)
+    X = rng.integers(0, 10, size=(200, 4)).astype(np.float32)  # heavy ties
+    t = TpuTable.from_arrays(X)
+    corr = Correlation.corr(t, "spearman")
+
+    from scipy.stats import spearmanr
+
+    ref = spearmanr(X).statistic
+    np.testing.assert_allclose(corr, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_spearman_ignores_padding_and_filtered(session):
+    # same live data, once bare (37 rows -> 3 padding slots) and once diluted
+    # with explicit zero-weight garbage rows -> identical ranks/correlation
+    rng = np.random.default_rng(6)
+    X = rng.standard_normal((37, 3)).astype(np.float32)
+    c1 = Correlation.corr(TpuTable.from_arrays(X), "spearman")
+    garbage = 100.0 * rng.standard_normal((11, 3)).astype(np.float32)
+    X2 = np.concatenate([X, garbage], axis=0)
+    W2 = np.concatenate([np.ones(37), np.zeros(11)]).astype(np.float32)
+    from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+
+    t2 = TpuTable.from_numpy(
+        Domain([ContinuousVariable(f"x{i}") for i in range(3)], None), X2, W=W2
+    )
+    c2 = Correlation.corr(t2, "spearman")
+    np.testing.assert_allclose(c1, c2, atol=1e-5)
+
+    from scipy.stats import spearmanr
+
+    np.testing.assert_allclose(c1, spearmanr(X).statistic, rtol=1e-3, atol=1e-4)
+
+
+def test_chi_square_matches_scipy(session):
+    rng = np.random.default_rng(7)
+    n = 500
+    y = rng.integers(0, 3, size=n)
+    f0 = (y + rng.integers(0, 2, size=n)) % 4       # dependent feature
+    f1 = rng.integers(0, 5, size=n)                 # independent feature
+    X = np.stack([f0, f1], axis=1).astype(np.float32)
+    domain = Domain(
+        [ContinuousVariable("f0"), ContinuousVariable("f1")],
+        DiscreteVariable("y", ("0", "1", "2")),
+    )
+    t = TpuTable.from_numpy(domain, X, y.astype(np.float32), session=session)
+    res = ChiSquareTest.test(t)
+
+    from scipy.stats import chi2_contingency
+
+    for j in range(2):
+        obs = np.zeros((int(X[:, j].max()) + 1, 3))
+        np.add.at(obs, (X[:, j].astype(int), y), 1.0)
+        obs = obs[obs.sum(1) > 0][:, obs.sum(0) > 0]
+        ref = chi2_contingency(obs, correction=False)
+        np.testing.assert_allclose(res.statistics[j], ref.statistic, rtol=1e-4)
+        np.testing.assert_allclose(res.p_values[j], ref.pvalue, rtol=1e-3, atol=1e-6)
+    assert res.p_values[0] < 0.01 < res.p_values[1]
+
+
+def test_summarizer(session):
+    rng = np.random.default_rng(8)
+    X = rng.standard_normal((123, 4)).astype(np.float32)
+    X[X < -1.5] = 0.0
+    t = TpuTable.from_arrays(X)
+    s = Summarizer.metrics(t)
+    np.testing.assert_allclose(s.mean, X.mean(0), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(s.variance, X.var(0, ddof=1), rtol=1e-3, atol=1e-5)
+    assert s.count == 123
+    np.testing.assert_allclose(s.num_non_zeros, (X != 0).sum(0))
+    np.testing.assert_allclose(s.max, X.max(0), rtol=1e-5)
+    np.testing.assert_allclose(s.min, X.min(0), rtol=1e-5)
+    np.testing.assert_allclose(s.norm_l1, np.abs(X).sum(0), rtol=1e-4)
+    np.testing.assert_allclose(s.norm_l2, np.sqrt((X**2).sum(0)), rtol=1e-4)
+
+
+def test_ks_test_matches_scipy(session):
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal(400).astype(np.float32)[:, None]
+    t = TpuTable.from_arrays(x)
+    res = KolmogorovSmirnovTest.test(t, "x0", "norm", loc=0.0, scale=1.0)
+
+    from scipy.stats import kstest
+
+    ref = kstest(x[:, 0], "norm")
+    np.testing.assert_allclose(res.statistic, ref.statistic, rtol=1e-3, atol=1e-5)
+    assert abs(res.p_value - ref.pvalue) < 0.02  # asymptotic vs exact tail
+    # a shifted normal must be strongly rejected
+    res2 = KolmogorovSmirnovTest.test(t, "x0", "norm", loc=2.0, scale=1.0)
+    assert res2.p_value < 1e-6
