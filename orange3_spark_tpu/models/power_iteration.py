@@ -1,0 +1,136 @@
+"""PowerIterationClustering — parity with ``pyspark.ml.clustering.PowerIterationClustering``.
+
+MLlib's PIC (Lin & Cohen 2010) runs power iteration on the degree-normalized
+affinity matrix of a similarity graph, then k-means on the resulting
+pseudo-eigenvector (SURVEY.md §2b; reconstructed, mount empty — public API:
+k, maxIter, initMode 'random'|'degree', srcCol/dstCol/weightCol;
+``assignClusters(dataset) -> (id, cluster)``). TPU-native redesign:
+
+* the graph stays in **edge-list COO form**; the sparse matvec
+  ``v' = D⁻¹ A v`` is a gather + ``segment_sum`` over edges — XLA lowers
+  both to efficient one-pass scatter/gather kernels, and the edge axis can be
+  sharded with the segment ids psum-reduced across devices;
+* the power loop is one jitted ``lax.fori_loop`` (normalize with an
+  all-reduced L1 norm each step — MLlib's exact update);
+* the final 1-D k-means reuses the jitted Lloyd kernel from ``kmeans.py``.
+
+Edges are treated as undirected (both directions inserted), matching MLlib's
+symmetric-affinity requirement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models.base import Params
+from orange3_spark_tpu.models.kmeans import _assign, _lloyd
+
+
+@dataclasses.dataclass(frozen=True)
+class PowerIterationClusteringParams(Params):
+    k: int = 2                 # MLlib k
+    max_iter: int = 20         # MLlib maxIter
+    init_mode: str = "random"  # MLlib initMode: 'random' | 'degree'
+    seed: int = 0
+    src_col: str = "src"
+    dst_col: str = "dst"
+    weight_col: str = "weight"
+
+
+@partial(jax.jit, static_argnames=("n", "max_iter"))
+def _power_iterate(src, dst, w, v0, *, n: int, max_iter: int):
+    deg = jax.ops.segment_sum(w, src, num_segments=n)
+    inv_deg = jnp.where(deg > 0, 1.0 / jnp.maximum(deg, 1e-30), 0.0)
+
+    def body(_, v):
+        # v' = D^-1 A v : gather neighbor values, weight, reduce per source row
+        contrib = w * v[dst]
+        av = jax.ops.segment_sum(contrib, src, num_segments=n)
+        v = inv_deg * av
+        return v / jnp.maximum(jnp.sum(jnp.abs(v)), 1e-30)
+
+    return jax.lax.fori_loop(0, max_iter, body, v0)
+
+
+class PowerIterationClustering:
+    """Not an Estimator — mirrors MLlib, where PIC has only assignClusters()."""
+
+    ParamsCls = PowerIterationClusteringParams
+
+    def __init__(self, params: PowerIterationClusteringParams | None = None, **kwargs):
+        if params is None:
+            params = PowerIterationClusteringParams(**kwargs)
+        elif kwargs:
+            params = params.replace(**kwargs)
+        self.params = params
+
+    def assign_clusters(self, dataset) -> np.ndarray:
+        """dataset: TpuTable with src/dst/weight attribute columns, or a
+        (src, dst, weight) triple of arrays. Returns int cluster id per vertex
+        (index = vertex id), the (id, cluster) frame of MLlib."""
+        p = self.params
+        if isinstance(dataset, TpuTable):
+            names = [v.name for v in dataset.domain.attributes]
+            X = np.asarray(jax.device_get(dataset.X))[: dataset.n_rows]
+            live = np.asarray(jax.device_get(dataset.W))[: dataset.n_rows] > 0
+            X = X[live]  # honor filter(): W==0 edges must not shape the graph
+            src = X[:, names.index(p.src_col)].astype(np.int64)
+            dst = X[:, names.index(p.dst_col)].astype(np.int64)
+            if len(src) and max(src.max(), dst.max()) >= (1 << 24):
+                # f32 storage cannot represent ids above 2^24 exactly —
+                # distinct vertices would silently collapse
+                raise ValueError(
+                    "vertex ids >= 2^24 cannot come from float32 table columns; "
+                    "pass (src, dst, weight) integer arrays instead"
+                )
+            w = (
+                X[:, names.index(p.weight_col)].astype(np.float32)
+                if p.weight_col in names
+                else np.ones(len(src), dtype=np.float32)
+            )
+        else:
+            src, dst, w = dataset
+            src = np.asarray(src, dtype=np.int64)
+            dst = np.asarray(dst, dtype=np.int64)
+            w = (np.ones(len(src), dtype=np.float32) if w is None
+                 else np.asarray(w, dtype=np.float32))
+        if np.any(w < 0):
+            raise ValueError("PIC requires nonnegative similarities")
+        n = int(max(src.max(), dst.max())) + 1 if len(src) else 0
+        if n == 0:
+            return np.zeros((0,), dtype=np.int64)
+        # symmetrize: undirected affinity
+        s2 = np.concatenate([src, dst])
+        d2 = np.concatenate([dst, src])
+        w2 = np.concatenate([w, w])
+        deg = np.zeros(n, dtype=np.float64)
+        np.add.at(deg, s2, w2)
+        rng = np.random.default_rng(p.seed)
+        if p.init_mode == "degree":
+            v0 = (deg / max(deg.sum(), 1e-30)).astype(np.float32)
+        elif p.init_mode == "random":
+            v0 = rng.random(n).astype(np.float32)
+            v0 /= max(np.abs(v0).sum(), 1e-30)
+        else:
+            raise ValueError(f"unknown init_mode {p.init_mode!r}")
+        v = _power_iterate(
+            jnp.asarray(s2), jnp.asarray(d2), jnp.asarray(w2), jnp.asarray(v0),
+            n=n, max_iter=p.max_iter,
+        )
+        # 1-D k-means on the pseudo-eigenvector
+        vv = v[:, None]
+        live = np.ones(n, dtype=np.float32)
+        q = np.quantile(np.asarray(v), np.linspace(0.05, 0.95, p.k))
+        centers0 = jnp.asarray(q[:, None].astype(np.float32))
+        centers, _, _, _ = _lloyd(
+            vv, jnp.asarray(live), centers0, jnp.float32(1e-6),
+            k=p.k, max_iter=50,
+        )
+        assign, _ = _assign(vv, centers, jnp.asarray(live))
+        return np.asarray(assign).astype(np.int64)

@@ -1,0 +1,149 @@
+"""GaussianMixture / BisectingKMeans / LDA / PIC vs sklearn numerics (SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.datasets import make_blobs
+from orange3_spark_tpu.models.bisecting_kmeans import BisectingKMeans
+from orange3_spark_tpu.models.gaussian_mixture import GaussianMixture
+from orange3_spark_tpu.models.lda import LDA
+from orange3_spark_tpu.models.power_iteration import PowerIterationClustering
+
+
+def _cluster_purity(pred, true, k):
+    """Fraction of rows in the majority true-label of their predicted cluster."""
+    hit = 0
+    for c in range(k):
+        m = pred == c
+        if m.sum():
+            hit += np.bincount(true[m].astype(int)).max()
+    return hit / len(true)
+
+
+# --------------------------------------------------------------------- GMM
+def test_gmm_recovers_blobs(session):
+    t, true = make_blobs(600, 4, 3, seed=11, spread=0.6, session=session)
+    model = GaussianMixture(k=3, max_iter=100, seed=3).fit(t)
+    pred = model.predict(t)
+    assert _cluster_purity(pred, true, 3) > 0.95
+    w = np.asarray(model.weights)
+    np.testing.assert_allclose(w.sum(), 1.0, atol=1e-4)
+    assert model.log_likelihood_ is not None
+
+
+def test_gmm_predict_probability_rows_sum_to_one(session):
+    t, _ = make_blobs(300, 3, 2, seed=12, session=session)
+    model = GaussianMixture(k=2, max_iter=50).fit(t)
+    probs = model.predict_probability(t)
+    assert probs.shape == (300, 2)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_gmm_vs_sklearn_loglik(session):
+    from sklearn.mixture import GaussianMixture as SkGMM
+
+    t, _ = make_blobs(400, 3, 3, seed=13, spread=1.0, session=session)
+    X = t.to_numpy()[0]
+    ours = GaussianMixture(k=3, max_iter=200, tol=1e-5, seed=1).fit(t)
+    sk = SkGMM(n_components=3, max_iter=200, tol=1e-5, random_state=1).fit(X)
+    # mean per-row log-likelihood should be near sklearn's
+    ours_ll = ours.log_likelihood(t) / 400.0
+    assert abs(ours_ll - sk.score(X)) < 0.2
+
+
+def test_gmm_transform_appends(session):
+    t, _ = make_blobs(200, 3, 2, seed=14, session=session)
+    out = GaussianMixture(k=2, max_iter=30).fit(t).transform(t)
+    names = [v.name for v in out.domain.attributes]
+    assert "prediction" in names and "probability_0" in names
+
+
+# ------------------------------------------------------- BisectingKMeans
+def test_bisecting_kmeans_recovers_blobs(session):
+    t, true = make_blobs(600, 4, 4, seed=21, spread=0.8, session=session)
+    model = BisectingKMeans(k=4, seed=2).fit(t)
+    pred = model.predict(t)
+    assert model.cluster_centers_.shape == (4, 4)
+    assert _cluster_purity(pred, true, 4) > 0.9
+    assert model.training_cost_ is not None and model.training_cost_ >= 0
+
+
+def test_bisecting_kmeans_fewer_rows_than_k(session):
+    X = np.array([[0.0, 0.0], [10.0, 10.0], [0.1, 0.1]], dtype=np.float32)
+    t = TpuTable.from_arrays(X, session=session)
+    model = BisectingKMeans(k=8).fit(t)
+    # degenerate: stops early with <= n clusters, predictions still valid
+    pred = model.predict(t)
+    assert len(pred) == 3
+
+
+# ------------------------------------------------------------------- LDA
+def _toy_corpus(session, n_docs=200, vocab=30, k=3, seed=5):
+    """Docs drawn from k disjoint topic blocks over the vocab."""
+    rng = np.random.default_rng(seed)
+    block = vocab // k
+    X = np.zeros((n_docs, vocab), dtype=np.float32)
+    labels = rng.integers(k, size=n_docs)
+    for i, z in enumerate(labels):
+        words = rng.integers(z * block, (z + 1) * block, size=50)
+        np.add.at(X[i], words, 1.0)
+    return TpuTable.from_arrays(X, session=session), labels
+
+
+def test_lda_topics_separate_blocks(session):
+    t, labels = _toy_corpus(session)
+    model = LDA(k=3, max_iter=30, seed=7).fit(t)
+    tm = model.topics_matrix()  # [V,k]
+    assert tm.shape == (30, 3)
+    np.testing.assert_allclose(tm.sum(axis=0), 1.0, atol=1e-3)
+    # each learned topic should concentrate on one vocab block
+    for c in range(3):
+        top = np.argsort(tm[:, c])[::-1][:5]
+        blocks = top // 10
+        assert (blocks == blocks[0]).mean() > 0.7
+
+
+def test_lda_transform_and_perplexity(session):
+    t, labels = _toy_corpus(session, n_docs=150)
+    model = LDA(k=3, max_iter=30, seed=7).fit(t)
+    out = model.transform(t)
+    names = [v.name for v in out.domain.attributes]
+    assert "topicDistribution_0" in names
+    X = out.to_numpy()[0]
+    theta = X[:, -3:]
+    np.testing.assert_allclose(theta.sum(axis=1), 1.0, atol=1e-3)
+    # docs from the same block should have similar dominant topics
+    dom = theta.argmax(axis=1)
+    assert _cluster_purity(dom, labels, 3) > 0.8
+    lp = model.log_perplexity(t)
+    assert np.isfinite(lp) and lp > 0
+
+
+def test_lda_describe_topics(session):
+    t, _ = _toy_corpus(session, n_docs=100)
+    model = LDA(k=3, max_iter=20, seed=7).fit(t)
+    desc = model.describe_topics(max_terms=4)
+    assert len(desc) == 3
+    assert len(desc[0]["termIndices"]) == 4
+
+
+# ------------------------------------------------------------------- PIC
+def test_pic_two_cliques():
+    rng = np.random.default_rng(3)
+    # two 15-node cliques joined by a single weak edge
+    src, dst = [], []
+    for base in (0, 15):
+        for i in range(15):
+            for j in range(i + 1, 15):
+                src.append(base + i)
+                dst.append(base + j)
+    src.append(0)
+    dst.append(15)
+    w = np.ones(len(src), dtype=np.float32)
+    w[-1] = 0.01
+    pic = PowerIterationClustering(k=2, max_iter=30, init_mode="random", seed=0)
+    assign = pic.assign_clusters((np.array(src), np.array(dst), w))
+    a, b = assign[:15], assign[15:]
+    assert len(np.unique(a)) == 1 and len(np.unique(b)) == 1
+    assert a[0] != b[0]
