@@ -32,6 +32,45 @@ class LinearFitResult(NamedTuple):
     final_loss: jax.Array # []
 
 
+def lbfgs_minimize(value_fn, theta0, tol, max_iter, *, memory_size: int = 10):
+    """Shared fused L-BFGS driver: minimize value_fn over the theta0 pytree
+    inside one ``lax.while_loop`` (optax.lbfgs + zoom linesearch). Returns
+    (theta, n_iter, final_value). Trace-time only — call from inside jit.
+
+    This is the one implementation of the optimizer loop; fit_linear, AFT,
+    and the MLP trainer all route through it.
+    """
+    opt = optax.lbfgs(memory_size=memory_size)
+    value_and_grad = optax.value_and_grad_from_state(value_fn)
+
+    def step(carry):
+        theta, state = carry
+        value, grad = value_and_grad(theta, state=state)
+        updates, state = opt.update(
+            grad, state, theta, value=value, grad=grad, value_fn=value_fn
+        )
+        theta = optax.apply_updates(theta, updates)
+        return theta, state
+
+    def keep_going(carry):
+        _, state = carry
+        count = otu.tree_get(state, "count")
+        grad = otu.tree_get(state, "grad")
+        gnorm = otu.tree_norm(grad)
+        # first iteration always runs (grad in fresh state is zero), but
+        # max_iter=0 must return the zero init, matching MLlib maxIter=0
+        return (max_iter > 0) & ((count == 0) | ((count < max_iter) & (gnorm > tol)))
+
+    theta, state = jax.lax.while_loop(keep_going, step, (theta0, opt.init(theta0)))
+    n_iter = otu.tree_get(state, "count")
+    # converged loss is already in the linesearch state; only the max_iter=0
+    # path (state still holds optax's inf sentinel) pays a fresh evaluation
+    final_value = jax.lax.cond(
+        n_iter == 0, lambda: value_fn(theta), lambda: otu.tree_get(state, "value")
+    )
+    return theta, n_iter, final_value
+
+
 def _make_objective(loss_kind: str, fit_intercept: bool, compute_dtype):
     """Builds loss(theta, X, y, w, reg_l2, sum_w) -> scalar.
 
@@ -112,33 +151,8 @@ def fit_linear(
     def value_fn(theta):
         return objective(theta, X, y, w, reg_l2, sum_w, col_scale)
 
-    opt = optax.lbfgs(memory_size=memory_size)
-    value_and_grad = optax.value_and_grad_from_state(value_fn)
-
-    def step(carry):
-        theta, state = carry
-        value, grad = value_and_grad(theta, state=state)
-        updates, state = opt.update(
-            grad, state, theta, value=value, grad=grad, value_fn=value_fn
-        )
-        theta = optax.apply_updates(theta, updates)
-        return theta, state
-
-    def keep_going(carry):
-        _, state = carry
-        count = otu.tree_get(state, "count")
-        grad = otu.tree_get(state, "grad")
-        gnorm = otu.tree_norm(grad)
-        # first iteration always runs (grad in fresh state is zero), but
-        # max_iter=0 must return the zero init, matching MLlib maxIter=0
-        return (max_iter > 0) & ((count == 0) | ((count < max_iter) & (gnorm > tol)))
-
-    theta, state = jax.lax.while_loop(keep_going, step, (theta0, opt.init(theta0)))
-    n_iter = otu.tree_get(state, "count")
-    # converged loss is already in the linesearch state; only the max_iter=0
-    # path (state still holds optax's inf sentinel) pays a fresh evaluation
-    final_loss = jax.lax.cond(
-        n_iter == 0, lambda: value_fn(theta), lambda: otu.tree_get(state, "value")
+    theta, n_iter, final_loss = lbfgs_minimize(
+        value_fn, theta0, tol, max_iter, memory_size=memory_size
     )
     return LinearFitResult(
         coef=theta["coef"],

@@ -1,0 +1,132 @@
+"""AFTSurvivalRegression — parity with ``pyspark.ml.regression.AFTSurvivalRegression``.
+
+MLlib fits a Weibull accelerated-failure-time model by L-BFGS on the
+censored log-likelihood, one treeAggregate of (loss, grad) per iteration
+(SURVEY.md §2b; reconstructed, mount empty — public API: censorCol (1 =
+event/uncensored, 0 = right-censored), quantileProbabilities, quantilesCol,
+maxIter=100, tol=1e-6, fitIntercept; model exposes coefficients, intercept,
+scale, predict = exp(x·b + b0), predictQuantiles). TPU-native redesign: the
+entire L-BFGS loop (optax.lbfgs with zoom linesearch) runs inside one jitted
+``lax.while_loop``; the row-axis loss contraction GSPMD all-reduces over ICI
+— same fused-trainer shape as ``_linear.fit_linear`` with the AFT loss:
+
+    eps_i = (log t_i - x_i·beta - b0) / sigma
+    logL  = sum_i  delta_i * (eps_i - log sigma) - exp(eps_i)
+
+optimized over (beta, b0, log sigma) — log-parameterizing sigma keeps the
+problem unconstrained exactly as MLlib does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from orange3_spark_tpu.models._linear import lbfgs_minimize
+from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models.base import Estimator, Model, Params
+
+
+@dataclasses.dataclass(frozen=True)
+class AFTSurvivalRegressionParams(Params):
+    censor_col: str = "censor"   # MLlib censorCol (1=event, 0=censored)
+    max_iter: int = 100          # MLlib maxIter
+    tol: float = 1e-6            # MLlib tol
+    fit_intercept: bool = True
+    quantile_probabilities: tuple = (0.01, 0.05, 0.1, 0.25, 0.5,
+                                     0.75, 0.9, 0.95, 0.99)  # MLlib default
+
+
+@partial(jax.jit, static_argnames=("fit_intercept", "max_iter"))
+def _fit_aft(X, logt, delta, w, tol, *, fit_intercept: bool, max_iter: int):
+    d = X.shape[1]
+    sum_w = jnp.maximum(jnp.sum(w), 1e-12)
+
+    def neg_loglik(theta):
+        eta = X @ theta["beta"] + (theta["b0"] if fit_intercept else 0.0)
+        log_sigma = theta["log_sigma"]
+        eps = (logt - eta) * jnp.exp(-log_sigma)
+        # guard exp overflow on padding rows (w=0 zeroes them anyway)
+        ll_rows = delta * (eps - log_sigma) - jnp.exp(jnp.clip(eps, -50.0, 50.0))
+        return -jnp.sum(w * ll_rows) / sum_w
+
+    theta0 = {
+        "beta": jnp.zeros((d,), jnp.float32),
+        "b0": jnp.float32(0.0),
+        "log_sigma": jnp.float32(0.0),
+    }
+    theta, n_iter, _ = lbfgs_minimize(neg_loglik, theta0, tol, max_iter)
+    return theta, n_iter
+
+
+class AFTSurvivalRegressionModel(Model):
+    def __init__(self, params, coef, intercept, scale, feature_indices=None):
+        self.params = params
+        self.coef = coef            # f32[d]
+        self.intercept = intercept  # f32[]
+        self.scale = scale          # f32[] Weibull scale sigma
+        self.feature_indices = feature_indices  # columns used (censor col excluded)
+        self.n_iter_: int | None = None
+
+    def _features(self, table: TpuTable):
+        if self.feature_indices is None:
+            return table.X
+        return table.X[:, jnp.asarray(self.feature_indices)]
+
+    @property
+    def state_pytree(self):
+        return {"coef": self.coef, "intercept": self.intercept, "scale": self.scale}
+
+    def predict(self, table: TpuTable) -> np.ndarray:
+        """Expected scale of survival time: exp(x·b + b0) (MLlib predict)."""
+        eta = self._features(table) @ self.coef + self.intercept
+        return np.asarray(jnp.exp(eta))[: table.n_rows]
+
+    def predict_quantiles(self, table: TpuTable) -> np.ndarray:
+        """MLlib predictQuantiles: t_p = exp(eta) * (-log(1-p))^sigma."""
+        eta = self._features(table) @ self.coef + self.intercept
+        probs = jnp.asarray(self.params.quantile_probabilities, dtype=jnp.float32)
+        q = jnp.exp(eta)[:, None] * (-jnp.log1p(-probs)) ** self.scale
+        return np.asarray(q)[: table.n_rows]
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        eta = self._features(table) @ self.coef + self.intercept
+        new_attrs = list(table.domain.attributes) + [ContinuousVariable("prediction")]
+        new_domain = Domain(new_attrs, table.domain.class_vars, table.domain.metas)
+        return table.with_X(
+            jnp.concatenate([table.X, jnp.exp(eta)[:, None]], axis=1), new_domain
+        )
+
+
+class AFTSurvivalRegression(Estimator):
+    ParamsCls = AFTSurvivalRegressionParams
+    params: AFTSurvivalRegressionParams
+
+    def _fit(self, table: TpuTable) -> AFTSurvivalRegressionModel:
+        p = self.params
+        if table.y is None:
+            raise ValueError("AFTSurvivalRegression needs a survival-time target")
+        names = [v.name for v in table.domain.attributes]
+        if p.censor_col not in names:
+            raise ValueError(
+                f"censor column {p.censor_col!r} not among attributes {names}"
+            )
+        ci = names.index(p.censor_col)
+        delta = table.X[:, ci]
+        keep = [i for i in range(len(names)) if i != ci]
+        X = table.X[:, jnp.asarray(keep)]
+        logt = jnp.log(jnp.maximum(table.y, 1e-12))
+        theta, n_iter = _fit_aft(
+            X, logt, delta, table.W, jnp.float32(p.tol),
+            fit_intercept=p.fit_intercept, max_iter=p.max_iter,
+        )
+        model = AFTSurvivalRegressionModel(
+            p, theta["beta"], theta["b0"], jnp.exp(theta["log_sigma"]),
+            feature_indices=keep,
+        )
+        model.n_iter_ = int(n_iter)
+        return model

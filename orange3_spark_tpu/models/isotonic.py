@@ -1,0 +1,121 @@
+"""IsotonicRegression — parity with ``pyspark.ml.regression.IsotonicRegression``.
+
+MLlib runs pool-adjacent-violators (PAV) per partition then a final merge on
+the driver (SURVEY.md §2b; reconstructed, mount empty — public API:
+isotonic=True|False (antitonic), featureIndex, weightCol; model exposes
+``boundaries``, ``predictions``, and transform = linear interpolation between
+boundaries). TPU-native placement decision:
+
+* PAV's pooling is inherently sequential, data-dependent control flow —
+  O(n) pointer-chasing, zero FLOPs. Tracing that into XLA would serialize
+  the TPU; MLlib itself finishes the merge single-threaded on the driver.
+  So the FIT runs host-side on a stack-based O(n) numpy PAV (the driver-
+  merge role), after a device-side sort key extraction.
+* TRANSFORM (the hot path — scoring N rows) IS jitted: a
+  ``jnp.searchsorted`` + linear interpolation over the fitted boundary
+  arrays, fully batched and shardable over rows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models.base import Estimator, Model, Params
+
+
+@dataclasses.dataclass(frozen=True)
+class IsotonicRegressionParams(Params):
+    isotonic: bool = True    # MLlib isotonic: True=nondecreasing, False=antitonic
+    feature_index: int = 0   # MLlib featureIndex
+
+
+def _pav(x: np.ndarray, y: np.ndarray, w: np.ndarray):
+    """Stack-based pool-adjacent-violators on (x-sorted) data. O(n)."""
+    # blocks: (mean, weight, x_lo, x_hi)
+    means: list[float] = []
+    weights: list[float] = []
+    x_lo: list[float] = []
+    x_hi: list[float] = []
+    for xi, yi, wi in zip(x, y, w):
+        means.append(float(yi))
+        weights.append(float(wi))
+        x_lo.append(float(xi))
+        x_hi.append(float(xi))
+        while len(means) > 1 and means[-2] > means[-1]:
+            m2, w2 = means.pop(), weights.pop()
+            hi = x_hi.pop(); x_lo.pop()
+            m1, w1 = means[-1], weights[-1]
+            tot = w1 + w2
+            means[-1] = (m1 * w1 + m2 * w2) / tot if tot > 0 else (m1 + m2) / 2
+            weights[-1] = tot
+            x_hi[-1] = hi
+    bx, by = [], []
+    for m, lo, hi in zip(means, x_lo, x_hi):
+        bx.append(lo)
+        by.append(m)
+        if hi > lo:
+            bx.append(hi)
+            by.append(m)
+    return np.asarray(bx, dtype=np.float32), np.asarray(by, dtype=np.float32)
+
+
+@jax.jit
+def _interp(x, bx, by):
+    """Piecewise-linear interpolation with flat extrapolation (MLlib semantics)."""
+    return jnp.interp(x, bx, by)
+
+
+class IsotonicRegressionModel(Model):
+    def __init__(self, params, boundaries, predictions):
+        self.params = params
+        self.boundaries = boundaries    # f32[m] ascending feature values
+        self.predictions = predictions  # f32[m] fitted values at boundaries
+
+    @property
+    def state_pytree(self):
+        return {"boundaries": self.boundaries, "predictions": self.predictions}
+
+    def predict(self, table: TpuTable) -> np.ndarray:
+        x = table.X[:, self.params.feature_index]
+        return np.asarray(_interp(x, self.boundaries, self.predictions))[: table.n_rows]
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        x = table.X[:, self.params.feature_index]
+        pred = _interp(x, self.boundaries, self.predictions)
+        new_attrs = list(table.domain.attributes) + [ContinuousVariable("prediction")]
+        new_domain = Domain(new_attrs, table.domain.class_vars, table.domain.metas)
+        return table.with_X(
+            jnp.concatenate([table.X, pred[:, None]], axis=1), new_domain
+        )
+
+
+class IsotonicRegression(Estimator):
+    ParamsCls = IsotonicRegressionParams
+    params: IsotonicRegressionParams
+
+    def _fit(self, table: TpuTable) -> IsotonicRegressionModel:
+        p = self.params
+        if table.y is None:
+            raise ValueError("IsotonicRegression needs a target column")
+        x = np.asarray(jax.device_get(table.X[:, p.feature_index]))
+        y = np.asarray(jax.device_get(table.y))
+        w = np.asarray(jax.device_get(table.W))
+        live = w > 0
+        x, y, w = x[live], y[live], w[live]
+        if not p.isotonic:
+            y = -y
+        order = np.argsort(x, kind="stable")
+        bx, by = _pav(x[order], y[order], w[order])
+        if not p.isotonic:
+            by = -by
+        rep = table.session.replicated
+        return IsotonicRegressionModel(
+            p, jax.device_put(bx, rep), jax.device_put(by, rep)
+        )

@@ -1,0 +1,205 @@
+"""GLM / Isotonic / AFT / FM / MLP vs reference numerics (SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.datasets import make_classification
+from orange3_spark_tpu.models.aft import AFTSurvivalRegression
+from orange3_spark_tpu.models.fm import FMClassifier, FMRegressor
+from orange3_spark_tpu.models.glm import GeneralizedLinearRegression
+from orange3_spark_tpu.models.isotonic import IsotonicRegression
+from orange3_spark_tpu.models.mlp import MultilayerPerceptronClassifier
+
+
+# ------------------------------------------------------------------- GLM
+def test_glm_gaussian_matches_ols(session):
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((300, 4)).astype(np.float32)
+    y = X @ np.array([1.0, -2.0, 0.5, 3.0], np.float32) + 1.5
+    t = TpuTable.from_arrays(X, y, session=session)
+    m = GeneralizedLinearRegression(family="gaussian").fit(t)
+    from sklearn.linear_model import LinearRegression as Sk
+
+    sk = Sk().fit(X, y)
+    np.testing.assert_allclose(np.asarray(m.coef), sk.coef_, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(float(m.intercept), sk.intercept_, rtol=1e-3)
+    assert m.deviance_ is not None and m.null_deviance_ > m.deviance_
+
+
+def test_glm_poisson_log_link(session):
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((2000, 3)).astype(np.float32)
+    true_b = np.array([0.3, -0.5, 0.2], np.float32)
+    lam = np.exp(X @ true_b + 0.7)
+    y = rng.poisson(lam).astype(np.float32)
+    t = TpuTable.from_arrays(X, y, session=session)
+    m = GeneralizedLinearRegression(family="poisson", max_iter=50).fit(t)
+    np.testing.assert_allclose(np.asarray(m.coef), true_b, atol=0.08)
+    np.testing.assert_allclose(float(m.intercept), 0.7, atol=0.08)
+    pred = m.predict(t)
+    assert np.all(pred > 0)  # means on the response scale
+
+
+def test_glm_binomial_matches_sklearn_logreg(session):
+    t = make_classification(600, 5, n_classes=2, seed=3, noise=0.3, session=session)
+    X, Y, _ = t.to_numpy()
+    y = Y[:, 0]
+    m = GeneralizedLinearRegression(family="binomial", max_iter=50).fit(
+        TpuTable.from_arrays(X, y, session=session)
+    )
+    from sklearn.linear_model import LogisticRegression as Sk
+
+    sk = Sk(penalty=None, max_iter=500).fit(X, y)
+    np.testing.assert_allclose(np.asarray(m.coef), sk.coef_[0], rtol=0.05, atol=0.05)
+    # predictions are probabilities
+    p = m.predict(TpuTable.from_arrays(X, y, session=session))
+    assert np.all((p >= 0) & (p <= 1))
+    acc = np.mean((p > 0.5) == y)
+    assert acc > 0.85
+
+
+def test_glm_gamma_inverse_link_runs(session):
+    rng = np.random.default_rng(2)
+    X = rng.uniform(0.5, 1.5, size=(400, 2)).astype(np.float32)
+    mu = 1.0 / (0.5 + 0.3 * X[:, 0] + 0.4 * X[:, 1])
+    y = (mu * rng.gamma(5.0, 0.2, size=400)).astype(np.float32)
+    t = TpuTable.from_arrays(X, y, session=session)
+    m = GeneralizedLinearRegression(family="gamma", max_iter=50).fit(t)
+    assert np.all(np.isfinite(np.asarray(m.coef)))
+    assert m.dispersion_ is not None
+
+
+def test_glm_tweedie_power_link(session):
+    rng = np.random.default_rng(4)
+    X = rng.standard_normal((500, 2)).astype(np.float32)
+    y = np.exp(0.4 * X[:, 0] + 0.1) * rng.gamma(3.0, 1 / 3.0, 500).astype(np.float32)
+    t = TpuTable.from_arrays(X, y.astype(np.float32), session=session)
+    m = GeneralizedLinearRegression(
+        family="tweedie", variance_power=1.5, link_power=0.0, max_iter=40
+    ).fit(t)
+    assert np.isfinite(m.deviance_)
+
+
+def test_glm_transform_appends(session):
+    rng = np.random.default_rng(5)
+    X = rng.standard_normal((100, 2)).astype(np.float32)
+    y = (X[:, 0] + 0.1).astype(np.float32)
+    t = TpuTable.from_arrays(X, y, session=session)
+    out = GeneralizedLinearRegression().fit(t).transform(t)
+    names = [v.name for v in out.domain.attributes]
+    assert "prediction" in names and "linkPrediction" in names
+
+
+# -------------------------------------------------------------- Isotonic
+def test_isotonic_matches_sklearn(session):
+    rng = np.random.default_rng(6)
+    x = rng.uniform(0, 10, 200).astype(np.float32)
+    y = (x + rng.standard_normal(200)).astype(np.float32)
+    t = TpuTable.from_arrays(x[:, None], y, session=session)
+    m = IsotonicRegression().fit(t)
+    pred = m.predict(t)
+    from sklearn.isotonic import IsotonicRegression as Sk
+
+    sk_pred = Sk(out_of_bounds="clip").fit(x, y).predict(x)
+    np.testing.assert_allclose(pred, sk_pred, atol=1e-3)
+    # fitted values must be nondecreasing in x
+    order = np.argsort(x)
+    assert np.all(np.diff(pred[order]) >= -1e-5)
+
+
+def test_isotonic_antitonic(session):
+    x = np.arange(50, dtype=np.float32)
+    y = -x + np.sin(x).astype(np.float32)
+    t = TpuTable.from_arrays(x[:, None], y, session=session)
+    pred = IsotonicRegression(isotonic=False).fit(t).predict(t)
+    assert np.all(np.diff(pred) <= 1e-5)
+
+
+def test_isotonic_respects_weights(session):
+    x = np.array([0.0, 1.0, 2.0], np.float32)
+    y = np.array([0.0, 5.0, 1.0], np.float32)
+    w = np.array([1.0, 1.0, 100.0], np.float32)
+    from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+
+    dom = Domain([ContinuousVariable("x")], ContinuousVariable("y"))
+    t = TpuTable.from_numpy(dom, x[:, None], y, W=w, session=session)
+    pred = IsotonicRegression().fit(t).predict(t)
+    # heavy third point drags the pooled block toward 1
+    assert pred[2] < 2.0
+
+
+# ------------------------------------------------------------------- AFT
+def test_aft_recovers_scale_model(session):
+    rng = np.random.default_rng(7)
+    n = 1500
+    x = rng.standard_normal((n, 2)).astype(np.float32)
+    true_b = np.array([0.8, -0.5], np.float32)
+    sigma = 0.5
+    t_event = np.exp(x @ true_b + 1.0 + sigma * np.log(rng.weibull(1.0, n))).astype(np.float32)
+    censor_time = rng.exponential(np.median(t_event) * 3, n).astype(np.float32)
+    observed = np.minimum(t_event, censor_time)
+    delta = (t_event <= censor_time).astype(np.float32)
+    X = np.concatenate([x, delta[:, None]], axis=1)
+    from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+
+    dom = Domain(
+        [ContinuousVariable("x0"), ContinuousVariable("x1"), ContinuousVariable("censor")],
+        ContinuousVariable("time"),
+    )
+    t = TpuTable.from_numpy(dom, X, observed, session=session)
+    m = AFTSurvivalRegression(max_iter=200).fit(t)
+    np.testing.assert_allclose(np.asarray(m.coef), true_b, atol=0.15)
+    assert abs(float(m.scale) - sigma) < 0.15
+    q = m.predict_quantiles(t)
+    assert q.shape == (n, 9)
+    assert np.all(np.diff(q, axis=1) >= 0)  # quantiles increase in p
+
+
+# -------------------------------------------------------------------- FM
+def test_fm_regressor_learns_interaction(session):
+    rng = np.random.default_rng(8)
+    X = rng.standard_normal((800, 4)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 0.5 * X[:, 2]).astype(np.float32)  # pure pairwise term
+    t = TpuTable.from_arrays(X, y, session=session)
+    m = FMRegressor(factor_size=4, max_iter=800, step_size=0.05).fit(t)
+    pred = m.predict(t)
+    mse = float(np.mean((pred - y) ** 2))
+    assert mse < 0.15  # a linear model can't go below ~var(x0*x1)=1
+
+
+def test_fm_classifier_binary(session):
+    t = make_classification(500, 6, n_classes=2, seed=9, noise=0.2, session=session)
+    m = FMClassifier(factor_size=4, max_iter=400, step_size=0.05).fit(t)
+    y = t.to_numpy()[1][:, 0]
+    assert np.mean(m.predict(t) == y) > 0.9
+    probs = m.predict_probability(t)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_fm_classifier_rejects_multiclass(session, iris):
+    with pytest.raises(ValueError, match="binary"):
+        FMClassifier().fit(iris)
+
+
+# ------------------------------------------------------------------- MLP
+def test_mlp_learns_xor(session):
+    rng = np.random.default_rng(10)
+    X = rng.uniform(-1, 1, (600, 2)).astype(np.float32)
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(np.float32)  # not linearly separable
+    t = TpuTable.from_arrays(X, y, class_values=("0", "1"), session=session)
+    m = MultilayerPerceptronClassifier(layers=(2, 16, 2), max_iter=300, seed=1).fit(t)
+    assert np.mean(m.predict(t) == y) > 0.95
+
+
+def test_mlp_iris_multiclass(session, iris):
+    m = MultilayerPerceptronClassifier(layers=(4, 8, 3), max_iter=200, seed=2).fit(iris)
+    y = iris.to_numpy()[1][:, 0]
+    assert np.mean(m.predict(iris) == y) > 0.95
+    probs = m.predict_probability(iris)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_mlp_layer_validation(session, iris):
+    with pytest.raises(ValueError, match="layers"):
+        MultilayerPerceptronClassifier(layers=(3, 8, 3)).fit(iris)
