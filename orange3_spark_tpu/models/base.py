@@ -46,7 +46,25 @@ class Params:
 
 
 class Transformer:
-    """transform(table) -> table. Stateless or carrying fitted state."""
+    """transform(table) -> table. Stateless or carrying fitted state.
+
+    Subclasses that declare ``ParamsCls`` get the standard params-dataclass
+    constructor for free (same convention as Estimator); ones with custom
+    state keep defining their own __init__.
+    """
+
+    ParamsCls: type["Params"] | None = None
+
+    def __init__(self, params: "Params | None" = None, **kwargs):
+        if self.ParamsCls is None:
+            if params is not None or kwargs:
+                raise TypeError(f"{type(self).__name__} takes no params")
+            return
+        if params is None:
+            params = self.ParamsCls(**kwargs)
+        elif kwargs:
+            params = params.replace(**kwargs)
+        self.params = params
 
     def transform(self, table: TpuTable) -> TpuTable:
         raise NotImplementedError

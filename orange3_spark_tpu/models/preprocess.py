@@ -451,9 +451,6 @@ class NormalizerParams(Params):
 class Normalizer(Transformer):
     ParamsCls = NormalizerParams
 
-    def __init__(self, params: NormalizerParams | None = None, **kwargs):
-        self.params = params or NormalizerParams(**kwargs)
-
     def transform(self, table: TpuTable) -> TpuTable:
         ord_ = self.params.p
         norms = jnp.linalg.norm(table.X, ord=ord_, axis=1, keepdims=True)
@@ -469,9 +466,6 @@ class BinarizerParams(Params):
 
 class Binarizer(Transformer):
     ParamsCls = BinarizerParams
-
-    def __init__(self, params: BinarizerParams | None = None, **kwargs):
-        self.params = params or BinarizerParams(**kwargs)
 
     def transform(self, table: TpuTable) -> TpuTable:
         idxs = jnp.asarray(_col_indices(table, self.params.input_cols))
@@ -507,9 +501,6 @@ class FeatureHasher(Transformer):
     dense [n_cols_or_cats, num_features] matmul: one-hot-via-matmul keeps the
     op on the MXU instead of a gather/scatter.
     """
-
-    def __init__(self, params: FeatureHasherParams | None = None, **kwargs):
-        self.params = params or FeatureHasherParams(**kwargs)
 
     def transform(self, table: TpuTable) -> TpuTable:
         import zlib

@@ -1,0 +1,452 @@
+"""Text feature pipeline — parity with ``pyspark.ml.feature``'s text stack:
+Tokenizer, RegexTokenizer, StopWordsRemover, NGram, HashingTF,
+CountVectorizer, IDF, Word2Vec.
+
+Placement design (the TPU-native split): free text lives HOST-SIDE in
+``table.metas`` — exactly where Orange keeps string columns and where the
+reference funnels Spark string columns on collect (SURVEY.md §2b
+"Orange Table ⇄ distributed table bridge"; reconstructed, mount empty).
+String munging (tokenize/stop-words/ngram/hashing) is pointer-chasing with
+zero FLOPs, so it stays on host; the moment text becomes NUMBERS
+(term-count vectors, IDF weights, word embeddings) it moves into the sharded
+``X`` matrix and every downstream op is jitted device compute:
+
+* HashingTF/CountVectorizer append dense count columns to X (our table is
+  columnar-dense; MLlib's 2^18-wide sparse vectors become a configurable
+  dense width — the MXU wants dense anyway);
+* IDF fit/transform is jitted: document frequencies are one masked
+  reduction over the sharded row axis (GSPMD all-reduce = the
+  treeAggregate), scaling is a fused elementwise multiply;
+* Word2Vec trains skip-gram with negative sampling as one jitted
+  ``lax.fori_loop``: embedding gathers + a [P,D]·[P,D] contraction per
+  step — MLlib's per-executor hogwild loop becomes data-parallel SGD.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orange3_spark_tpu.core.domain import (
+    ContinuousVariable,
+    Domain,
+    StringVariable,
+)
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models.base import Estimator, Model, Params, Transformer
+
+# a compact default English stop list (MLlib loads its list from resources)
+_DEFAULT_STOP_WORDS = (
+    "a an and are as at be but by for if in into is it no not of on or such "
+    "that the their then there these they this to was will with i me my we "
+    "our you your he him his she her its them what which who whom am been "
+    "being have has had having do does did doing would should could ought"
+).split()
+
+
+def _meta_col(table: TpuTable, name: str) -> np.ndarray:
+    if table.metas is None:
+        raise ValueError("table has no meta columns")
+    names = [v.name for v in table.domain.metas]
+    if name not in names:
+        raise ValueError(f"no meta column {name!r} (have {names})")
+    return table.metas[:, names.index(name)]
+
+
+def _append_meta(table: TpuTable, name: str, values: np.ndarray) -> TpuTable:
+    """New table with an extra host-side meta column (token lists etc.)."""
+    col = np.empty((len(values), 1), dtype=object)
+    col[:, 0] = values
+    metas = col if table.metas is None else np.concatenate([table.metas, col], axis=1)
+    domain = Domain(
+        table.domain.attributes, table.domain.class_vars,
+        list(table.domain.metas) + [StringVariable(name)],
+    )
+    return TpuTable(domain, table.X, table.Y, table.W, metas, table.n_rows,
+                    table.session)
+
+
+def _append_x(table: TpuTable, names: list[str], cols_np: np.ndarray) -> TpuTable:
+    """Append host-computed numeric columns (padded + sharded) to X."""
+    pad = np.zeros((table.n_pad, cols_np.shape[1]), dtype=np.float32)
+    pad[: cols_np.shape[0]] = cols_np
+    dev = jax.device_put(pad, table.session.row_sharding)
+    domain = Domain(
+        list(table.domain.attributes) + [ContinuousVariable(n) for n in names],
+        table.domain.class_vars, table.domain.metas,
+    )
+    return table.with_X(jnp.concatenate([table.X, dev], axis=1), domain)
+
+
+# ---------------------------------------------------------------- tokenizers
+@dataclasses.dataclass(frozen=True)
+class TokenizerParams(Params):
+    input_col: str = "text"
+    output_col: str = "tokens"
+
+
+class Tokenizer(Transformer):
+    """MLlib Tokenizer: lowercase, split on whitespace."""
+
+    ParamsCls = TokenizerParams
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        p = self.params
+        texts = _meta_col(table, p.input_col)
+        toks = np.empty(len(texts), dtype=object)
+        for i, t in enumerate(texts):
+            toks[i] = str(t).lower().split()
+        return _append_meta(table, p.output_col, toks)
+
+
+@dataclasses.dataclass(frozen=True)
+class RegexTokenizerParams(Params):
+    input_col: str = "text"
+    output_col: str = "tokens"
+    pattern: str = r"\s+"         # MLlib pattern
+    gaps: bool = True             # pattern matches gaps (split) vs tokens (findall)
+    min_token_length: int = 1     # MLlib minTokenLength
+    to_lowercase: bool = True     # MLlib toLowercase
+
+
+class RegexTokenizer(Transformer):
+    ParamsCls = RegexTokenizerParams
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        p = self.params
+        rx = re.compile(p.pattern)
+        texts = _meta_col(table, p.input_col)
+        toks = np.empty(len(texts), dtype=object)
+        for i, t in enumerate(texts):
+            s = str(t).lower() if p.to_lowercase else str(t)
+            parts = rx.split(s) if p.gaps else rx.findall(s)
+            toks[i] = [w for w in parts if len(w) >= p.min_token_length]
+        return _append_meta(table, p.output_col, toks)
+
+
+@dataclasses.dataclass(frozen=True)
+class StopWordsRemoverParams(Params):
+    input_col: str = "tokens"
+    output_col: str = "filtered"
+    stop_words: tuple = tuple(_DEFAULT_STOP_WORDS)  # MLlib stopWords
+    case_sensitive: bool = False                    # MLlib caseSensitive
+
+
+class StopWordsRemover(Transformer):
+    ParamsCls = StopWordsRemoverParams
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        p = self.params
+        stop = set(p.stop_words if p.case_sensitive
+                   else (w.lower() for w in p.stop_words))
+        toks = _meta_col(table, p.input_col)
+        out = np.empty(len(toks), dtype=object)
+        for i, ts in enumerate(toks):
+            ts = ts if isinstance(ts, list) else str(ts).split()
+            out[i] = [w for w in ts
+                      if (w if p.case_sensitive else w.lower()) not in stop]
+        return _append_meta(table, p.output_col, out)
+
+
+@dataclasses.dataclass(frozen=True)
+class NGramParams(Params):
+    input_col: str = "tokens"
+    output_col: str = "ngrams"
+    n: int = 2  # MLlib n
+
+
+class NGram(Transformer):
+    ParamsCls = NGramParams
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        p = self.params
+        toks = _meta_col(table, p.input_col)
+        out = np.empty(len(toks), dtype=object)
+        for i, ts in enumerate(toks):
+            ts = ts if isinstance(ts, list) else str(ts).split()
+            out[i] = [" ".join(ts[j: j + p.n]) for j in range(len(ts) - p.n + 1)]
+        return _append_meta(table, p.output_col, out)
+
+
+# ---------------------------------------------------------- vectorization
+@dataclasses.dataclass(frozen=True)
+class HashingTFParams(Params):
+    input_col: str = "tokens"
+    output_prefix: str = "tf"
+    num_features: int = 1024  # MLlib numFeatures (2^18 sparse; dense here —
+                              # pick the width your vocab needs)
+    binary: bool = False      # MLlib binary
+
+
+class HashingTF(Transformer):
+    """Feature hashing: term -> crc32(term) mod num_features (stable across
+    processes, unlike Python's salted hash; plays MLlib's murmur3 role)."""
+
+    ParamsCls = HashingTFParams
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        p = self.params
+        toks = _meta_col(table, p.input_col)
+        counts = np.zeros((len(toks), p.num_features), dtype=np.float32)
+        for i, ts in enumerate(toks):
+            ts = ts if isinstance(ts, list) else str(ts).split()
+            for w in ts:
+                counts[i, zlib.crc32(w.encode()) % p.num_features] += 1.0
+        if p.binary:
+            counts = (counts > 0).astype(np.float32)
+        names = [f"{p.output_prefix}_{j}" for j in range(p.num_features)]
+        return _append_x(table, names, counts)
+
+
+@dataclasses.dataclass(frozen=True)
+class CountVectorizerParams(Params):
+    input_col: str = "tokens"
+    output_prefix: str = "cv"
+    vocab_size: int = 1024   # MLlib vocabSize
+    min_df: float = 1.0      # MLlib minDF (>=1: count, <1: fraction of docs)
+    min_tf: float = 1.0      # MLlib minTF (per-doc filter)
+    binary: bool = False
+
+
+class CountVectorizerModel(Model):
+    def __init__(self, params, vocabulary):
+        self.params = params
+        self.vocabulary = tuple(vocabulary)
+
+    @property
+    def state_pytree(self):
+        return {}
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        p = self.params
+        lut = {w: j for j, w in enumerate(self.vocabulary)}
+        toks = _meta_col(table, p.input_col)
+        counts = np.zeros((len(toks), len(self.vocabulary)), dtype=np.float32)
+        for i, ts in enumerate(toks):
+            ts = ts if isinstance(ts, list) else str(ts).split()
+            for w in ts:
+                j = lut.get(w)
+                if j is not None:
+                    counts[i, j] += 1.0
+            min_tf = p.min_tf if p.min_tf >= 1.0 else p.min_tf * max(len(ts), 1)
+            counts[i][counts[i] < min_tf] = 0.0
+        if p.binary:
+            counts = (counts > 0).astype(np.float32)
+        names = [f"{p.output_prefix}_{w}" for w in self.vocabulary]
+        return _append_x(table, names, counts)
+
+
+class CountVectorizer(Estimator):
+    ParamsCls = CountVectorizerParams
+    params: CountVectorizerParams
+
+    def _fit(self, table: TpuTable) -> CountVectorizerModel:
+        p = self.params
+        toks = _meta_col(table, p.input_col)
+        live = np.asarray(jax.device_get(table.W))[: len(toks)] > 0
+        tf: dict[str, float] = {}
+        df: dict[str, int] = {}
+        n_docs = 0
+        for i, ts in enumerate(toks):
+            if not live[i]:
+                continue
+            n_docs += 1
+            ts = ts if isinstance(ts, list) else str(ts).split()
+            for w in ts:
+                tf[w] = tf.get(w, 0.0) + 1.0
+            for w in set(ts):
+                df[w] = df.get(w, 0) + 1
+        min_df = p.min_df if p.min_df >= 1.0 else p.min_df * max(n_docs, 1)
+        eligible = [w for w in tf if df[w] >= min_df]
+        # MLlib: vocabulary ordered by corpus term frequency, capped
+        eligible.sort(key=lambda w: (-tf[w], w))
+        return CountVectorizerModel(p, eligible[: p.vocab_size])
+
+
+@dataclasses.dataclass(frozen=True)
+class IDFParams(Params):
+    input_cols: tuple = ()   # term-count attribute names; () => all attributes
+    min_doc_freq: int = 0    # MLlib minDocFreq
+
+
+class IDFModel(Model):
+    def __init__(self, params, idf, col_idx):
+        self.params = params
+        self.idf = idf          # f32[m] per-term idf weights
+        self.col_idx = col_idx  # i32[m] attribute indices scaled in-place
+
+    @property
+    def state_pytree(self):
+        return {"idf": self.idf}
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        X = table.X
+        scaled = X[:, self.col_idx] * self.idf[None, :]
+        X = X.at[:, self.col_idx].set(scaled)
+        return table.with_X(X, table.domain)
+
+
+class IDF(Estimator):
+    """idf = log((n_docs + 1) / (df + 1)) — MLlib's smoothed formula; the df
+    reduction runs jitted over the sharded row axis."""
+
+    ParamsCls = IDFParams
+    params: IDFParams
+
+    def _fit(self, table: TpuTable) -> IDFModel:
+        p = self.params
+        names = [v.name for v in table.domain.attributes]
+        cols = list(p.input_cols) if p.input_cols else names
+        idx = jnp.asarray([names.index(c) for c in cols], dtype=jnp.int32)
+        X, W = table.X, table.W
+        sub = X[:, idx]
+        df = jnp.sum(((sub > 0) & (W[:, None] > 0)).astype(jnp.float32), axis=0)
+        n_docs = jnp.sum((W > 0).astype(jnp.float32))
+        idf = jnp.log((n_docs + 1.0) / (df + 1.0))
+        idf = jnp.where(df >= p.min_doc_freq, idf, 0.0)
+        return IDFModel(p, idf, idx)
+
+
+# ----------------------------------------------------------------- Word2Vec
+@dataclasses.dataclass(frozen=True)
+class Word2VecParams(Params):
+    input_col: str = "tokens"
+    output_prefix: str = "w2v"
+    vector_size: int = 100    # MLlib vectorSize
+    min_count: int = 5        # MLlib minCount
+    window_size: int = 5      # MLlib windowSize
+    max_iter: int = 1         # MLlib maxIter (epochs)
+    step_size: float = 0.025  # MLlib stepSize
+    negative: int = 5         # negative samples (MLlib uses hierarchical
+                              # softmax; neg-sampling is the batched-friendly
+                              # formulation of the same skip-gram objective)
+    max_pairs: int = 1 << 20  # cap on (center, context) pairs per epoch
+    seed: int = 0
+
+
+class Word2VecModel(Model):
+    def __init__(self, params, vocabulary, vectors):
+        self.params = params
+        self.vocabulary = tuple(vocabulary)
+        self.vectors = vectors  # f32[V, D]
+        self._lut = {w: i for i, w in enumerate(self.vocabulary)}
+
+    @property
+    def state_pytree(self):
+        return {"vectors": self.vectors}
+
+    def get_vectors(self) -> np.ndarray:
+        return np.asarray(self.vectors)
+
+    def find_synonyms(self, word: str, num: int = 5):
+        """MLlib findSynonyms: top cosine-similar vocabulary words."""
+        if word not in self._lut:
+            raise ValueError(f"word {word!r} not in vocabulary")
+        V = np.asarray(self.vectors)
+        q = V[self._lut[word]]
+        sims = V @ q / (np.linalg.norm(V, axis=1) * np.linalg.norm(q) + 1e-12)
+        order = np.argsort(sims)[::-1]
+        out = [(self.vocabulary[i], float(sims[i])) for i in order
+               if self.vocabulary[i] != word]
+        return out[:num]
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        """Doc vector = mean of its words' vectors (MLlib's doc embedding)."""
+        p = self.params
+        toks = _meta_col(table, p.input_col)
+        V = np.asarray(self.vectors)
+        out = np.zeros((len(toks), p.vector_size), dtype=np.float32)
+        for i, ts in enumerate(toks):
+            ts = ts if isinstance(ts, list) else str(ts).split()
+            ids = [self._lut[w] for w in ts if w in self._lut]
+            if ids:
+                out[i] = V[ids].mean(axis=0)
+        names = [f"{p.output_prefix}_{j}" for j in range(p.vector_size)]
+        return _append_x(table, names, out)
+
+
+def _sgns_epoch(params, centers, contexts, key, *, negative, step_size, probs):
+    """One full-batch skip-gram negative-sampling step set."""
+    E_in, E_out = params
+
+    def loss_fn(params):
+        E_in, E_out = params
+        vc = E_in[centers]                           # [P,D] gather
+        uo = E_out[contexts]                         # [P,D]
+        pos = jax.nn.log_sigmoid(jnp.sum(vc * uo, axis=1))
+        neg_ids = jax.random.categorical(
+            key, jnp.log(probs)[None, :], shape=(centers.shape[0], negative)
+        )                                            # [P,neg]
+        un = E_out[neg_ids]                          # [P,neg,D]
+        neg = jnp.sum(jax.nn.log_sigmoid(-jnp.einsum("pd,pnd->pn", vc, un)), axis=1)
+        return -jnp.mean(pos + neg)
+
+    g = jax.grad(loss_fn)(params)
+    return (E_in - step_size * g[0], E_out - step_size * g[1])
+
+
+class Word2Vec(Estimator):
+    ParamsCls = Word2VecParams
+    params: Word2VecParams
+
+    def _fit(self, table: TpuTable) -> Word2VecModel:
+        p = self.params
+        toks = _meta_col(table, p.input_col)
+        live = np.asarray(jax.device_get(table.W))[: len(toks)] > 0
+        counts: dict[str, int] = {}
+        docs = []
+        for i, ts in enumerate(toks):
+            if not live[i]:
+                continue
+            ts = ts if isinstance(ts, list) else str(ts).split()
+            docs.append(ts)
+            for w in ts:
+                counts[w] = counts.get(w, 0) + 1
+        vocab = sorted((w for w, c in counts.items() if c >= p.min_count),
+                       key=lambda w: (-counts[w], w))
+        if not vocab:
+            raise ValueError(f"no words with count >= min_count={p.min_count}")
+        lut = {w: i for i, w in enumerate(vocab)}
+        rng = np.random.default_rng(p.seed)
+        centers, contexts = [], []
+        for ts in docs:
+            ids = [lut[w] for w in ts if w in lut]
+            for j, c in enumerate(ids):
+                win = rng.integers(1, p.window_size + 1)
+                for k in range(max(0, j - win), min(len(ids), j + win + 1)):
+                    if k != j:
+                        centers.append(c)
+                        contexts.append(ids[k])
+        if not centers:
+            raise ValueError("no (center, context) pairs — docs too short?")
+        centers = np.asarray(centers, dtype=np.int32)
+        contexts = np.asarray(contexts, dtype=np.int32)
+        if len(centers) > p.max_pairs:
+            sel = rng.choice(len(centers), p.max_pairs, replace=False)
+            centers, contexts = centers[sel], contexts[sel]
+        # unigram^0.75 negative-sampling distribution (word2vec standard)
+        freq = np.asarray([counts[w] for w in vocab], dtype=np.float64) ** 0.75
+        probs = jnp.asarray((freq / freq.sum()).astype(np.float32))
+        V, D = len(vocab), p.vector_size
+        key = jax.random.PRNGKey(p.seed)
+        key, k1 = jax.random.split(key)
+        E_in = (jax.random.uniform(k1, (V, D), jnp.float32) - 0.5) / D
+        E_out = jnp.zeros((V, D), jnp.float32)
+        epoch = jax.jit(
+            lambda params, key: _sgns_epoch(
+                params, centers, contexts, key,
+                negative=p.negative, step_size=p.step_size, probs=probs,
+            )
+        )
+        # several SGD steps per "epoch" (full-batch grad ≈ one pass over pairs)
+        steps = max(p.max_iter * 10, 10)
+        params = (E_in, E_out)
+        for _ in range(steps):
+            key, sub = jax.random.split(key)
+            params = epoch(params, sub)
+        return Word2VecModel(p, vocab, params[0])

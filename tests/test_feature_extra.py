@@ -1,0 +1,240 @@
+"""RobustScaler/Poly/DCT/selectors/SQLTransformer/LSH parity tests (SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models.feature_extra import (
+    DCT,
+    BucketedRandomProjectionLSH,
+    ChiSqSelector,
+    ElementwiseProduct,
+    IndexToString,
+    Interaction,
+    MinHashLSH,
+    PolynomialExpansion,
+    RobustScaler,
+    SQLTransformer,
+    UnivariateFeatureSelector,
+    VarianceThresholdSelector,
+    VectorIndexer,
+    VectorSlicer,
+)
+
+
+def test_robust_scaler_matches_sklearn(session):
+    rng = np.random.default_rng(0)
+    X = np.concatenate(
+        [rng.standard_normal((200, 3)), 100 * rng.standard_normal((5, 3))]
+    ).astype(np.float32)
+    t = TpuTable.from_arrays(X, session=session)
+    m = RobustScaler(with_centering=True).fit(t)
+    out = m.transform(t).to_numpy()[0]
+    from sklearn.preprocessing import RobustScaler as Sk
+
+    sk = Sk().fit_transform(X)
+    np.testing.assert_allclose(out, sk, rtol=1e-2, atol=1e-2)
+
+
+def test_polynomial_expansion_degree2(session):
+    X = np.array([[2.0, 3.0]], dtype=np.float32)
+    t = TpuTable.from_arrays(X, attr_names=["a", "b"], session=session)
+    out = PolynomialExpansion(degree=2).transform(t)
+    names = [v.name for v in out.domain.attributes]
+    assert names == ["a", "b", "a*a", "a*b", "b*b"]
+    row = out.to_numpy()[0][0]
+    np.testing.assert_allclose(row, [2, 3, 4, 6, 9])
+
+
+def test_dct_roundtrip_and_energy(session):
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((50, 8)).astype(np.float32)
+    t = TpuTable.from_arrays(X, session=session)
+    fwd = DCT().transform(t)
+    back = DCT(inverse=True).transform(fwd)
+    np.testing.assert_allclose(back.to_numpy()[0], X, atol=1e-4)
+    # orthonormal: energy preserved
+    np.testing.assert_allclose(
+        np.sum(fwd.to_numpy()[0] ** 2), np.sum(X**2), rtol=1e-4
+    )
+    from scipy.fft import dct as sp_dct
+
+    np.testing.assert_allclose(
+        fwd.to_numpy()[0], sp_dct(X, norm="ortho", axis=1), atol=1e-4
+    )
+
+
+def test_interaction_and_elementwise(session):
+    X = np.array([[2.0, 3.0, 4.0]], dtype=np.float32)
+    t = TpuTable.from_arrays(X, attr_names=["a", "b", "c"], session=session)
+    out = Interaction(input_cols=("a", "c")).transform(t)
+    assert out.to_numpy()[0][0, -1] == 8.0
+    out2 = ElementwiseProduct(scaling_vec=(10.0, 0.0, 1.0)).transform(t)
+    np.testing.assert_allclose(out2.to_numpy()[0][0], [20.0, 0.0, 4.0])
+
+
+def test_vector_slicer(session):
+    X = np.zeros((4, 3), dtype=np.float32)
+    t = TpuTable.from_arrays(X, attr_names=["a", "b", "c"], session=session)
+    out = VectorSlicer(names=("c",), indices=(0,)).transform(t)
+    assert [v.name for v in out.domain.attributes] == ["c", "a"]
+
+
+def test_index_to_string_roundtrip(session):
+    from orange3_spark_tpu.core.domain import DiscreteVariable
+
+    dom = Domain([DiscreteVariable("color", ("red", "green", "blue"))])
+    X = np.array([[0.0], [2.0], [1.0]], dtype=np.float32)
+    t = TpuTable.from_numpy(dom, X, session=session)
+    out = IndexToString(input_col="color").transform(t)
+    col = out.metas[:, -1]
+    assert list(col) == ["red", "blue", "green"]
+
+
+def test_vector_indexer_detects_categories(session):
+    rng = np.random.default_rng(2)
+    cont = rng.standard_normal(100).astype(np.float32)
+    cat = rng.choice([0.0, 3.0, 7.0], 100).astype(np.float32)
+    t = TpuTable.from_arrays(
+        np.stack([cont, cat], 1), attr_names=["cont", "cat"], session=session
+    )
+    m = VectorIndexer(max_categories=5).fit(t)
+    assert 1 in m.category_maps and 0 not in m.category_maps
+    out = m.transform(t)
+    assert out.domain.attributes[1].is_discrete
+    vals = out.to_numpy()[0][:, 1]
+    assert set(np.unique(vals)) <= {0.0, 1.0, 2.0}  # re-encoded ordinals
+
+
+def test_vector_indexer_unseen_category_errors_or_keeps(session):
+    t_fit = TpuTable.from_arrays(
+        np.array([[0.0], [3.0]], np.float32), attr_names=["c"], session=session
+    )
+    t_new = TpuTable.from_arrays(
+        np.array([[7.0]], np.float32), attr_names=["c"], session=session
+    )
+    m = VectorIndexer(max_categories=5).fit(t_fit)
+    with pytest.raises(ValueError, match="unseen"):
+        m.transform(t_new)
+    m2 = VectorIndexer(max_categories=5, handle_invalid="keep").fit(t_fit)
+    out = m2.transform(t_new)
+    assert out.to_numpy()[0][0, 0] == 2.0  # __unknown__ ordinal
+    assert out.domain.attributes[0].values[-1] == "__unknown__"
+
+
+def test_univariate_selector_fpr_mode(session):
+    rng = np.random.default_rng(11)
+    n = 500
+    y = rng.integers(0, 2, n).astype(np.float32)
+    info = y * 3 + rng.standard_normal(n) * 0.3
+    X = np.column_stack([rng.standard_normal(n), info]).astype(np.float32)
+    t = TpuTable.from_arrays(X, y, attr_names=["noise", "info"],
+                             class_values=("0", "1"), session=session)
+    model = UnivariateFeatureSelector(
+        feature_type="continuous", label_type="categorical",
+        selection_mode="fpr", selection_threshold=1e-4,
+    ).fit(t)
+    assert model.selected == ("info",)
+
+
+def test_variance_threshold_drops_constant(session):
+    rng = np.random.default_rng(3)
+    X = np.stack(
+        [rng.standard_normal(100), np.full(100, 7.0)], axis=1
+    ).astype(np.float32)
+    t = TpuTable.from_arrays(X, attr_names=["varied", "const"], session=session)
+    model = VarianceThresholdSelector(variance_threshold=0.01).fit(t)
+    out = model.transform(t)
+    assert [v.name for v in out.domain.attributes] == ["varied"]
+
+
+def test_univariate_selector_finds_informative(session):
+    rng = np.random.default_rng(4)
+    n = 400
+    y = rng.integers(0, 2, n).astype(np.float32)
+    informative = y * 2 + rng.standard_normal(n) * 0.3
+    noise = rng.standard_normal((n, 3))
+    X = np.column_stack([noise[:, 0], informative, noise[:, 1:]]).astype(np.float32)
+    t = TpuTable.from_arrays(X, y, attr_names=["n0", "info", "n1", "n2"],
+                             class_values=("0", "1"), session=session)
+    model = UnivariateFeatureSelector(
+        feature_type="continuous", label_type="categorical",
+        selection_mode="numTopFeatures", selection_threshold=1,
+    ).fit(t)
+    assert model.selected == ("info",)
+
+
+def test_chisq_selector(session):
+    rng = np.random.default_rng(5)
+    n = 500
+    y = rng.integers(0, 2, n).astype(np.float32)
+    dep = (y + rng.integers(0, 2, n) * 0.2).astype(np.float32)  # depends on y
+    indep = rng.integers(0, 3, n).astype(np.float32)
+    t = TpuTable.from_arrays(np.stack([indep, dep], 1), y,
+                             attr_names=["indep", "dep"],
+                             class_values=("0", "1"), session=session)
+    model = ChiSqSelector(selection_threshold=1).fit(t)
+    assert model.selected == ("dep",)
+
+
+def test_sql_transformer_select_where(session):
+    X = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], dtype=np.float32)
+    t = TpuTable.from_arrays(X, attr_names=["a", "b"], session=session)
+    out = SQLTransformer(
+        statement="SELECT *, a + b AS ab, a * 2 AS a2 FROM __THIS__ WHERE a > 1"
+    ).transform(t)
+    names = [v.name for v in out.domain.attributes]
+    assert names == ["a", "b", "ab", "a2"]
+    assert out.count() == 2  # a>1 keeps rows 2,3
+    Xo, _, Wo = out.to_numpy()
+    live = Wo > 0
+    np.testing.assert_allclose(Xo[live][:, 2], [7.0, 11.0])
+
+
+def test_sql_transformer_projection_only(session):
+    X = np.array([[2.0, 8.0]], dtype=np.float32)
+    t = TpuTable.from_arrays(X, attr_names=["a", "b"], session=session)
+    out = SQLTransformer(statement="SELECT sqrt(b) AS sb FROM __THIS__").transform(t)
+    assert [v.name for v in out.domain.attributes] == ["sb"]
+    assert abs(out.to_numpy()[0][0, 0] - np.sqrt(8.0)) < 1e-5
+
+
+def test_brp_lsh_neighbors(session):
+    rng = np.random.default_rng(6)
+    X = rng.standard_normal((300, 5)).astype(np.float32) * 10
+    t = TpuTable.from_arrays(X, session=session)
+    model = BucketedRandomProjectionLSH(
+        bucket_length=5.0, num_hash_tables=6, seed=0
+    ).fit(t)
+    out = model.transform(t)
+    assert sum(v.name.startswith("lsh_") for v in out.domain.attributes) == 6
+    # query with an existing row: itself must be the nearest neighbor
+    idx, dists = model.approx_nearest_neighbors(t, X[17], k=3)
+    assert idx[0] == 17 and dists[0] < 0.05  # f32 |x|²-2x·c+|c|² noise
+
+
+def test_brp_lsh_similarity_join(session):
+    base = np.array([[0.0, 0.0], [10.0, 10.0]], dtype=np.float32)
+    a = TpuTable.from_arrays(base, session=session)
+    b = TpuTable.from_arrays(base + 0.01, session=session)
+    model = BucketedRandomProjectionLSH(bucket_length=2.0, num_hash_tables=4).fit(a)
+    ii, jj, dd = model.approx_similarity_join(a, b, threshold=1.0)
+    pairs = set(zip(ii.tolist(), jj.tolist()))
+    assert (0, 0) in pairs and (1, 1) in pairs
+    assert (0, 1) not in pairs
+
+
+def test_minhash_lsh_jaccard(session):
+    A = np.array([
+        [1, 1, 1, 0, 0, 0],
+        [1, 1, 0, 0, 0, 0],
+        [0, 0, 0, 1, 1, 1],
+    ], dtype=np.float32)
+    t = TpuTable.from_arrays(A, session=session)
+    model = MinHashLSH(num_hash_tables=8, seed=1).fit(t)
+    out = model.transform(t)
+    assert sum(v.name.startswith("minhash_") for v in out.domain.attributes) == 8
+    idx, dists = model.approx_nearest_neighbors(t, A[0], k=2)
+    assert idx[0] == 0 and dists[0] < 1e-6
+    assert idx[1] == 1  # shares 2/3 support with row 0

@@ -1,0 +1,139 @@
+"""Text pipeline: Tokenizer/StopWords/NGram/HashingTF/CountVectorizer/IDF/Word2Vec."""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.core.domain import ContinuousVariable, Domain, StringVariable
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models.text import (
+    IDF,
+    CountVectorizer,
+    HashingTF,
+    NGram,
+    RegexTokenizer,
+    StopWordsRemover,
+    Tokenizer,
+    Word2Vec,
+)
+
+
+def _text_table(session, texts):
+    dom = Domain([ContinuousVariable("x")], None, [StringVariable("text")])
+    X = np.zeros((len(texts), 1), dtype=np.float32)
+    metas = np.asarray(texts, dtype=object)[:, None]
+    return TpuTable.from_numpy(dom, X, metas=metas, session=session)
+
+
+def _tokens(table, col):
+    names = [v.name for v in table.domain.metas]
+    return table.metas[:, names.index(col)]
+
+
+def test_tokenizer_lowercases_and_splits(session):
+    t = _text_table(session, ["Hello World", "Foo  bar baz"])
+    out = Tokenizer(input_col="text", output_col="tok").transform(t)
+    toks = _tokens(out, "tok")
+    assert toks[0] == ["hello", "world"]
+    assert toks[1] == ["foo", "bar", "baz"]
+
+
+def test_regex_tokenizer_min_length_and_findall(session):
+    t = _text_table(session, ["ab, cde; f ghij"])
+    out = RegexTokenizer(
+        input_col="text", output_col="tok", pattern=r"\w+", gaps=False,
+        min_token_length=2,
+    ).transform(t)
+    assert _tokens(out, "tok")[0] == ["ab", "cde", "ghij"]
+
+
+def test_stopwords_removed(session):
+    t = _text_table(session, ["the cat sat on the mat"])
+    t = Tokenizer(input_col="text", output_col="tok").transform(t)
+    out = StopWordsRemover(input_col="tok", output_col="clean").transform(t)
+    assert _tokens(out, "clean")[0] == ["cat", "sat", "mat"]
+
+
+def test_ngram(session):
+    t = _text_table(session, ["a b c d"])
+    t = Tokenizer(input_col="text", output_col="tok").transform(t)
+    out = NGram(input_col="tok", output_col="bi", n=2).transform(t)
+    assert _tokens(out, "bi")[0] == ["a b", "b c", "c d"]
+
+
+def test_hashing_tf_counts_and_binary(session):
+    t = _text_table(session, ["x x y", "z"])
+    t = Tokenizer(input_col="text", output_col="tok").transform(t)
+    out = HashingTF(input_col="tok", num_features=16).transform(t)
+    X = out.to_numpy()[0]
+    tf = X[:, 1:]  # first col is the original 'x' feature
+    assert tf.shape == (2, 16)
+    assert tf[0].sum() == 3.0 and tf[0].max() == 2.0  # 'x' twice, 'y' once
+    assert tf[1].sum() == 1.0
+    out_b = HashingTF(input_col="tok", num_features=16, binary=True).transform(t)
+    assert out_b.to_numpy()[0][:, 1:].max() == 1.0
+
+
+def test_count_vectorizer_vocab_and_min_df(session):
+    docs = ["apple banana apple", "banana cherry", "apple banana", "dragonfruit"]
+    t = _text_table(session, docs)
+    t = Tokenizer(input_col="text", output_col="tok").transform(t)
+    model = CountVectorizer(input_col="tok", min_df=2.0).fit(t)
+    # dragonfruit + cherry appear in only 1 doc each
+    assert set(model.vocabulary) == {"apple", "banana"}
+    assert model.vocabulary[0] in ("apple", "banana")  # freq-ordered
+    out = model.transform(t)
+    X = out.to_numpy()[0]
+    col = dict(zip(model.vocabulary, range(len(model.vocabulary))))
+    assert X[0, 1 + col["apple"]] == 2.0
+    assert X[3, 1:].sum() == 0.0
+
+
+def test_idf_downweights_common_terms(session):
+    docs = ["a b", "a c", "a d"]
+    t = _text_table(session, docs)
+    t = Tokenizer(input_col="text", output_col="tok").transform(t)
+    cv = CountVectorizer(input_col="tok", min_df=1.0).fit(t)
+    t2 = cv.transform(t)
+    count_cols = tuple(f"cv_{w}" for w in cv.vocabulary)
+    idf_model = IDF(input_cols=count_cols).fit(t2)
+    out = idf_model.transform(t2)
+    X = out.to_numpy()[0]
+    names = [v.name for v in out.domain.attributes]
+    # 'a' in every doc -> idf log(4/4)=0; rare terms get positive weight
+    a_col = names.index("cv_a")
+    assert np.allclose(X[:, a_col], 0.0, atol=1e-6)
+    b_col = names.index("cv_b")
+    assert X[0, b_col] > 0
+
+
+def test_word2vec_groups_cooccurring_words(session):
+    rng = np.random.default_rng(0)
+    docs = []
+    for _ in range(150):
+        docs.append(" ".join(rng.permutation(["cat", "dog", "pet"]).tolist()))
+        docs.append(" ".join(rng.permutation(["car", "road", "drive"]).tolist()))
+    t = _text_table(session, docs)
+    t = Tokenizer(input_col="text", output_col="tok").transform(t)
+    model = Word2Vec(
+        input_col="tok", vector_size=16, min_count=5, window_size=2,
+        max_iter=30, step_size=0.5, seed=1,
+    ).fit(t)
+    assert set(model.vocabulary) == {"cat", "dog", "pet", "car", "road", "drive"}
+    syn = model.find_synonyms("cat", num=2)
+    assert {w for w, _ in syn} <= {"dog", "pet"}
+    out = model.transform(t)
+    assert out.to_numpy()[0].shape[1] == 1 + 16
+
+
+def test_word2vec_transform_doc_vectors_cluster(session):
+    docs = ["cat dog", "dog cat", "car road", "road car"] * 40
+    t = _text_table(session, docs)
+    t = Tokenizer(input_col="text", output_col="tok").transform(t)
+    model = Word2Vec(input_col="tok", vector_size=8, min_count=5,
+                     window_size=2, max_iter=20, step_size=0.5, seed=2).fit(t)
+    out = model.transform(t)
+    X = out.to_numpy()[0][:, 1:]
+    # doc vectors of same-topic docs should be closer than cross-topic
+    d_same = np.linalg.norm(X[0] - X[1])
+    d_cross = np.linalg.norm(X[0] - X[2])
+    assert d_same < d_cross
