@@ -45,13 +45,11 @@ class Params:
         return [(f.name, f.type, f.default) for f in dataclasses.fields(cls)]
 
 
-class Transformer:
-    """transform(table) -> table. Stateless or carrying fitted state.
-
-    Subclasses that declare ``ParamsCls`` get the standard params-dataclass
-    constructor for free (same convention as Estimator); ones with custom
-    state keep defining their own __init__.
-    """
+class HasParams:
+    """The one params-dataclass constructor: subclasses declare ``ParamsCls``
+    and get ``Cls(**kwargs)`` / ``Cls(params)`` / ``Cls(params, override=...)``
+    for free. Shared by Transformer, Estimator, and the fit-less algorithm
+    entry points (PrefixSpan, PowerIterationClustering)."""
 
     ParamsCls: type["Params"] | None = None
 
@@ -65,6 +63,15 @@ class Transformer:
         elif kwargs:
             params = params.replace(**kwargs)
         self.params = params
+
+
+class Transformer(HasParams):
+    """transform(table) -> table. Stateless or carrying fitted state.
+
+    Subclasses that declare ``ParamsCls`` get the standard params-dataclass
+    constructor from HasParams; ones with custom state define their own
+    __init__.
+    """
 
     def transform(self, table: TpuTable) -> TpuTable:
         raise NotImplementedError

@@ -1,0 +1,325 @@
+"""Frequent pattern mining — parity with ``pyspark.ml.fpm``: FPGrowth
+(frequent itemsets + association rules) and PrefixSpan (sequential patterns).
+
+MLlib runs PFP (parallel FP-Growth, Li et al.) — items partitioned across
+executors, each building conditional FP-trees — and a distributed PrefixSpan
+(SURVEY.md §2b; reconstructed, mount empty — public API: FPGrowth(minSupport
+=0.3, minConfidence=0.8, itemsCol), model.freqItemsets, associationRules,
+transform = rule-consequent prediction; PrefixSpan(minSupport,
+maxPatternLength, maxLocalProjDBSize)). TPU-native placement:
+
+* transactions become a **binary incidence matrix** ``X: f32[N, n_items]``
+  (rows sharded over the mesh). Support counting — the entire hot loop of
+  Apriori/FP-growth — is then ``(X[:, mask-products]ᵀ · W)``: candidate
+  itemset supports for a whole level are ONE [N,c]@[c→reduce] masked-product
+  + matmul batch on the MXU, with the row contraction GSPMD all-reduced over
+  ICI (the treeAggregate moment). Level-wise candidate generation (tiny,
+  set-algebra on item ids) stays host-side — it is pointer-chasing the TPU
+  should never see.
+* PrefixSpan keeps its projected-database recursion on host (inherently
+  sequential/data-dependent), but counts every candidate extension level on
+  device the same masked-matmul way when sequences are dense-encodable;
+  gated to host counting otherwise.
+
+Orange parity note: Orange3's own add-on family ships an 'Associate' add-on
+(frequent itemsets) — this module covers the same canvas role.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models.base import Estimator, HasParams, Model, Params
+from orange3_spark_tpu.models.text import _meta_col
+
+
+@dataclasses.dataclass(frozen=True)
+class FPGrowthParams(Params):
+    min_support: float = 0.3      # MLlib minSupport (fraction of rows)
+    min_confidence: float = 0.8   # MLlib minConfidence (rules)
+    items_col: str = ""           # meta column of item lists; "" => X is binary
+    max_pattern_length: int = 10  # guard on itemset size
+
+
+def _incidence(table: TpuTable, items_col: str):
+    """(binary incidence [N_pad, n_items] device array, item names)."""
+    if not items_col:
+        names = [v.name for v in table.domain.attributes]
+        return (table.X > 0).astype(jnp.float32), names
+    col = _meta_col(table, items_col)
+    vocab: dict[str, int] = {}
+    rows, cols = [], []
+    for i, items in enumerate(col):
+        items = items if isinstance(items, (list, tuple)) else str(items).split()
+        for it in set(items):
+            j = vocab.setdefault(str(it), len(vocab))
+            rows.append(i)
+            cols.append(j)
+    M = np.zeros((table.n_pad, len(vocab)), dtype=np.float32)
+    M[rows, cols] = 1.0
+    names = [w for w, _ in sorted(vocab.items(), key=lambda kv: kv[1])]
+    return jax.device_put(M, table.session.row_sharding), names
+
+
+@jax.jit
+def _support_chunk(B, W, members):
+    hits = B @ members.T                                   # [N, c]
+    sizes = jnp.sum(members, axis=1)                       # [c]
+    full = (hits >= sizes[None, :] - 0.5).astype(jnp.float32)
+    return full.T @ W                                      # [c] psum'd support
+
+
+_SUPPORT_CHUNK_ROWS = 1 << 22  # f32 integers are exact below 2^24
+
+
+def _support_batch(B, W, members):
+    """Support of a batch of candidate itemsets.
+
+    B: f32[N, m] binary incidence; members: f32[c, m] one row per candidate
+    (1 where the item belongs). A row supports a candidate iff it contains
+    every member item: count(row·members_row) == |candidate| — ONE
+    [N,m]@[m,c] MXU matmul + compare per chunk, no per-candidate scan.
+
+    Device accumulation is f32, whose integers are exact only below 2^24;
+    row chunks are therefore capped at 2^22 and the per-chunk counts summed
+    host-side in float64 (MLlib counts in 64-bit longs).
+    """
+    n = B.shape[0]
+    if n <= _SUPPORT_CHUNK_ROWS:
+        return np.asarray(jax.device_get(_support_chunk(B, W, members))).astype(np.float64)
+    total = np.zeros((members.shape[0],), dtype=np.float64)
+    for s in range(0, n, _SUPPORT_CHUNK_ROWS):
+        e = min(s + _SUPPORT_CHUNK_ROWS, n)
+        total += np.asarray(jax.device_get(_support_chunk(B[s:e], W[s:e], members)))
+    return total
+
+
+class FPGrowthModel(Model):
+    def __init__(self, params, item_names, freq_itemsets, n_rows_weighted):
+        self.params = params
+        self.item_names = tuple(item_names)
+        # list[(frozenset[int] item ids, float support_count)]
+        self.freq_itemsets_ = freq_itemsets
+        self.n_rows_weighted = n_rows_weighted
+        self.association_rules_ = self._rules()
+
+    @property
+    def state_pytree(self):
+        return {}
+
+    def freq_itemsets(self):
+        """MLlib freqItemsets frame: [{'items': [names], 'freq': count}]."""
+        return [
+            {"items": sorted(self.item_names[i] for i in s), "freq": c}
+            for s, c in self.freq_itemsets_
+        ]
+
+    def _rules(self):
+        """antecedent => consequent with confidence/lift/support (MLlib)."""
+        sup = {s: c for s, c in self.freq_itemsets_}
+        rules = []
+        for s, c in self.freq_itemsets_:
+            if len(s) < 2:
+                continue
+            # MLlib AssociationRules: exactly ONE consequent item per rule
+            for cons_item in sorted(s):
+                ante = s - {cons_item}
+                if ante not in sup:
+                    continue
+                conf = c / sup[ante]
+                if conf >= self.params.min_confidence:
+                    cons_sup = sup.get(frozenset([cons_item]))
+                    lift = (
+                        conf / (cons_sup / self.n_rows_weighted)
+                        if cons_sup else float("nan")
+                    )
+                    rules.append({
+                        "antecedent": sorted(self.item_names[i] for i in ante),
+                        "consequent": [self.item_names[cons_item]],
+                        "confidence": conf,
+                        "lift": lift,
+                        "support": c / self.n_rows_weighted,
+                    })
+        return rules
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        """MLlib transform: per row, union of rule consequents whose
+        antecedent is contained in the row's items — emitted as one binary
+        'pred_<item>' column per predictable item."""
+        B, names = _incidence(table, self.params.items_col)
+        name_to_id = {n: j for j, n in enumerate(names)}
+        pred_items = sorted({it for r in self.association_rules_
+                             for it in r["consequent"]})
+        # batch ALL rules: one [N,m]@[m,R] antecedent matmul + one [N,R]@[R,P]
+        # consequent mapping — never a per-rule device dispatch
+        m = B.shape[1]
+        usable = [r for r in self.association_rules_
+                  if all(a in name_to_id for a in r["antecedent"])]
+        if usable:
+            ante_members = np.zeros((len(usable), m), dtype=np.float32)
+            cons_map = np.zeros((len(usable), len(pred_items)), dtype=np.float32)
+            for ri, r in enumerate(usable):
+                ante_members[ri, [name_to_id[a] for a in r["antecedent"]]] = 1.0
+                for it in r["consequent"]:
+                    cons_map[ri, pred_items.index(it)] = 1.0
+            AM = jnp.asarray(ante_members)
+            sizes = jnp.sum(AM, axis=1)
+            has_ante = (B @ AM.T >= sizes[None, :] - 0.5).astype(jnp.float32)
+            fired = (has_ante @ jnp.asarray(cons_map)) > 0          # [N,P]
+            has_item = jnp.stack(
+                [B[:, name_to_id[it]] > 0 if it in name_to_id
+                 else jnp.zeros((B.shape[0],), bool) for it in pred_items],
+                axis=1,
+            )
+            # predict only items the row does not already contain (MLlib)
+            out = (fired & ~has_item).astype(jnp.float32)
+        else:
+            out = jnp.zeros((B.shape[0], len(pred_items)), dtype=jnp.float32)
+        new_attrs = list(table.domain.attributes) + [
+            ContinuousVariable(f"pred_{it}") for it in pred_items
+        ]
+        domain = Domain(new_attrs, table.domain.class_vars, table.domain.metas)
+        return table.with_X(jnp.concatenate([table.X, out], axis=1), domain)
+
+
+class FPGrowth(Estimator):
+    ParamsCls = FPGrowthParams
+    params: FPGrowthParams
+
+    def _fit(self, table: TpuTable) -> FPGrowthModel:
+        p = self.params
+        B, names = _incidence(table, p.items_col)
+        W = table.W
+        m = len(names)
+        total_w = float(jax.device_get(jnp.sum(W)))
+        min_count = p.min_support * total_w
+        # level 1: single-item supports (chunked f64 accumulation)
+        sup1 = _support_batch(B, W, jnp.eye(m, dtype=jnp.float32))
+        freq: list[tuple[frozenset, float]] = []
+        current = []
+        for j in range(m):
+            if sup1[j] >= min_count:
+                s = frozenset([j])
+                freq.append((s, float(sup1[j])))
+                current.append(s)
+        level = 1
+        # level-wise growth (Apriori over the incidence matrix): candidate
+        # generation host-side; support counting one batched matmul per level
+        while current and level < p.max_pattern_length:
+            level += 1
+            cand = sorted({
+                a | b for a, b in itertools.combinations(current, 2)
+                if len(a | b) == level
+            })
+            # prune: all (level-1)-subsets must be frequent (Apriori property)
+            fset = {s for s, _ in freq}
+            cand = [
+                c for c in cand
+                if all(frozenset(sub) in fset
+                       for sub in itertools.combinations(c, level - 1))
+            ]
+            if not cand:
+                break
+            members = np.zeros((len(cand), m), dtype=np.float32)
+            for ci, s in enumerate(cand):
+                members[ci, sorted(s)] = 1.0
+            sup = _support_batch(B, W, jnp.asarray(members))
+            current = []
+            for ci, s in enumerate(cand):
+                if sup[ci] >= min_count:
+                    freq.append((s, float(sup[ci])))
+                    current.append(s)
+        return FPGrowthModel(p, names, freq, total_w)
+
+
+# ------------------------------------------------------------------ PrefixSpan
+@dataclasses.dataclass(frozen=True)
+class PrefixSpanParams(Params):
+    min_support: float = 0.1        # MLlib minSupport
+    max_pattern_length: int = 10    # MLlib maxPatternLength
+    max_local_proj_db_size: int = 32_000_000  # parity; host recursion here
+    sequence_col: str = "sequence"  # meta column of item-list sequences
+
+
+def _seq_contains(seq, pat) -> bool:
+    """Itemset-subsequence containment: each pattern element must be a subset
+    of a strictly later sequence element (greedy match is exact here)."""
+    i = 0
+    for elem in seq:
+        if pat[i] <= elem:
+            i += 1
+            if i == len(pat):
+                return True
+    return False
+
+
+class PrefixSpan(HasParams):
+    """Sequential pattern mining (Pei et al.). Mirrors MLlib's API shape:
+    no fit/model — ``find_frequent_sequential_patterns(table)`` returns the
+    pattern frame. DFS over the pattern lattice with BOTH extension kinds:
+    s-extension (item starts a new element) and i-extension (item joins the
+    prefix's last itemset), so multi-item elements like <(a b)> are found.
+    The recursion is host-side (inherently sequential control flow); each
+    candidate's support is one containment scan over the sequences."""
+
+    ParamsCls = PrefixSpanParams
+
+    def find_frequent_sequential_patterns(self, table: TpuTable):
+        p = self.params
+        col = _meta_col(table, p.sequence_col)
+        live = np.asarray(jax.device_get(table.W))[: len(col)] > 0
+        seqs = []
+        for i, s in enumerate(col):
+            if not live[i]:
+                continue
+            if isinstance(s, (list, tuple)):
+                seqs.append([
+                    frozenset(e) if isinstance(e, (list, tuple, set, frozenset))
+                    else frozenset([e])
+                    for e in s
+                ])
+            else:
+                seqs.append([frozenset([tok]) for tok in str(s).split()])
+        n = len(seqs)
+        min_count = max(p.min_support * n, 1.0)
+        item_counts: dict[str, int] = {}
+        for sq in seqs:
+            for it in {x for e in sq for x in e}:
+                item_counts[it] = item_counts.get(it, 0) + 1
+        freq_items = sorted(it for it, c in item_counts.items() if c >= min_count)
+        results: list[tuple[tuple, int]] = []
+
+        def count(pat) -> int:
+            return sum(1 for sq in seqs if _seq_contains(sq, pat))
+
+        def explore(pat, total_items):
+            if total_items >= p.max_pattern_length:
+                return
+            for it in freq_items:
+                # s-extension: item opens a new element
+                cand = pat + [frozenset([it])]
+                c = count(cand)
+                if c >= min_count:
+                    results.append((tuple(tuple(sorted(e)) for e in cand), c))
+                    explore(cand, total_items + 1)
+                # i-extension: item joins the last element (dedup: only items
+                # lexically after everything already in it)
+                if pat and all(it > x for x in pat[-1]):
+                    cand = pat[:-1] + [pat[-1] | {it}]
+                    c = count(cand)
+                    if c >= min_count:
+                        results.append((tuple(tuple(sorted(e)) for e in cand), c))
+                        explore(cand, total_items + 1)
+
+        explore([], 0)
+        return [
+            {"sequence": [list(e) for e in pat], "freq": c}
+            for pat, c in sorted(results, key=lambda r: (-r[1], r[0]))
+        ]

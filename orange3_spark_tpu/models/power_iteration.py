@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from orange3_spark_tpu.core.table import TpuTable
-from orange3_spark_tpu.models.base import Params
+from orange3_spark_tpu.models.base import HasParams, Params
 from orange3_spark_tpu.models.kmeans import _assign, _lloyd
 
 
@@ -58,17 +58,10 @@ def _power_iterate(src, dst, w, v0, *, n: int, max_iter: int):
     return jax.lax.fori_loop(0, max_iter, body, v0)
 
 
-class PowerIterationClustering:
+class PowerIterationClustering(HasParams):
     """Not an Estimator — mirrors MLlib, where PIC has only assignClusters()."""
 
     ParamsCls = PowerIterationClusteringParams
-
-    def __init__(self, params: PowerIterationClusteringParams | None = None, **kwargs):
-        if params is None:
-            params = PowerIterationClusteringParams(**kwargs)
-        elif kwargs:
-            params = params.replace(**kwargs)
-        self.params = params
 
     def assign_clusters(self, dataset) -> np.ndarray:
         """dataset: TpuTable with src/dst/weight attribute columns, or a

@@ -1,0 +1,150 @@
+"""FPGrowth / PrefixSpan vs known ground truth (SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.core.domain import ContinuousVariable, Domain, StringVariable
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models.fpm import FPGrowth, PrefixSpan
+
+
+def _basket_table(session, baskets):
+    dom = Domain([ContinuousVariable("x")], None, [StringVariable("items")])
+    X = np.zeros((len(baskets), 1), dtype=np.float32)
+    metas = np.empty((len(baskets), 1), dtype=object)
+    for i, b in enumerate(baskets):
+        metas[i, 0] = b
+    return TpuTable.from_numpy(dom, X, metas=metas, session=session)
+
+
+BASKETS = [
+    ["bread", "milk"],
+    ["bread", "diapers", "beer", "eggs"],
+    ["milk", "diapers", "beer", "cola"],
+    ["bread", "milk", "diapers", "beer"],
+    ["bread", "milk", "diapers", "cola"],
+]
+
+
+def test_fpgrowth_frequent_itemsets(session):
+    t = _basket_table(session, BASKETS)
+    model = FPGrowth(min_support=0.6, items_col="items").fit(t)
+    sets = {tuple(f["items"]): f["freq"] for f in model.freq_itemsets()}
+    # classic textbook result: {beer,diapers} support 3/5
+    assert sets[("bread",)] == 4.0
+    assert sets[("milk",)] == 4.0
+    assert sets[("diapers",)] == 4.0
+    assert sets[("beer", "diapers")] == 3.0
+    assert ("beer",) in sets and sets[("beer",)] == 3.0
+    # {beer, cola} has support 1/5 -> absent
+    assert ("beer", "cola") not in sets
+
+
+def test_fpgrowth_matches_mlxtend_style_bruteforce(session):
+    rng = np.random.default_rng(0)
+    items = list("abcdef")
+    baskets = [
+        [it for it in items if rng.random() < 0.4] or ["a"] for _ in range(120)
+    ]
+    t = _basket_table(session, baskets)
+    model = FPGrowth(min_support=0.25, items_col="items").fit(t)
+    got = {frozenset(f["items"]): f["freq"] for f in model.freq_itemsets()}
+    # brute force
+    import itertools as itl
+
+    min_count = 0.25 * len(baskets)
+    expect = {}
+    for r in range(1, 4):
+        for combo in itl.combinations(items, r):
+            c = sum(1 for b in baskets if set(combo) <= set(b))
+            if c >= min_count:
+                expect[frozenset(combo)] = float(c)
+    for s, c in expect.items():
+        assert got.get(s) == c, (sorted(s), c, got.get(s))
+    # no false positives at sizes 1..3
+    assert all(len(s) > 3 or s in expect for s in got)
+
+
+def test_fpgrowth_association_rules_and_transform(session):
+    t = _basket_table(session, BASKETS)
+    model = FPGrowth(min_support=0.5, min_confidence=0.7, items_col="items").fit(t)
+    rules = model.association_rules_
+    assert any(r["antecedent"] == ["beer"] and r["consequent"] == ["diapers"]
+               for r in rules)
+    r = next(r for r in rules if r["antecedent"] == ["beer"])
+    assert abs(r["confidence"] - 1.0) < 1e-9  # beer always with diapers
+    assert r["lift"] == pytest.approx(1.0 / (4 / 5))
+    out = model.transform(t)
+    names = [v.name for v in out.domain.attributes]
+    assert any(n.startswith("pred_") for n in names)
+    X = out.to_numpy()[0]
+    j = names.index("pred_diapers")
+    assert X[0, j] == 1.0  # basket 0 {bread, milk} -> rules imply diapers
+
+
+def test_fpgrowth_on_binary_columns(session):
+    # items_col="" mode: attributes ARE the items
+    X = np.array([[1, 1, 0], [1, 0, 0], [1, 1, 1], [0, 1, 0]], np.float32)
+    t = TpuTable.from_arrays(X, attr_names=["a", "b", "c"], session=session)
+    model = FPGrowth(min_support=0.5).fit(t)
+    sets = {tuple(f["items"]): f["freq"] for f in model.freq_itemsets()}
+    assert sets[("a",)] == 3.0 and sets[("b",)] == 3.0
+    assert sets[("a", "b")] == 2.0
+
+
+def _seq_table(session, seqs):
+    dom = Domain([ContinuousVariable("x")], None, [StringVariable("sequence")])
+    X = np.zeros((len(seqs), 1), dtype=np.float32)
+    metas = np.empty((len(seqs), 1), dtype=object)
+    for i, s in enumerate(seqs):
+        metas[i, 0] = s
+    return TpuTable.from_numpy(dom, X, metas=metas, session=session)
+
+
+def test_prefixspan_basic(session):
+    seqs = [
+        [["a"], ["b"], ["c"]],
+        [["a"], ["c"]],
+        [["a"], ["b"]],
+        [["b"], ["c"]],
+    ]
+    t = _seq_table(session, seqs)
+    ps = PrefixSpan(min_support=0.5, sequence_col="sequence")
+    pats = {tuple(tuple(e) for e in r["sequence"]): r["freq"]
+            for r in ps.find_frequent_sequential_patterns(t)}
+    assert pats[(("a",),)] == 3
+    assert pats[(("b",),)] == 3
+    assert pats[(("c",),)] == 3
+    assert pats[(("a",), ("b",))] == 2
+    assert pats[(("a",), ("c",))] == 2
+    assert pats[(("b",), ("c",))] == 2
+    # order matters: c then a never happens
+    assert (("c",), ("a",)) not in pats
+
+
+def test_prefixspan_itemset_elements(session):
+    # multi-item elements: <(a b)> must be found as one element, and
+    # <(a b) c> as the two-element sequential pattern
+    seqs = [
+        [["a", "b"], ["c"]],
+        [["b", "a"], ["c"]],
+        [["a", "b"], ["d"]],
+    ]
+    t = _seq_table(session, seqs)
+    ps = PrefixSpan(min_support=0.9, sequence_col="sequence")
+    pats = {tuple(tuple(sorted(e)) for e in r["sequence"]): r["freq"]
+            for r in ps.find_frequent_sequential_patterns(t)}
+    assert pats[(("a", "b"),)] == 3
+    assert pats[(("a",),)] == 3 and pats[(("b",),)] == 3
+    ps2 = PrefixSpan(min_support=0.6, sequence_col="sequence")
+    pats2 = {tuple(tuple(sorted(e)) for e in r["sequence"]): r["freq"]
+             for r in ps2.find_frequent_sequential_patterns(t)}
+    assert pats2[(("a", "b"), ("c",))] == 2
+
+
+def test_prefixspan_max_pattern_length(session):
+    seqs = [[["a"], ["b"], ["c"], ["d"]]] * 4
+    t = _seq_table(session, seqs)
+    ps = PrefixSpan(min_support=0.9, max_pattern_length=2, sequence_col="sequence")
+    pats = ps.find_frequent_sequential_patterns(t)
+    assert max(len(r["sequence"]) for r in pats) == 2
