@@ -1,0 +1,188 @@
+"""ctypes binding for the native fastcsv engine (native/fastcsv.cpp).
+
+The reference's ingest substrate is native too — Spark's JVM CSV reader into
+Tungsten columnar memory (SURVEY.md §2b "Data ingest"; reconstructed, mount
+empty). Here the C++ side produces row-major float32 chunks that go straight
+into ``jax.device_put`` with P('data', None) sharding — no pandas hop, no
+Python-level per-cell work. The library is compiled on first use with g++
+(-O3 -pthread) and cached next to the source. ``read_csv_native`` falls back to the pyarrow
+reader (io/readers.py) when no toolchain is available; the chunked
+``NativeCsvReader`` API raises ``NativeUnavailable`` explicitly.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "native", "fastcsv.cpp")
+_LIB = os.path.join(os.path.dirname(_SRC), "_fastcsv.so")
+_lock = threading.Lock()
+_lib = None
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _build() -> str:
+    # compile to a temp name, then atomically rename: another PROCESS (the
+    # module lock is per-process) may race us to dlopen the final path and
+    # must never see a half-written ELF
+    tmp = f"{_LIB}.build.{os.getpid()}"
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+        _SRC, "-o", tmp,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _LIB)
+    except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
+        detail = getattr(e, "stderr", str(e))
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise NativeUnavailable(f"fastcsv build failed: {detail}") from e
+    return _LIB
+
+
+def get_lib():
+    """Load (building if stale) the fastcsv shared library."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            _build()
+        lib = ctypes.CDLL(_LIB)
+        lib.fcsv_open.restype = ctypes.c_void_p
+        lib.fcsv_open.argtypes = [ctypes.c_char_p, ctypes.c_char, ctypes.c_int]
+        lib.fcsv_ncols.restype = ctypes.c_int
+        lib.fcsv_ncols.argtypes = [ctypes.c_void_p]
+        lib.fcsv_colname.restype = ctypes.c_char_p
+        lib.fcsv_colname.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.fcsv_read_chunk.restype = ctypes.c_long
+        lib.fcsv_read_chunk.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long,
+            ctypes.c_int,
+        ]
+        lib.fcsv_close.restype = None
+        lib.fcsv_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class NativeCsvReader:
+    """Chunked reader over one CSV file.
+
+    >>> r = NativeCsvReader("data.csv")
+    >>> r.colnames
+    ['a', 'b']
+    >>> for chunk in r.chunks(1_000_000):   # f32 [rows, ncols] views
+    ...     device_put(chunk, sharding)
+    """
+
+    def __init__(self, path: str, *, delimiter: str = ",", header: bool = True,
+                 n_threads: int = 0):
+        self._lib = get_lib()
+        self._h = self._lib.fcsv_open(
+            path.encode(), delimiter.encode()[0:1] or b",", int(header)
+        )
+        if not self._h:
+            raise FileNotFoundError(path)
+        self.n_threads = n_threads
+        self.ncols = self._lib.fcsv_ncols(self._h)
+        self.colnames = [
+            self._lib.fcsv_colname(self._h, j).decode() for j in range(self.ncols)
+        ]
+
+    def read_chunk(self, max_rows: int) -> np.ndarray | None:
+        """Next up-to-max_rows rows as f32 [rows, ncols]; None at EOF."""
+        if self._h is None:
+            return None
+        buf = np.empty((max_rows, self.ncols), dtype=np.float32)
+        n = self._lib.fcsv_read_chunk(
+            self._h,
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            max_rows,
+            self.n_threads,
+        )
+        if n == 0:
+            return None
+        if n == max_rows:
+            return buf
+        # short (trailing) chunk: copy so the view doesn't pin the full buffer
+        return buf[:n].copy()
+
+    def chunks(self, chunk_rows: int):
+        while True:
+            c = self.read_chunk(chunk_rows)
+            if c is None:
+                break
+            yield c
+
+    def read_all(self, chunk_rows: int = 1 << 20) -> np.ndarray:
+        parts = list(self.chunks(chunk_rows))
+        if not parts:
+            return np.empty((0, self.ncols), dtype=np.float32)
+        return np.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+
+    def close(self):
+        if self._h is not None:
+            self._lib.fcsv_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # pragma: no cover - GC safety net
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def read_csv_native(path: str, class_col: str = "", *, delimiter: str = ",",
+                    header: bool = True, session=None, n_threads: int = 0):
+    """Whole-file native read -> TpuTable (numeric columns only; string
+    columns come through as NaN — use io.readers.read_csv for mixed schema).
+    Falls back to the pyarrow reader when the native engine can't build."""
+    from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+    from orange3_spark_tpu.core.table import TpuTable
+
+    try:
+        get_lib()
+    except NativeUnavailable:
+        from orange3_spark_tpu.io.readers import CsvReaderParams, read_csv
+
+        return read_csv(
+            params=CsvReaderParams(path=path, class_col=class_col,
+                                   header=header, delimiter=delimiter),
+            session=session,
+        )
+    with NativeCsvReader(path, delimiter=delimiter, header=header,
+                         n_threads=n_threads) as r:
+        data = r.read_all()
+        names = list(r.colnames)
+    if class_col:
+        if class_col not in names:
+            raise ValueError(f"class_col {class_col!r} not in {names}")
+        ci = names.index(class_col)
+        y = data[:, ci]
+        keep = [j for j in range(len(names)) if j != ci]
+        X = np.ascontiguousarray(data[:, keep])
+        attrs = [ContinuousVariable(names[j]) for j in keep]
+        domain = Domain(attrs, ContinuousVariable(class_col))
+        return TpuTable.from_numpy(domain, X, y, session=session)
+    domain = Domain([ContinuousVariable(n) for n in names])
+    return TpuTable.from_numpy(domain, data, session=session)

@@ -1,0 +1,271 @@
+"""Out-of-core streaming fit — the 1B-row path (Criteo / NYC-Taxi configs).
+
+Spark streams these workloads by construction: rows live partitioned on the
+cluster and every L-BFGS iteration treeAggregates over all executors
+(SURVEY.md §3 step 3; reconstructed, mount empty). A single TPU host can't
+hold 1B rows either, so the TPU-native path is a **chunk pipeline**:
+
+    native fastcsv chunk (C++ threads, f32 row-major)
+      -> jax.device_put onto the data-axis sharding   (host->HBM DMA)
+      -> one jitted minibatch update step             (MXU)
+
+with three overlap properties:
+
+* every chunk has the SAME padded shape, so the update step compiles once
+  and is reused for the whole stream;
+* JAX dispatch is async — while the TPU runs step t, the C++ parser and the
+  DMA for chunk t+1 proceed on host threads (double buffering for free);
+* the optimizer state lives on device; nothing but the raw chunk crosses
+  the host boundary, once.
+
+``StreamingLinearEstimator`` fits logistic / squared / hinge losses with
+adam over epochs of chunks and returns the SAME model classes the in-memory
+estimators produce, so downstream transform/evaluate/save code sees no
+difference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable, Iterable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from orange3_spark_tpu.core.session import TpuSession
+from orange3_spark_tpu.models.base import Estimator, Params
+
+# (X [n,d], y [n] or None) or (X, y, w) — sources may carry row weights
+Chunk = tuple
+
+
+def csv_chunk_source(
+    path: str, class_col: str = "", *, chunk_rows: int = 1 << 20,
+    delimiter: str = ",", header: bool = True, n_threads: int = 0,
+) -> Callable[[], Iterator[Chunk]]:
+    """Re-iterable chunk source over a CSV file via the native parser.
+
+    Returns a zero-arg callable (epochs need to restart the stream)."""
+    from orange3_spark_tpu.io.native import NativeCsvReader
+
+    def open_stream() -> Iterator[Chunk]:
+        with NativeCsvReader(path, delimiter=delimiter, header=header,
+                             n_threads=n_threads) as r:
+            if class_col:
+                if class_col not in r.colnames:
+                    raise ValueError(
+                        f"class_col {class_col!r} not in {r.colnames}"
+                    )
+                ci = r.colnames.index(class_col)
+                keep = [j for j in range(r.ncols) if j != ci]
+                for c in r.chunks(chunk_rows):
+                    yield np.ascontiguousarray(c[:, keep]), c[:, ci]
+            else:
+                for c in r.chunks(chunk_rows):
+                    yield c, None
+
+    return open_stream
+
+
+def array_chunk_source(X: np.ndarray, y: np.ndarray | None = None,
+                       w: np.ndarray | None = None,
+                       *, chunk_rows: int = 1 << 16) -> Callable[[], Iterator[Chunk]]:
+    """Chunk an in-memory array (testing / small data)."""
+
+    def open_stream() -> Iterator[Chunk]:
+        for s in range(0, len(X), chunk_rows):
+            e = min(s + chunk_rows, len(X))
+            yield (X[s:e],
+                   None if y is None else y[s:e],
+                   None if w is None else w[s:e])
+
+    return open_stream
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamingLinearParams(Params):
+    loss: str = "logistic"       # 'logistic' | 'squared' | 'squared_hinge'
+    n_classes: int = 2           # k for logistic
+    epochs: int = 1
+    step_size: float = 0.01
+    reg_param: float = 0.0       # L2
+    chunk_rows: int = 1 << 18    # padded device batch per step
+    seed: int = 0
+
+
+def _rechunk(stream: Iterator[Chunk], rows: int) -> Iterator[tuple]:
+    """Normalize a stream of (X, y[, w]) chunks of arbitrary sizes into
+    batches of EXACTLY ``rows`` rows (the final one may be short) — source
+    chunk sizes then never have to match the device batch size."""
+    bx, by, bw = [], [], []
+    have = 0
+    any_y = any_w = False
+
+    def flush(upto):
+        nonlocal bx, by, bw, have
+        X = np.concatenate(bx) if len(bx) > 1 else bx[0]
+        y = (np.concatenate(by) if len(by) > 1 else by[0]) if any_y else None
+        w = (np.concatenate(bw) if len(bw) > 1 else bw[0]) if any_w else None
+        out = (X[:upto],
+               None if y is None else y[:upto],
+               None if w is None else w[:upto])
+        rest_x, rest_y, rest_w = X[upto:], \
+            None if y is None else y[upto:], None if w is None else w[upto:]
+        bx = [rest_x] if len(rest_x) else []
+        by = [rest_y] if (rest_y is not None and len(rest_y)) else []
+        bw = [rest_w] if (rest_w is not None and len(rest_w)) else []
+        have = len(rest_x)
+        return out
+
+    for chunk in stream:
+        X, y, w = (chunk + (None, None))[:3]
+        bx.append(X)
+        if y is not None:
+            by.append(y)
+            any_y = True
+        if w is not None:
+            bw.append(w)
+            any_w = True
+        have += len(X)
+        while have >= rows:
+            yield flush(rows)
+    if have:
+        yield flush(have)
+
+
+# one module-level optimizer so the jitted step has a stable identity; the
+# learning rate is applied by scaling adam's unit-lr updates with the traced
+# ``lr`` argument (adam(lr) == lr * adam(1.0) updates)
+_ADAM_UNIT = optax.adam(1.0)
+
+
+@partial(jax.jit, static_argnames=("loss_kind",), donate_argnums=(0, 1))
+def _stream_step(theta, opt_state, X, y, w, reg, lr, *, loss_kind: str):
+    def loss_fn(theta):
+        logits = X @ theta["coef"] + theta["intercept"]
+        if loss_kind == "logistic":
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            row = -jnp.take_along_axis(
+                logp, y.astype(jnp.int32)[:, None], axis=1
+            )[:, 0]
+        elif loss_kind == "squared":
+            row = 0.5 * (logits[:, 0] - y) ** 2
+        elif loss_kind == "squared_hinge":
+            sign = 2.0 * y - 1.0
+            row = jnp.maximum(0.0, 1.0 - sign * logits[:, 0]) ** 2
+        else:  # pragma: no cover
+            raise ValueError(loss_kind)
+        sw = jnp.maximum(jnp.sum(w), 1e-12)
+        return jnp.sum(row * w) / sw + 0.5 * reg * jnp.sum(theta["coef"] ** 2)
+
+    loss, g = jax.value_and_grad(loss_fn)(theta)
+    updates, opt_state = _ADAM_UNIT.update(g, opt_state, theta)
+    updates = jax.tree.map(lambda u: lr * u, updates)
+    return optax.apply_updates(theta, updates), opt_state, loss
+
+
+class StreamingLinearEstimator(Estimator):
+    """Minibatch-over-chunks trainer producing the standard model classes.
+
+    fit_stream(source, n_features) -> LogisticRegressionModel /
+    LinearRegressionModel / LinearSVCModel depending on ``loss``.
+    """
+
+    ParamsCls = StreamingLinearParams
+    params: StreamingLinearParams
+
+    def _fit(self, table):  # Estimator protocol: in-memory table fallback
+        from orange3_spark_tpu.models.base import infer_class_values
+
+        X, Y, W = table.to_numpy()
+        y = Y[:, 0] if Y is not None else None
+        class_values = (
+            infer_class_values(table) if self.params.loss == "logistic" else None
+        )
+        return self.fit_stream(
+            array_chunk_source(X, y, W, chunk_rows=self.params.chunk_rows),
+            n_features=X.shape[1],
+            session=table.session,
+            class_values=class_values,
+        )
+
+    def fit_stream(self, source: Callable[[], Iterator[Chunk]], *,
+                   n_features: int, session: TpuSession | None = None,
+                   class_values: tuple | None = None):
+        p = self.params
+        session = session or TpuSession.active()
+        k = p.n_classes if p.loss == "logistic" else 1
+        if class_values is not None:
+            k = max(k, len(class_values)) if p.loss == "logistic" else k
+        theta = {
+            "coef": jnp.zeros((n_features, k), jnp.float32),
+            "intercept": jnp.zeros((k,), jnp.float32),
+        }
+        opt_state = _ADAM_UNIT.init(theta)
+        pad_rows = session.pad_rows(p.chunk_rows)
+        row_sh = session.row_sharding
+        vec_sh = session.vector_sharding
+        reg = jnp.float32(p.reg_param)
+        lr = jnp.float32(p.step_size)
+        n_steps = 0
+        last_loss = None
+        for _ in range(p.epochs):
+            for X_np, y_np, w_np in _rechunk(source(), pad_rows):
+                # every device batch is EXACTLY pad_rows tall (last one padded
+                # with w=0): one compiled _stream_step serves the whole stream
+                n = X_np.shape[0]
+                Xp = np.zeros((pad_rows, n_features), np.float32)
+                Xp[:n] = X_np
+                yp = np.zeros((pad_rows,), np.float32)
+                if y_np is not None:
+                    yp[:n] = y_np
+                wp = np.zeros((pad_rows,), np.float32)
+                wp[:n] = 1.0 if w_np is None else w_np
+                Xd = jax.device_put(Xp, row_sh)
+                yd = jax.device_put(yp, vec_sh)
+                wd = jax.device_put(wp, vec_sh)
+                theta, opt_state, loss = _stream_step(
+                    theta, opt_state, Xd, yd, wd, reg, lr,
+                    loss_kind=p.loss,
+                )
+                n_steps += 1
+                last_loss = loss
+        model = self._wrap_model(theta, k, class_values)
+        model.n_steps_ = n_steps
+        model.final_loss_ = float(last_loss) if last_loss is not None else None
+        return model
+
+    def _wrap_model(self, theta, k, class_values=None):
+        p = self.params
+        if p.loss == "logistic":
+            from orange3_spark_tpu.models.logistic_regression import (
+                LogisticRegressionModel,
+                LogisticRegressionParams,
+            )
+
+            return LogisticRegressionModel(
+                LogisticRegressionParams(), theta["coef"], theta["intercept"],
+                class_values or tuple(str(i) for i in range(k)),
+            )
+        if p.loss == "squared":
+            from orange3_spark_tpu.models.linear_regression import (
+                LinearRegressionModel,
+                LinearRegressionParams,
+            )
+
+            return LinearRegressionModel(
+                LinearRegressionParams(), theta["coef"][:, 0],
+                theta["intercept"][0],
+            )
+        from orange3_spark_tpu.models.linear_svc import (
+            LinearSVCModel,
+            LinearSVCParams,
+        )
+
+        return LinearSVCModel(
+            LinearSVCParams(), theta["coef"], theta["intercept"],
+            class_values or ("0", "1"),
+        )

@@ -1,0 +1,243 @@
+// fastcsv — multithreaded CSV -> float32 columnar chunks.
+//
+// Plays the role of Spark's native ingest substrate (the JVM CSV reader +
+// Tungsten columnar memory behind `spark.read.csv`; SURVEY.md §2b "Data
+// ingest" — reconstructed, reference mount empty). The TPU framework's hot
+// ingest path must keep the single host core from becoming the bottleneck
+// between disk and `jax.device_put`, so parsing is:
+//
+//   * chunked: the file is read in large blocks clipped to line boundaries,
+//     so a 1B-row file streams through a fixed host-memory window
+//     (out-of-core — the NYC-Taxi/Criteo configs never fit in RAM);
+//   * parallel: each chunk's rows are split across threads; every thread
+//     writes disjoint [row, col] slots of the caller's buffer, no locks;
+//   * allocation-free in steady state: one pass memchr's newline offsets,
+//     then a hand-rolled float parser (no strtof locale machinery) fills
+//     the row-major float32 buffer the Python side hands in (which is the
+//     exact layout device_put wants for P('data', None) sharding).
+//
+// C API only (extern "C") — bound from Python with ctypes; no pybind11.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct CsvHandle {
+  FILE* f = nullptr;
+  char delim = ',';
+  std::vector<std::string> colnames;
+  int ncols = 0;
+  // carry: bytes of a trailing partial line from the previous block
+  std::vector<char> carry;
+  bool eof = false;
+  long rows_read = 0;
+};
+
+// fast float parser: [-+]?digits[.digits][(e|E)[-+]digits]; NaN on garbage.
+// Returns value, advances *p to the first unconsumed char.
+inline float parse_float(const char* p, const char* end, const char** out) {
+  const char* s = p;
+  while (s < end && (*s == ' ' || *s == '\t')) ++s;
+  bool neg = false;
+  if (s < end && (*s == '-' || *s == '+')) { neg = (*s == '-'); ++s; }
+  double val = 0.0;
+  bool any = false;
+  while (s < end && *s >= '0' && *s <= '9') {
+    val = val * 10.0 + (*s - '0');
+    any = true;
+    ++s;
+  }
+  if (s < end && *s == '.') {
+    ++s;
+    double frac = 0.1;
+    while (s < end && *s >= '0' && *s <= '9') {
+      val += (*s - '0') * frac;
+      frac *= 0.1;
+      any = true;
+      ++s;
+    }
+  }
+  if (any && s < end && (*s == 'e' || *s == 'E')) {
+    const char* es = s + 1;
+    bool eneg = false;
+    if (es < end && (*es == '-' || *es == '+')) { eneg = (*es == '-'); ++es; }
+    int ev = 0;
+    bool eany = false;
+    while (es < end && *es >= '0' && *es <= '9') {
+      ev = ev * 10 + (*es - '0');
+      eany = true;
+      ++es;
+    }
+    if (eany) {
+      val *= std::pow(10.0, eneg ? -ev : ev);
+      s = es;
+    }
+  }
+  *out = s;
+  if (!any) return std::nanf("");
+  return static_cast<float>(neg ? -val : val);
+}
+
+// parse rows [r0, r1) given newline offsets; writes out[row*ncols + col].
+void parse_rows(const char* buf, const std::vector<size_t>& starts,
+                const std::vector<size_t>& ends, size_t r0, size_t r1,
+                int ncols, char delim, float* out) {
+  for (size_t r = r0; r < r1; ++r) {
+    const char* p = buf + starts[r];
+    const char* end = buf + ends[r];
+    float* row = out + r * ncols;
+    int c = 0;
+    while (c < ncols) {
+      const char* next;
+      row[c] = parse_float(p, end, &next);
+      p = next;
+      // skip to the delimiter (tolerates quoted junk: everything until the
+      // delimiter belongs to this cell; non-numeric cells came back NaN)
+      while (p < end && *p != delim) ++p;
+      if (p < end) ++p;  // eat delimiter
+      ++c;
+      if (p >= end) break;
+    }
+    for (; c < ncols; ++c) row[c] = std::nanf("");
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* fcsv_open(const char* path, char delim, int header) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  auto* h = new CsvHandle();
+  h->f = f;
+  h->delim = delim;
+  // read the first line for the schema (names or column count)
+  std::string line;
+  int ch;
+  while ((ch = std::fgetc(f)) != EOF && ch != '\n') line.push_back((char)ch);
+  if (!line.empty() && line.back() == '\r') line.pop_back();
+  int ncols = 1;
+  for (char c : line) ncols += (c == delim);
+  h->ncols = ncols;
+  size_t start = 0;
+  for (int j = 0; j < ncols; ++j) {
+    size_t pos = line.find(delim, start);
+    std::string name = line.substr(
+        start, pos == std::string::npos ? std::string::npos : pos - start);
+    h->colnames.push_back(header ? name : ("c" + std::to_string(j)));
+    start = (pos == std::string::npos) ? line.size() : pos + 1;
+  }
+  if (!header) {
+    // first line was data — replay it through the carry buffer
+    h->carry.assign(line.begin(), line.end());
+    h->carry.push_back('\n');
+  }
+  return h;
+}
+
+int fcsv_ncols(void* hv) { return static_cast<CsvHandle*>(hv)->ncols; }
+
+const char* fcsv_colname(void* hv, int j) {
+  auto* h = static_cast<CsvHandle*>(hv);
+  if (j < 0 || j >= h->ncols) return "";
+  return h->colnames[j].c_str();
+}
+
+// Parse up to max_rows rows into out (row-major f32 [max_rows, ncols]).
+// Returns rows produced; 0 => EOF. nthreads <= 0 => hardware concurrency.
+long fcsv_read_chunk(void* hv, float* out, long max_rows, int nthreads) {
+  auto* h = static_cast<CsvHandle*>(hv);
+  if (max_rows <= 0) return 0;
+  const int ncols = h->ncols;
+  // target block: ~48 bytes/cell upper bound keeps us under max_rows lines
+  // in almost all cases; loop tops up if lines are shorter.
+  std::vector<char> buf(std::move(h->carry));
+  h->carry.clear();
+  std::vector<size_t> starts, ends;
+  starts.reserve(max_rows);
+  ends.reserve(max_rows);
+  size_t scan_from = 0;
+  long nrows = 0;
+  while (nrows < max_rows) {
+    // find line breaks in what we have
+    while (nrows < max_rows) {
+      const char* base = buf.data();
+      const char* nl = static_cast<const char*>(
+          memchr(base + scan_from, '\n', buf.size() - scan_from));
+      if (!nl) break;
+      size_t line_end = nl - base;
+      size_t line_start = scan_from;
+      scan_from = line_end + 1;
+      if (line_end > line_start && base[line_end - 1] == '\r') --line_end;
+      if (line_end > line_start) {  // skip blank lines
+        starts.push_back(line_start);
+        ends.push_back(line_end);
+        ++nrows;
+      }
+    }
+    if (nrows >= max_rows || h->eof) break;
+    // top up the buffer
+    size_t old = buf.size();
+    size_t want = 4u << 20;  // 4 MB reads
+    buf.resize(old + want);
+    size_t got = std::fread(buf.data() + old, 1, want, h->f);
+    buf.resize(old + got);
+    if (got == 0) {
+      h->eof = true;
+      // trailing line without newline
+      if (scan_from < buf.size()) {
+        size_t line_end = buf.size();
+        if (line_end > scan_from && buf[line_end - 1] == '\r') --line_end;
+        if (line_end > scan_from && nrows < max_rows) {
+          starts.push_back(scan_from);
+          ends.push_back(line_end);
+          scan_from = buf.size();
+          ++nrows;
+        }
+      }
+      break;
+    }
+  }
+  // stash the tail (unconsumed bytes) for the next chunk
+  if (scan_from < buf.size()) {
+    h->carry.assign(buf.begin() + scan_from, buf.end());
+  }
+  if (nrows == 0) return 0;
+  int T = nthreads > 0 ? nthreads
+                       : (int)std::thread::hardware_concurrency();
+  if (T < 1) T = 1;
+  if ((long)T > nrows) T = (int)nrows;
+  if (T == 1) {
+    parse_rows(buf.data(), starts, ends, 0, nrows, ncols, h->delim, out);
+  } else {
+    std::vector<std::thread> threads;
+    size_t per = (nrows + T - 1) / T;
+    for (int t = 0; t < T; ++t) {
+      size_t r0 = t * per;
+      size_t r1 = std::min<size_t>(r0 + per, nrows);
+      if (r0 >= r1) break;
+      threads.emplace_back(parse_rows, buf.data(), std::cref(starts),
+                           std::cref(ends), r0, r1, ncols, h->delim, out);
+    }
+    for (auto& th : threads) th.join();
+  }
+  h->rows_read += nrows;
+  return nrows;
+}
+
+void fcsv_close(void* hv) {
+  auto* h = static_cast<CsvHandle*>(hv);
+  if (h->f) std::fclose(h->f);
+  delete h;
+}
+
+}  // extern "C"

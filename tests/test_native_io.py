@@ -1,0 +1,172 @@
+"""Native fastcsv engine + out-of-core streaming fit (SURVEY §2b ingest)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.io.native import (
+    NativeCsvReader,
+    NativeUnavailable,
+    read_csv_native,
+)
+from orange3_spark_tpu.io.streaming import (
+    StreamingLinearEstimator,
+    array_chunk_source,
+    csv_chunk_source,
+)
+
+pytestmark = pytest.mark.filterwarnings("ignore::RuntimeWarning")
+
+
+@pytest.fixture(scope="module")
+def csv_file(tmp_path_factory):
+    rng = np.random.default_rng(0)
+    path = tmp_path_factory.mktemp("nio") / "data.csv"
+    n, d = 10_000, 6
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    w = rng.standard_normal(d).astype(np.float32)
+    y = (X @ w > 0).astype(np.float32)
+    with open(path, "w") as f:
+        f.write(",".join([f"f{j}" for j in range(d)] + ["label"]) + "\n")
+        for i in range(n):
+            f.write(",".join(f"{v:.6g}" for v in X[i]) + f",{int(y[i])}\n")
+    return str(path), X, y
+
+
+def test_native_reader_schema_and_values(csv_file):
+    path, X, y = csv_file
+    with NativeCsvReader(path) as r:
+        assert r.colnames == [f"f{j}" for j in range(6)] + ["label"]
+        data = r.read_all()
+    assert data.shape == (10_000, 7)
+    np.testing.assert_allclose(data[:, :6], X, rtol=2e-5, atol=1e-5)
+    np.testing.assert_array_equal(data[:, 6], y)
+
+
+def test_native_reader_chunked_matches_whole(csv_file):
+    path, X, _ = csv_file
+    with NativeCsvReader(path) as r:
+        chunks = list(r.chunks(777))  # awkward chunk size crosses buffers
+    assert sum(c.shape[0] for c in chunks) == 10_000
+    joined = np.concatenate(chunks, axis=0)
+    np.testing.assert_allclose(joined[:, :6], X, rtol=2e-5, atol=1e-5)
+
+
+def test_native_reader_no_header(tmp_path):
+    p = tmp_path / "nh.csv"
+    p.write_text("1.5,2\n3,4.25\n")
+    with NativeCsvReader(str(p), header=False) as r:
+        assert r.colnames == ["c0", "c1"]
+        data = r.read_all()
+    np.testing.assert_allclose(data, [[1.5, 2.0], [3.0, 4.25]])
+
+
+def test_native_reader_bad_cells_are_nan(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("a,b\n1,xyz\n,2\n")
+    with NativeCsvReader(str(p)) as r:
+        data = r.read_all()
+    assert data[0, 0] == 1.0 and np.isnan(data[0, 1])
+    assert np.isnan(data[1, 0]) and data[1, 1] == 2.0
+
+
+def test_native_reader_crlf_and_missing_final_newline(tmp_path):
+    p = tmp_path / "crlf.csv"
+    with open(p, "wb") as f:
+        f.write(b"a,b\r\n1,2\r\n3,4")  # CRLF + no trailing newline
+    with NativeCsvReader(str(p)) as r:
+        data = r.read_all()
+    np.testing.assert_allclose(data, [[1, 2], [3, 4]])
+
+
+def test_read_csv_native_to_table(session, csv_file):
+    path, X, y = csv_file
+    t = read_csv_native(path, class_col="label", session=session)
+    assert t.n_rows == 10_000
+    assert [v.name for v in t.domain.attributes] == [f"f{j}" for j in range(6)]
+    Xt, Yt, _ = t.to_numpy()
+    np.testing.assert_allclose(Xt, X, rtol=2e-5, atol=1e-5)
+    np.testing.assert_array_equal(Yt[:, 0], y)
+
+
+def test_streaming_fit_from_csv(session, csv_file):
+    path, X, y = csv_file
+    src = csv_chunk_source(path, class_col="label", chunk_rows=2048)
+    est = StreamingLinearEstimator(
+        loss="logistic", epochs=30, step_size=0.1, chunk_rows=2048
+    )
+    model = est.fit_stream(src, n_features=6, session=session)
+    assert model.n_steps_ == 30 * 5  # ceil(10000/2048) = 5 chunks/epoch
+    from orange3_spark_tpu.core.table import TpuTable
+
+    t = TpuTable.from_arrays(X, y, class_values=("0", "1"), session=session)
+    acc = np.mean(model.predict(t) == y)
+    assert acc > 0.93
+
+
+def test_streaming_fit_matches_inmemory_quality(session):
+    rng = np.random.default_rng(3)
+    n, d = 4096, 5
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    w = rng.standard_normal(d).astype(np.float32)
+    y = (X @ w + 0.1 * rng.standard_normal(n) > 0).astype(np.float32)
+    est = StreamingLinearEstimator(
+        loss="logistic", epochs=40, step_size=0.1, chunk_rows=1024
+    )
+    model = est.fit_stream(array_chunk_source(X, y, chunk_rows=1024),
+                           n_features=d, session=session)
+    from orange3_spark_tpu.core.table import TpuTable
+
+    t = TpuTable.from_arrays(X, y, class_values=("0", "1"), session=session)
+    assert np.mean(model.predict(t) == y) > 0.95
+
+
+def test_streaming_fit_respects_filter_weights(session):
+    # rows filtered out (W=0) must not train the model
+    rng = np.random.default_rng(5)
+    n = 2048
+    X = rng.standard_normal((n, 2)).astype(np.float32)
+    y_good = (X[:, 0] > 0).astype(np.float32)
+    y = y_good.copy()
+    flip = np.arange(0, n, 2)        # half the rows get adversarial labels...
+    y[flip] = 1 - y[flip]
+    from orange3_spark_tpu.core.table import TpuTable
+    import jax.numpy as jnp
+
+    t = TpuTable.from_arrays(X, y, class_values=("0", "1"), session=session)
+    keep = np.ones(t.n_pad, np.float32)
+    keep[flip] = 0.0                  # ...and are filtered away
+    t2 = t.filter(jnp.asarray(keep) > 0)
+    est = StreamingLinearEstimator(loss="logistic", epochs=40, step_size=0.1,
+                                   chunk_rows=512)
+    model = est.fit(t2)
+    live = np.setdiff1d(np.arange(n), flip)
+    acc = np.mean(model.predict(t)[live] == y[live])
+    assert acc > 0.95  # clean on live rows => flipped rows were ignored
+    assert model.class_values == ("0", "1")
+
+
+def test_rechunk_mismatched_sizes(session):
+    from orange3_spark_tpu.io.streaming import _rechunk
+
+    chunks = [(np.ones((5, 2)) * i, None, None) for i in range(4)]
+    out = list(_rechunk(iter(chunks), 8))
+    assert [len(c[0]) for c in out] == [8, 8, 4]
+    joined = np.concatenate([c[0] for c in out])
+    np.testing.assert_array_equal(
+        joined, np.concatenate([c[0] for c in chunks])
+    )
+
+
+def test_streaming_squared_loss(session):
+    rng = np.random.default_rng(4)
+    X = rng.standard_normal((2048, 3)).astype(np.float32)
+    y = (X @ np.array([1.0, -1.0, 0.5], np.float32)).astype(np.float32)
+    est = StreamingLinearEstimator(loss="squared", epochs=60, step_size=0.2,
+                                   chunk_rows=512)
+    model = est.fit_stream(array_chunk_source(X, y, chunk_rows=512),
+                           n_features=3, session=session)
+    np.testing.assert_allclose(
+        np.asarray(model.coef), [1.0, -1.0, 0.5], atol=0.05
+    )
