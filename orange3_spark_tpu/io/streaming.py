@@ -197,9 +197,19 @@ class StreamingLinearEstimator(Estimator):
                    class_values: tuple | None = None):
         p = self.params
         session = session or TpuSession.active()
-        k = p.n_classes if p.loss == "logistic" else 1
-        if class_values is not None:
-            k = max(k, len(class_values)) if p.loss == "logistic" else k
+        if p.loss == "logistic":
+            if class_values is not None:
+                k = max(2, len(class_values))
+                # keep coef width and label list consistent (transform builds
+                # one probability column per class value)
+                if len(class_values) < k:
+                    class_values = tuple(class_values) + tuple(
+                        f"__class_{i}__" for i in range(len(class_values), k)
+                    )
+            else:
+                k = p.n_classes
+        else:
+            k = 1
         theta = {
             "coef": jnp.zeros((n_features, k), jnp.float32),
             "intercept": jnp.zeros((k,), jnp.float32),
@@ -217,13 +227,29 @@ class StreamingLinearEstimator(Estimator):
                 # every device batch is EXACTLY pad_rows tall (last one padded
                 # with w=0): one compiled _stream_step serves the whole stream
                 n = X_np.shape[0]
-                Xp = np.zeros((pad_rows, n_features), np.float32)
-                Xp[:n] = X_np
-                yp = np.zeros((pad_rows,), np.float32)
-                if y_np is not None:
-                    yp[:n] = y_np
-                wp = np.zeros((pad_rows,), np.float32)
-                wp[:n] = 1.0 if w_np is None else w_np
+                if p.loss == "logistic" and y_np is not None and len(y_np):
+                    y_max = int(y_np.max())
+                    if y_max >= k:
+                        raise ValueError(
+                            f"label {y_max} out of range for k={k} classes; "
+                            "set n_classes= (or pass class_values=) to the "
+                            "true class count"
+                        )
+                if n == pad_rows:
+                    # full chunk: no pad copy — device_put the arrays as-is
+                    Xp = np.ascontiguousarray(X_np, dtype=np.float32)
+                    yp = (np.zeros((n,), np.float32) if y_np is None
+                          else np.ascontiguousarray(y_np, dtype=np.float32))
+                    wp = (np.ones((n,), np.float32) if w_np is None
+                          else np.ascontiguousarray(w_np, dtype=np.float32))
+                else:
+                    Xp = np.zeros((pad_rows, n_features), np.float32)
+                    Xp[:n] = X_np
+                    yp = np.zeros((pad_rows,), np.float32)
+                    if y_np is not None:
+                        yp[:n] = y_np
+                    wp = np.zeros((pad_rows,), np.float32)
+                    wp[:n] = 1.0 if w_np is None else w_np
                 Xd = jax.device_put(Xp, row_sh)
                 yd = jax.device_put(yp, vec_sh)
                 wd = jax.device_put(wp, vec_sh)

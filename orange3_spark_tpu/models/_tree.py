@@ -33,6 +33,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from orange3_spark_tpu.ops.histogram import node_histograms
 from orange3_spark_tpu.ops.stats import weighted_quantiles
 
 EPS = 1e-12
@@ -142,11 +143,9 @@ def grow_tree(
 
     for level in range(depth):
         nodes = 2**level
-        # ---- histograms: scan features, one segment_sum per feature ----
-        def hist_one_feature(j):
-            key = pos * n_bins + B[:, j]
-            return jax.ops.segment_sum(S, key, num_segments=nodes * n_bins)
-        H = jax.vmap(hist_one_feature)(jnp.arange(d))        # [d, nodes*bins, s]
+        # ---- histograms: Pallas MXU kernel on TPU, segment_sum elsewhere
+        # (ops/histogram.py — the findBestSplits treeAggregate equivalent)
+        H = node_histograms(B, S, pos, nodes=nodes, n_bins=n_bins)
         H = H.reshape(d, nodes, n_bins, s)
         Hc = jnp.cumsum(H, axis=2)
         gains, node_w = _impurity_gain(Hc, gain_mode, reg, min_instances)

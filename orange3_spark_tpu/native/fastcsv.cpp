@@ -17,6 +17,12 @@
 //     exact layout device_put wants for P('data', None) sharding).
 //
 // C API only (extern "C") — bound from Python with ctypes; no pybind11.
+//
+// Dialect: RFC-4180-ish. Quoted cells may contain the delimiter ("" escapes
+// a quote); numeric quoted content parses, text becomes NaN. Embedded
+// NEWLINES inside quoted cells are NOT supported (the chunker's newline scan
+// is quote-blind by design — it is what keeps chunk splitting O(memchr)) —
+// use io/readers.py (pyarrow) for such files.
 
 #include <atomic>
 #include <cstdint>
@@ -97,10 +103,26 @@ void parse_rows(const char* buf, const std::vector<size_t>& starts,
     int c = 0;
     while (c < ncols) {
       const char* next;
-      row[c] = parse_float(p, end, &next);
-      p = next;
-      // skip to the delimiter (tolerates quoted junk: everything until the
-      // delimiter belongs to this cell; non-numeric cells came back NaN)
+      if (p < end && *p == '"') {
+        // quoted cell: delimiters inside the quotes belong to the cell
+        // ("" escapes a quote). Numeric content still parses; text -> NaN.
+        const char* q = p + 1;
+        row[c] = parse_float(q, end, &next);
+        while (q < end) {
+          if (*q == '"') {
+            if (q + 1 < end && q[1] == '"') { q += 2; continue; }
+            ++q;  // closing quote
+            break;
+          }
+          ++q;
+        }
+        p = q;
+      } else {
+        row[c] = parse_float(p, end, &next);
+        p = next;
+      }
+      // skip to the delimiter (unquoted junk until the delimiter belongs to
+      // this cell; non-numeric cells came back NaN)
       while (p < end && *p != delim) ++p;
       if (p < end) ++p;  // eat delimiter
       ++c;

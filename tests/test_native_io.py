@@ -170,3 +170,26 @@ def test_streaming_squared_loss(session):
     np.testing.assert_allclose(
         np.asarray(model.coef), [1.0, -1.0, 0.5], atol=0.05
     )
+
+
+def test_native_reader_quoted_cells(tmp_path):
+    p = tmp_path / "q.csv"
+    p.write_text('a,b,c\n3.5,"Brooklyn, NY",7.25\n"1.5",2,"x""y"\n')
+    with NativeCsvReader(str(p)) as r:
+        data = r.read_all()
+    # quoted text cell is NaN but columns do NOT shift
+    assert data[0, 0] == np.float32(3.5)
+    assert np.isnan(data[0, 1]) and data[0, 2] == np.float32(7.25)
+    # quoted numeric parses; escaped-quote cell is NaN
+    assert data[1, 0] == 1.5 and data[1, 1] == 2.0 and np.isnan(data[1, 2])
+
+
+def test_streaming_label_out_of_range_errors(session):
+    rng = np.random.default_rng(9)
+    X = rng.standard_normal((256, 2)).astype(np.float32)
+    y = rng.integers(0, 3, 256).astype(np.float32)  # 3 classes
+    est = StreamingLinearEstimator(loss="logistic", n_classes=2, epochs=1,
+                                   chunk_rows=128)
+    with pytest.raises(ValueError, match="out of range"):
+        est.fit_stream(array_chunk_source(X, y, chunk_rows=128),
+                       n_features=2, session=session)
