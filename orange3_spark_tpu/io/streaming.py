@@ -136,6 +136,27 @@ def _rechunk(stream: Iterator[Chunk], rows: int) -> Iterator[tuple]:
         yield flush(have)
 
 
+def _pad_chunk(X_np, y_np, w_np, pad_rows: int, n_features: int):
+    """Pad a chunk to EXACTLY pad_rows (padding rows carry w=0); full chunks
+    pass through without a copy. Shared by every streaming estimator."""
+    n = X_np.shape[0]
+    if n == pad_rows:
+        Xp = np.ascontiguousarray(X_np, dtype=np.float32)
+        yp = (np.zeros((n,), np.float32) if y_np is None
+              else np.ascontiguousarray(y_np, dtype=np.float32))
+        wp = (np.ones((n,), np.float32) if w_np is None
+              else np.ascontiguousarray(w_np, dtype=np.float32))
+    else:
+        Xp = np.zeros((pad_rows, n_features), np.float32)
+        Xp[:n] = X_np
+        yp = np.zeros((pad_rows,), np.float32)
+        if y_np is not None:
+            yp[:n] = y_np
+        wp = np.zeros((pad_rows,), np.float32)
+        wp[:n] = 1.0 if w_np is None else w_np
+    return Xp, yp, wp
+
+
 # one module-level optimizer so the jitted step has a stable identity; the
 # learning rate is applied by scaling adam's unit-lr updates with the traced
 # ``lr`` argument (adam(lr) == lr * adam(1.0) updates)
@@ -167,6 +188,98 @@ def _stream_step(theta, opt_state, X, y, w, reg, lr, *, loss_kind: str):
     return optax.apply_updates(theta, updates), opt_state, loss
 
 
+@dataclasses.dataclass(frozen=True)
+class StreamingKMeansParams(Params):
+    k: int = 8
+    epochs: int = 1
+    chunk_rows: int = 1 << 18
+    decay: float = 1.0           # MLlib StreamingKMeans decayFactor
+    seed: int = 0
+
+
+@partial(jax.jit, static_argnames=("k",), donate_argnums=(0, 1))
+def _kmeans_stream_step(centers, counts, X, w, decay, *, k: int):
+    """One aggregated mini-batch update (Sculley 2010 / MLlib StreamingKMeans):
+    per-center sums from this chunk fold into running counts with decay."""
+    from orange3_spark_tpu.models.kmeans import _assign
+
+    assign, cost = _assign(X, centers, w)
+    onehot = jax.nn.one_hot(assign, k, dtype=jnp.float32) * w[:, None]
+    n_i = jnp.sum(onehot, axis=0)                       # [k]
+    sum_i = onehot.T @ X                                # [k, d] MXU
+    counts = decay * counts + n_i
+    centers = jnp.where(
+        n_i[:, None] > 0,
+        centers + (sum_i - n_i[:, None] * centers) / jnp.maximum(counts, 1e-12)[:, None],
+        centers,
+    )
+    return centers, counts, cost
+
+
+class StreamingKMeans(Estimator):
+    """Out-of-core KMeans over a chunk stream (the NYC-Taxi-1B path) —
+    MLlib's StreamingKMeans role: aggregated mini-batch center updates with
+    a decay factor, returning the standard KMeansModel."""
+
+    ParamsCls = StreamingKMeansParams
+    params: StreamingKMeansParams
+
+    def _fit(self, table):
+        X, _, W = table.to_numpy()
+        return self.fit_stream(
+            array_chunk_source(X, None, W, chunk_rows=self.params.chunk_rows),
+            n_features=X.shape[1], session=table.session,
+        )
+
+    def fit_stream(self, source: Callable[[], Iterator[Chunk]], *,
+                   n_features: int, session: TpuSession | None = None):
+        from orange3_spark_tpu.models.kmeans import KMeansModel, KMeansParams
+
+        p = self.params
+        session = session or TpuSession.active()
+        pad_rows = session.pad_rows(p.chunk_rows)
+        row_sh = session.row_sharding
+        vec_sh = session.vector_sharding
+        rng = np.random.default_rng(p.seed)
+        centers = None
+        counts = jnp.zeros((p.k,), jnp.float32)
+        decay = jnp.float32(p.decay)
+        n_steps = 0
+        last_cost = None
+        for _ in range(p.epochs):
+            for X_np, _, w_np in _rechunk(source(), pad_rows):
+                n = X_np.shape[0]
+                if centers is None:
+                    # kmeans++ seeding on (a capped sample of) the first chunk
+                    from orange3_spark_tpu.models.kmeans import kmeanspp_seed
+
+                    live = (np.arange(n) if w_np is None
+                            else np.flatnonzero(np.asarray(w_np) > 0))
+                    if len(live) < 1:
+                        continue
+                    if len(live) > 8192:
+                        live = rng.choice(live, 8192, replace=False)
+                    centers = jax.device_put(
+                        kmeanspp_seed(np.asarray(X_np, np.float32)[live],
+                                      p.k, rng),
+                        session.replicated,
+                    )
+                Xp, _, wp = _pad_chunk(X_np, None, w_np, pad_rows, n_features)
+                Xd = jax.device_put(Xp, row_sh)
+                wd = jax.device_put(wp, vec_sh)
+                centers, counts, cost = _kmeans_stream_step(
+                    centers, counts, Xd, wd, decay, k=p.k
+                )
+                n_steps += 1
+        if centers is None:
+            raise ValueError("stream produced no live rows")
+        model = KMeansModel(KMeansParams(k=p.k), centers)
+        model.n_iter_ = n_steps
+        # training_cost_ stays None: a per-chunk cost is NOT the full-dataset
+        # trainingCost the attribute means — use model.compute_cost(table)
+        return model
+
+
 class StreamingLinearEstimator(Estimator):
     """Minibatch-over-chunks trainer producing the standard model classes.
 
@@ -194,7 +307,11 @@ class StreamingLinearEstimator(Estimator):
 
     def fit_stream(self, source: Callable[[], Iterator[Chunk]], *,
                    n_features: int, session: TpuSession | None = None,
-                   class_values: tuple | None = None):
+                   class_values: tuple | None = None, checkpointer=None):
+        """checkpointer: optional utils.fault.StreamCheckpointer — snapshots
+        (theta, opt_state) every N steps and, if a snapshot exists at start,
+        resumes from it (skipping already-consumed batches), so a killed fit
+        restarted with the same source/params lands on identical numbers."""
         p = self.params
         session = session or TpuSession.active()
         if p.loss == "logistic":
@@ -215,6 +332,18 @@ class StreamingLinearEstimator(Estimator):
             "intercept": jnp.zeros((k,), jnp.float32),
         }
         opt_state = _ADAM_UNIT.init(theta)
+        resume_from = 0
+        ckpt_meta = {"params": p.to_dict(), "n_features": n_features, "k": k}
+        if checkpointer is not None:
+            step0, saved = checkpointer.load(expect_meta=ckpt_meta)
+            if saved is not None:
+                theta = jax.tree.map(jnp.asarray, saved["theta"])
+                opt_state = jax.tree.map(
+                    lambda tmpl, v: jnp.asarray(v) if isinstance(
+                        tmpl, (jax.Array, np.ndarray)) else v,
+                    opt_state, saved["opt_state"],
+                )
+                resume_from = step0
         pad_rows = session.pad_rows(p.chunk_rows)
         row_sh = session.row_sharding
         vec_sh = session.vector_sharding
@@ -224,6 +353,9 @@ class StreamingLinearEstimator(Estimator):
         last_loss = None
         for _ in range(p.epochs):
             for X_np, y_np, w_np in _rechunk(source(), pad_rows):
+                if n_steps < resume_from:
+                    n_steps += 1  # fast-forward past checkpointed batches
+                    continue
                 # every device batch is EXACTLY pad_rows tall (last one padded
                 # with w=0): one compiled _stream_step serves the whole stream
                 n = X_np.shape[0]
@@ -235,21 +367,7 @@ class StreamingLinearEstimator(Estimator):
                             "set n_classes= (or pass class_values=) to the "
                             "true class count"
                         )
-                if n == pad_rows:
-                    # full chunk: no pad copy — device_put the arrays as-is
-                    Xp = np.ascontiguousarray(X_np, dtype=np.float32)
-                    yp = (np.zeros((n,), np.float32) if y_np is None
-                          else np.ascontiguousarray(y_np, dtype=np.float32))
-                    wp = (np.ones((n,), np.float32) if w_np is None
-                          else np.ascontiguousarray(w_np, dtype=np.float32))
-                else:
-                    Xp = np.zeros((pad_rows, n_features), np.float32)
-                    Xp[:n] = X_np
-                    yp = np.zeros((pad_rows,), np.float32)
-                    if y_np is not None:
-                        yp[:n] = y_np
-                    wp = np.zeros((pad_rows,), np.float32)
-                    wp[:n] = 1.0 if w_np is None else w_np
+                Xp, yp, wp = _pad_chunk(X_np, y_np, w_np, pad_rows, n_features)
                 Xd = jax.device_put(Xp, row_sh)
                 yd = jax.device_put(yp, vec_sh)
                 wd = jax.device_put(wp, vec_sh)
@@ -259,6 +377,11 @@ class StreamingLinearEstimator(Estimator):
                 )
                 n_steps += 1
                 last_loss = loss
+                if checkpointer is not None:
+                    checkpointer.maybe_save(
+                        n_steps, {"theta": theta, "opt_state": opt_state},
+                        meta=ckpt_meta,
+                    )
         model = self._wrap_model(theta, k, class_values)
         model.n_steps_ = n_steps
         model.final_loss_ = float(last_loss) if last_loss is not None else None

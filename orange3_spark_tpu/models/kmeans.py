@@ -85,6 +85,35 @@ def _lloyd(X, w, centers0, tol, *, k: int, max_iter: int, compute_dtype=jnp.floa
     return centers, assign, cost, n_iter
 
 
+def kmeanspp_seed(sample: np.ndarray, k: int, rng) -> np.ndarray:
+    """kmeans++ seeding on a host-side sample -> f32[k, d] centers.
+
+    Distances/probabilities run in float64 (float32 D² vectors can fail
+    numpy's choice() sum-to-1 tolerance on large samples) and the result is
+    jitter-padded when the sample has fewer than k distinct points (exact
+    duplicate centers would never win an argmin tie and stay empty forever).
+    Shared by KMeans._init_centers and io.streaming.StreamingKMeans.
+    """
+    sample = np.asarray(sample, dtype=np.float64)
+    m = len(sample)
+    centers = [sample[rng.integers(m)]]
+    d2 = np.sum((sample - centers[0]) ** 2, axis=1)
+    for _ in range(1, min(k, m)):
+        s = d2.sum()
+        if s > 0:
+            p = d2 / s
+            p = p / p.sum()  # exact renormalization for choice()
+            centers.append(sample[rng.choice(m, p=p)])
+        else:  # all remaining points identical to a seed: pick uniformly
+            centers.append(sample[rng.integers(m)])
+        d2 = np.minimum(d2, np.sum((sample - centers[-1]) ** 2, axis=1))
+    out = np.stack(centers)
+    if out.shape[0] < k:  # fewer rows than k: pad with jitter
+        extra = out[rng.integers(out.shape[0], size=k - out.shape[0])]
+        out = np.concatenate([out, extra + 1e-3], axis=0)
+    return out.astype(np.float32)
+
+
 class KMeansModel(Model):
     def __init__(self, params, centers):
         self.params = params
@@ -145,16 +174,7 @@ class KMeans(Estimator):
             # gather the sample ON DEVICE, then pull only those m rows host-ward
             # (never device_get the full [N,d] table)
             sample = np.asarray(jax.device_get(table.X[np.sort(idx)]))
-            centers = [sample[rng.integers(m)]]
-            d2 = np.sum((sample - centers[0]) ** 2, axis=1)
-            for _ in range(1, min(p.k, m)):
-                s = d2.sum()
-                if s > 0:
-                    centers.append(sample[rng.choice(m, p=d2 / s)])
-                else:  # all remaining points identical to a seed: pick uniformly
-                    centers.append(sample[rng.integers(m)])
-                d2 = np.minimum(d2, np.sum((sample - centers[-1]) ** 2, axis=1))
-            centers = np.stack(centers)
+            centers = kmeanspp_seed(sample, p.k, rng)
         else:
             raise ValueError(f"unknown init_mode {p.init_mode!r}")
         if centers.shape[0] < p.k:  # fewer rows than k: pad with jitter

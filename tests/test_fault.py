@@ -1,0 +1,111 @@
+"""Fault injection: kill-and-resume equals uninterrupted (SURVEY §5)."""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.datasets import make_blobs
+from orange3_spark_tpu.io.streaming import (
+    StreamingKMeans,
+    StreamingLinearEstimator,
+    array_chunk_source,
+)
+from orange3_spark_tpu.utils.fault import StreamCheckpointer
+
+
+def _data(n=4096, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    w = rng.standard_normal(d).astype(np.float32)
+    y = (X @ w > 0).astype(np.float32)
+    return X, y
+
+
+def test_kill_and_resume_bit_identical(session, tmp_path):
+    X, y = _data()
+    ckpt_path = str(tmp_path / "stream.ckpt")
+    params = dict(loss="logistic", epochs=4, step_size=0.1, chunk_rows=512)
+    src = lambda: array_chunk_source(X, y, chunk_rows=512)()
+
+    # uninterrupted run (no checkpointing)
+    ref = StreamingLinearEstimator(**params).fit_stream(
+        src, n_features=4, session=session
+    )
+
+    # crashing run: checkpoint every 5 steps, kill mid-flight via a poisoned
+    # source that raises after 23 chunks (mid-epoch 3)
+    ck = StreamCheckpointer(ckpt_path, every_steps=5)
+    served = {"n": 0}
+
+    def crashing_source():
+        for c in src():
+            if served["n"] == 23:
+                raise RuntimeError("injected fault")
+            served["n"] += 1
+            yield c
+
+    with pytest.raises(RuntimeError, match="injected fault"):
+        StreamingLinearEstimator(**params).fit_stream(
+            crashing_source, n_features=4, session=session, checkpointer=ck
+        )
+
+    # resumed run: fresh estimator, same checkpointer -> picks up at step 20
+    step, state = ck.load()
+    assert step == 20 and state is not None
+    resumed = StreamingLinearEstimator(**params).fit_stream(
+        src, n_features=4, session=session, checkpointer=ck
+    )
+    np.testing.assert_array_equal(
+        np.asarray(resumed.coef), np.asarray(ref.coef)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(resumed.intercept), np.asarray(ref.intercept)
+    )
+
+
+def test_checkpointer_atomic_and_empty(tmp_path):
+    ck = StreamCheckpointer(str(tmp_path / "x.ckpt"), every_steps=3)
+    assert ck.load() == (0, None)
+    assert not ck.maybe_save(2, {"a": np.ones(3)})
+    assert ck.maybe_save(3, {"a": np.ones(3)})
+    step, state = ck.load()
+    assert step == 3
+    np.testing.assert_array_equal(state["a"], np.ones(3))
+
+
+def test_streaming_kmeans_recovers_blobs(session):
+    t, true = make_blobs(4000, 3, 4, seed=7, spread=0.4, session=session)
+    X = t.to_numpy()[0]
+    model = StreamingKMeans(k=4, epochs=3, chunk_rows=512, seed=1).fit_stream(
+        array_chunk_source(X, chunk_rows=512), n_features=3, session=session
+    )
+    pred = model.predict(t)
+    hit = 0
+    for c in range(4):
+        m = pred == c
+        if m.sum():
+            hit += np.bincount(true[m].astype(int)).max()
+    assert hit / len(true) > 0.9
+    assert model.cluster_centers_.shape == (4, 3)
+
+
+def test_streaming_kmeans_from_table(session):
+    t, _ = make_blobs(2000, 3, 3, seed=8, spread=0.4, session=session)
+    model = StreamingKMeans(k=3, epochs=2, chunk_rows=512).fit(t)
+    # training_cost_ stays None on the streaming path (a per-chunk cost is
+    # not the dataset trainingCost); full cost comes from compute_cost
+    assert model.training_cost_ is None
+    assert model.compute_cost(t) > 0
+
+
+def test_checkpoint_config_mismatch_refuses(session, tmp_path):
+    X, y = _data(n=1024)
+    ck = StreamCheckpointer(str(tmp_path / "m.ckpt"), every_steps=1)
+    StreamingLinearEstimator(
+        loss="logistic", epochs=1, chunk_rows=256
+    ).fit_stream(array_chunk_source(X, y, chunk_rows=256), n_features=4,
+                 session=session, checkpointer=ck)
+    with pytest.raises(ValueError, match="different"):
+        StreamingLinearEstimator(
+            loss="logistic", epochs=2, chunk_rows=256  # changed config
+        ).fit_stream(array_chunk_source(X, y, chunk_rows=256), n_features=4,
+                     session=session, checkpointer=ck)
