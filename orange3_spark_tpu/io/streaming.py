@@ -385,6 +385,10 @@ class StreamingLinearEstimator(Estimator):
         model = self._wrap_model(theta, k, class_values)
         model.n_steps_ = n_steps
         model.final_loss_ = float(last_loss) if last_loss is not None else None
+        if checkpointer is not None:
+            # a finished fit's snapshot must not fast-forward a FUTURE fit
+            # (same path, same config, different data) past its early batches
+            checkpointer.delete()
         return model
 
     def _wrap_model(self, theta, k, class_values=None):

@@ -108,9 +108,13 @@ def kmeanspp_seed(sample: np.ndarray, k: int, rng) -> np.ndarray:
             centers.append(sample[rng.integers(m)])
         d2 = np.minimum(d2, np.sum((sample - centers[-1]) ** 2, axis=1))
     out = np.stack(centers)
-    if out.shape[0] < k:  # fewer rows than k: pad with jitter
+    if out.shape[0] < k:  # fewer rows than k: pad with PER-ROW random jitter
+        # (a shared constant offset would make the pads exact duplicates of
+        # each other — precisely the dead-center failure this guards against)
         extra = out[rng.integers(out.shape[0], size=k - out.shape[0])]
-        out = np.concatenate([out, extra + 1e-3], axis=0)
+        out = np.concatenate(
+            [out, extra + rng.normal(scale=1e-3, size=extra.shape)], axis=0
+        )
     return out.astype(np.float32)
 
 

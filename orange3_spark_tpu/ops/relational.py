@@ -30,21 +30,32 @@ from orange3_spark_tpu.ops.stats import EPS_TOTAL_WEIGHT
 AGG_FNS = ("sum", "mean", "count", "min", "max")
 
 
-def group_by(table: TpuTable, key: str, aggs: dict[str, str]) -> TpuTable:
-    """df.groupBy(key).agg({col: fn}) with discrete key → k-row table.
+def group_by(table: TpuTable, key, aggs: dict[str, str]) -> TpuTable:
+    """df.groupBy(keys).agg({col: fn}) with discrete key(s) → fixed-row table.
 
-    Output columns: the key (as its category index) + one column per (col, fn)
-    named ``fn_col``; rows ordered by category index. Groups with no live rows
-    get count 0 and NaN for mean/min/max (Spark: such groups are absent; a
-    fixed-shape table keeps them with null-like stats instead).
+    ``key``: one column name or a sequence of them (multi-key groupBy — the
+    composite key is the cross product of the categories, so the result is
+    a FIXED ∏kᵢ-row table; Spark's data-dependent group count has no
+    static-shape analogue). Output columns: each key (as its category index)
+    + one column per (col, fn) named ``fn_col``; rows ordered by composite
+    index. Groups with no live rows get count 0 and NaN for mean/min/max
+    (Spark: such groups are absent; here they stay with null-like stats).
     """
-    kvar = table.domain[key]
-    if not isinstance(kvar, DiscreteVariable) or not kvar.values:
-        raise ValueError(
-            f"group_by key {key!r} must be a DiscreteVariable with known values"
-        )
-    k = len(kvar.values)
-    key_idx = table.column(key).astype(jnp.int32)
+    keys = [key] if isinstance(key, str) else list(key)
+    kvars = []
+    for kname in keys:
+        kvar = table.domain[kname]
+        if not isinstance(kvar, DiscreteVariable) or not kvar.values:
+            raise ValueError(
+                f"group_by key {kname!r} must be a DiscreteVariable with known values"
+            )
+        kvars.append(kvar)
+    sizes = [len(v.values) for v in kvars]
+    k = int(np.prod(sizes))
+    # composite index: row-major over the key tuple
+    key_idx = jnp.zeros((table.n_pad,), jnp.int32)
+    for kname, sz in zip(keys, sizes):
+        key_idx = key_idx * sz + table.column(kname).astype(jnp.int32)
     for col, fn in aggs.items():
         if fn not in AGG_FNS:
             raise ValueError(f"unknown agg {fn!r}; supported: {AGG_FNS}")
@@ -59,10 +70,14 @@ def group_by(table: TpuTable, key: str, aggs: dict[str, str]) -> TpuTable:
     counts, sums, mins, maxs = out
     counts_np = np.asarray(counts)
 
-    # the key keeps its discrete identity (values included) so the result can
-    # feed joins / value_counts / one-hot downstream
-    new_attrs: list = [DiscreteVariable(key, kvar.values)]
-    data = [np.arange(k, dtype=np.float32)]
+    # the keys keep their discrete identity (values included) so the result
+    # can feed joins / value_counts / one-hot downstream
+    new_attrs: list = [DiscreteVariable(v.name, v.values) for v in kvars]
+    composite = np.arange(k)
+    data = []
+    for i in range(len(keys) - 1, -1, -1):  # decompose row-major index
+        data.insert(0, (composite % sizes[i]).astype(np.float32))
+        composite = composite // sizes[i]
     for j, (col, fn) in enumerate(aggs.items()):
         new_attrs.append(ContinuousVariable(f"{fn}_{col}"))
         if fn == "count":
@@ -273,3 +288,97 @@ def train_test_split(table: TpuTable, test_fraction: float = 0.25, seed: int = 0
         table.with_weights(jnp.where(keep, table.W, 0.0)),
         table.with_weights(jnp.where(keep, 0.0, table.W)),
     )
+
+
+def distinct(table: TpuTable, cols=None) -> TpuTable:
+    """df.distinct() / df.dropDuplicates(cols) over live rows.
+
+    Inherently data-dependent-shape, so (like ``count``/``head``) this is an
+    ACTION: unique rows are computed host-side and re-sharded as a fresh
+    table. Dedup keys default to ALL columns (attributes + class vars, like
+    Spark); the first occurrence's full row — X, Y, and weight — survives.
+    For discrete-only keys prefer group_by, which stays on device.
+    """
+    names = [v.name for v in table.domain.attributes]
+    X, Y, W = table.to_numpy()
+    live = W > 0
+    Xl = X[live]
+    Yl = Y[live] if Y is not None else None
+    Wl = W[live]
+    if cols is not None:
+        keymat = Xl[:, [names.index(c) for c in cols]]
+    else:
+        keymat = Xl if Yl is None else np.concatenate([Xl, Yl], axis=1)
+    _, first = np.unique(keymat, axis=0, return_index=True)
+    order = np.sort(first)
+    return TpuTable.from_numpy(
+        Domain(list(table.domain.attributes), table.domain.class_vars),
+        Xl[order].astype(np.float32),
+        None if Yl is None else Yl[order].astype(np.float32),
+        W=Wl[order].astype(np.float32),
+        session=table.session,
+    )
+
+
+def crosstab(table: TpuTable, col1: str, col2: str) -> np.ndarray:
+    """df.stat.crosstab: weighted contingency counts [k1, k2] — one one-hot
+    MXU matmul, GSPMD all-reduced over the sharded rows."""
+    v1, v2 = table.domain[col1], table.domain[col2]
+    for v in (v1, v2):
+        if not isinstance(v, DiscreteVariable) or not v.values:
+            raise ValueError(f"crosstab needs discrete columns, got {v.name!r}")
+    k1, k2 = len(v1.values), len(v2.values)
+    a = jax.nn.one_hot(table.column(col1).astype(jnp.int32), k1,
+                       dtype=jnp.float32) * table.W[:, None]
+    b = jax.nn.one_hot(table.column(col2).astype(jnp.int32), k2,
+                       dtype=jnp.float32)
+    return np.asarray(a.T @ b)
+
+
+def with_column(table: TpuTable, name: str, expr) -> TpuTable:
+    """df.withColumn: append a computed column.
+
+    ``expr``: a callable (table) -> f32[N_pad] column, or a SQL-ish string
+    over attribute names ("a + log(b)") evaluated by the SQLTransformer
+    expression engine — either way one fused elementwise XLA op.
+    """
+    if callable(expr):
+        col = expr(table)
+    else:
+        import ast as _ast
+
+        from orange3_spark_tpu.models.feature_extra import SQLTransformer
+
+        env = {v.name: table.X[:, j]
+               for j, v in enumerate(table.domain.attributes)}
+        col = SQLTransformer()._eval(_ast.parse(str(expr), mode="eval"), env)
+    # dead/padding rows carry X=0 and can produce NaN/inf under the
+    # expression (0/0, log 0) — zero them so weighted reductions downstream
+    # never see 0·NaN
+    col = jnp.where(table.W > 0, jnp.asarray(col), 0.0)
+    names = [v.name for v in table.domain.attributes]
+    if name in names:
+        # Spark withColumn REPLACES an existing column in place
+        j = names.index(name)
+        X = table.X.at[:, j].set(col)
+        attrs = list(table.domain.attributes)
+        attrs[j] = ContinuousVariable(name)
+        domain = Domain(attrs, table.domain.class_vars, table.domain.metas)
+        return table.with_X(X, domain)
+    domain = Domain(
+        list(table.domain.attributes) + [ContinuousVariable(name)],
+        table.domain.class_vars, table.domain.metas,
+    )
+    return table.with_X(
+        jnp.concatenate([table.X, col[:, None]], axis=1), domain
+    )
+
+
+def drop(table: TpuTable, cols) -> TpuTable:
+    """df.drop(columns): select the complement."""
+    gone = {cols} if isinstance(cols, str) else set(cols)
+    names = [v.name for v in table.domain.attributes]
+    unknown = gone - set(names)
+    if unknown:
+        raise ValueError(f"cannot drop unknown columns {sorted(unknown)}")
+    return table.select([n for n in names if n not in gone])

@@ -56,6 +56,11 @@ class StreamCheckpointer:
                 os.unlink(tmp)
             raise
 
+    def delete(self) -> None:
+        """Remove the snapshot (called by fits on successful completion)."""
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+
     def load(self, expect_meta=None):
         """(step, state) of the last snapshot, or (0, None) if none exists.
 

@@ -100,12 +100,23 @@ def test_streaming_kmeans_from_table(session):
 def test_checkpoint_config_mismatch_refuses(session, tmp_path):
     X, y = _data(n=1024)
     ck = StreamCheckpointer(str(tmp_path / "m.ckpt"), every_steps=1)
+    # leave a mid-run snapshot behind (as a crash would)
+    stale_meta = {"params": {"epochs": 1}, "n_features": 4, "k": 2}
+    ck.save(2, {"theta": {}, "opt_state": {}}, meta=stale_meta)
+    with pytest.raises(ValueError, match="different"):
+        StreamingLinearEstimator(
+            loss="logistic", epochs=2, chunk_rows=256
+        ).fit_stream(array_chunk_source(X, y, chunk_rows=256), n_features=4,
+                     session=session, checkpointer=ck)
+
+
+def test_checkpoint_deleted_on_success(session, tmp_path):
+    X, y = _data(n=1024)
+    ck = StreamCheckpointer(str(tmp_path / "done.ckpt"), every_steps=1)
     StreamingLinearEstimator(
         loss="logistic", epochs=1, chunk_rows=256
     ).fit_stream(array_chunk_source(X, y, chunk_rows=256), n_features=4,
                  session=session, checkpointer=ck)
-    with pytest.raises(ValueError, match="different"):
-        StreamingLinearEstimator(
-            loss="logistic", epochs=2, chunk_rows=256  # changed config
-        ).fit_stream(array_chunk_source(X, y, chunk_rows=256), n_features=4,
-                     session=session, checkpointer=ck)
+    # a finished fit must not leave a snapshot that would fast-forward a
+    # future fit past its early batches
+    assert ck.load() == (0, None)

@@ -216,3 +216,66 @@ def test_sort_nan_beats_inf(session):
     assert up[0] == 1.0 and up[1] == np.inf and np.isnan(up[2])
     down = sort(t, "a", ascending=False).to_numpy()[0][:, 0]
     assert np.isnan(down[0]) and down[1] == np.inf and down[2] == 1.0
+
+
+def test_group_by_multi_key(session):
+    from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
+    from orange3_spark_tpu.ops.relational import group_by
+
+    dom = Domain([
+        DiscreteVariable("a", ("a0", "a1")),
+        DiscreteVariable("b", ("b0", "b1", "b2")),
+        ContinuousVariable("v"),
+    ])
+    X = np.array([
+        [0, 0, 1.0], [0, 0, 3.0], [0, 2, 5.0], [1, 1, 7.0], [1, 1, 9.0],
+    ], np.float32)
+    t = TpuTable.from_numpy(dom, X, session=session)
+    out = group_by(t, ["a", "b"], {"v": "sum"})
+    names = [v.name for v in out.domain.attributes]
+    assert names == ["a", "b", "sum_v"]
+    Xo = out.to_numpy()[0]
+    assert Xo.shape == (6, 3)  # 2*3 composite groups
+    lut = {(int(r[0]), int(r[1])): r[2] for r in Xo}
+    assert lut[(0, 0)] == 4.0 and lut[(0, 2)] == 5.0 and lut[(1, 1)] == 16.0
+    assert lut[(1, 0)] == 0.0  # empty group: sum 0
+
+
+def test_distinct_and_drop(session):
+    from orange3_spark_tpu.ops.relational import distinct, drop
+
+    X = np.array([[1, 2], [1, 2], [3, 4], [1, 2]], np.float32)
+    t = TpuTable.from_arrays(X, attr_names=["p", "q"], session=session)
+    u = distinct(t)
+    assert u.n_rows == 2
+    got = {tuple(r) for r in u.to_numpy()[0]}
+    assert got == {(1.0, 2.0), (3.0, 4.0)}
+    d = drop(t, "p")
+    assert [v.name for v in d.domain.attributes] == ["q"]
+    with pytest.raises(ValueError, match="unknown"):
+        drop(t, ["nope"])
+
+
+def test_crosstab(session):
+    from orange3_spark_tpu.core.domain import DiscreteVariable, Domain
+    from orange3_spark_tpu.ops.relational import crosstab
+
+    dom = Domain([
+        DiscreteVariable("x", ("x0", "x1")),
+        DiscreteVariable("y", ("y0", "y1", "y2")),
+    ])
+    X = np.array([[0, 0], [0, 0], [0, 2], [1, 1]], np.float32)
+    t = TpuTable.from_numpy(dom, X, session=session)
+    ct = crosstab(t, "x", "y")
+    np.testing.assert_array_equal(ct, [[2, 0, 1], [0, 1, 0]])
+
+
+def test_with_column_callable_and_expr(session):
+    from orange3_spark_tpu.ops.relational import with_column
+
+    X = np.array([[1.0, 4.0], [2.0, 9.0]], np.float32)
+    t = TpuTable.from_arrays(X, attr_names=["a", "b"], session=session)
+    t2 = with_column(t, "s", "a + sqrt(b)")
+    np.testing.assert_allclose(t2.to_numpy()[0][:, 2], [3.0, 5.0])
+    t3 = with_column(t, "double_a", lambda tt: tt.column("a") * 2)
+    np.testing.assert_allclose(t3.to_numpy()[0][:, 2], [2.0, 4.0])
