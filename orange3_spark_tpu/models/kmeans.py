@@ -112,9 +112,11 @@ def kmeanspp_seed(sample: np.ndarray, k: int, rng) -> np.ndarray:
         # (a shared constant offset would make the pads exact duplicates of
         # each other — precisely the dead-center failure this guards against)
         extra = out[rng.integers(out.shape[0], size=k - out.shape[0])]
-        out = np.concatenate(
-            [out, extra + rng.normal(scale=1e-3, size=extra.shape)], axis=0
-        )
+        # jitter scaled to the value's magnitude: an absolute 1e-3 rounds
+        # away in float32 when |center| ~ 1e5+ and the pads collapse back
+        # into exact duplicates
+        jitter = rng.normal(size=extra.shape) * 1e-3 * (1.0 + np.abs(extra))
+        out = np.concatenate([out, extra + jitter], axis=0)
     return out.astype(np.float32)
 
 

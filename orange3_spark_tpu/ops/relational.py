@@ -299,22 +299,41 @@ def distinct(table: TpuTable, cols=None) -> TpuTable:
     Spark); the first occurrence's full row — X, Y, and weight — survives.
     For discrete-only keys prefer group_by, which stays on device.
     """
-    names = [v.name for v in table.domain.attributes]
     X, Y, W = table.to_numpy()
     live = W > 0
+    live_idx = np.flatnonzero(live)
     Xl = X[live]
     Yl = Y[live] if Y is not None else None
     Wl = W[live]
+    full = Xl if Yl is None else np.concatenate([Xl, Yl], axis=1)
+    full_names = [v.name for v in table.domain.attributes] + [
+        v.name for v in (table.domain.class_vars or ())
+    ]
     if cols is not None:
-        keymat = Xl[:, [names.index(c) for c in cols]]
+        idx = []
+        for c in cols:
+            if c not in full_names:
+                raise ValueError(
+                    f"distinct column {c!r} not found; available: {full_names}"
+                )
+            idx.append(full_names.index(c))
+        keymat = full[:, idx]
     else:
-        keymat = Xl if Yl is None else np.concatenate([Xl, Yl], axis=1)
+        keymat = full
+    # NaN != NaN under np.unique; Spark dropDuplicates treats nulls as equal,
+    # so map NaN to a sentinel before dedup (lowest float32 — unreachable by
+    # real data that also contains a NaN in the same column)
+    keymat = np.where(np.isnan(keymat), np.float32(np.finfo(np.float32).min),
+                      keymat)
     _, first = np.unique(keymat, axis=0, return_index=True)
     order = np.sort(first)
+    metas = table.metas[live_idx[order]] if table.metas is not None else None
     return TpuTable.from_numpy(
-        Domain(list(table.domain.attributes), table.domain.class_vars),
+        Domain(list(table.domain.attributes), table.domain.class_vars,
+               table.domain.metas),
         Xl[order].astype(np.float32),
         None if Yl is None else Yl[order].astype(np.float32),
+        metas=metas,
         W=Wl[order].astype(np.float32),
         session=table.session,
     )

@@ -1,0 +1,159 @@
+"""save_model/load_model round-trip across every model family (SURVEY §5
+checkpoint/resume): predictions must be identical after reload."""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.datasets import make_blobs, make_classification
+from orange3_spark_tpu.utils.checkpoint import load_model, save_model
+
+
+def _roundtrip(model, tmp_path):
+    save_model(model, str(tmp_path / "m"))
+    return load_model(str(tmp_path / "m"))
+
+
+def _cls_table(session, n=300, d=5, seed=0):
+    return make_classification(n, d, n_classes=2, seed=seed, noise=0.2,
+                               session=session)
+
+
+def _reg_table(session, n=300, d=4, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (X @ rng.standard_normal(d)).astype(np.float32)
+    return TpuTable.from_arrays(X, y, session=session)
+
+
+def _check(model, table, tmp_path):
+    before = model.predict(table)
+    reloaded = _roundtrip(model, tmp_path)
+    after = reloaded.predict(table)
+    np.testing.assert_array_equal(np.asarray(before), np.asarray(after))
+
+
+def test_roundtrip_gmm(session, tmp_path):
+    from orange3_spark_tpu.models.gaussian_mixture import GaussianMixture
+
+    t, _ = make_blobs(200, 3, 2, seed=3, session=session)
+    _check(GaussianMixture(k=2, max_iter=20).fit(t), t, tmp_path)
+
+
+def test_roundtrip_bisecting_kmeans(session, tmp_path):
+    from orange3_spark_tpu.models.bisecting_kmeans import BisectingKMeans
+
+    t, _ = make_blobs(200, 3, 3, seed=4, session=session)
+    _check(BisectingKMeans(k=3).fit(t), t, tmp_path)
+
+
+def test_roundtrip_lda(session, tmp_path):
+    from orange3_spark_tpu.models.lda import LDA
+
+    rng = np.random.default_rng(5)
+    t = TpuTable.from_arrays(
+        rng.poisson(1.0, (80, 20)).astype(np.float32), session=session
+    )
+    model = LDA(k=3, max_iter=10).fit(t)
+    before = model.transform(t).to_numpy()[0]
+    after = _roundtrip(model, tmp_path).transform(t).to_numpy()[0]
+    np.testing.assert_array_equal(before, after)
+
+
+def test_roundtrip_glm(session, tmp_path):
+    from orange3_spark_tpu.models.glm import GeneralizedLinearRegression
+
+    t = _reg_table(session)
+    _check(GeneralizedLinearRegression(family="gaussian").fit(t), t, tmp_path)
+
+
+def test_roundtrip_isotonic(session, tmp_path):
+    from orange3_spark_tpu.models.isotonic import IsotonicRegression
+
+    rng = np.random.default_rng(6)
+    x = rng.uniform(0, 5, 100).astype(np.float32)
+    t = TpuTable.from_arrays(x[:, None], (x + 0.1).astype(np.float32),
+                             session=session)
+    _check(IsotonicRegression().fit(t), t, tmp_path)
+
+
+def test_roundtrip_aft(session, tmp_path):
+    from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+    from orange3_spark_tpu.models.aft import AFTSurvivalRegression
+
+    rng = np.random.default_rng(7)
+    n = 200
+    X = np.concatenate(
+        [rng.standard_normal((n, 2)), np.ones((n, 1))], axis=1
+    ).astype(np.float32)
+    dom = Domain(
+        [ContinuousVariable("x0"), ContinuousVariable("x1"),
+         ContinuousVariable("censor")],
+        ContinuousVariable("time"),
+    )
+    t = TpuTable.from_numpy(
+        dom, X, np.exp(rng.standard_normal(n)).astype(np.float32),
+        session=session,
+    )
+    _check(AFTSurvivalRegression(max_iter=30).fit(t), t, tmp_path)
+
+
+def test_roundtrip_fm(session, tmp_path):
+    from orange3_spark_tpu.models.fm import FMClassifier, FMRegressor
+
+    t = _cls_table(session)
+    _check(FMClassifier(factor_size=4, max_iter=40).fit(t), t, tmp_path)
+    tr = _reg_table(session)
+    _check(FMRegressor(factor_size=4, max_iter=40).fit(tr), tr, tmp_path)
+
+
+def test_roundtrip_mlp(session, tmp_path):
+    from orange3_spark_tpu.models.mlp import MultilayerPerceptronClassifier
+
+    t = _cls_table(session)
+    _check(MultilayerPerceptronClassifier(layers=(5, 6, 2), max_iter=30).fit(t),
+           t, tmp_path)
+
+
+def test_roundtrip_fpgrowth(session, tmp_path):
+    from orange3_spark_tpu.models.fpm import FPGrowth
+
+    X = np.array([[1, 1, 0], [1, 0, 1], [1, 1, 1], [0, 1, 0]], np.float32)
+    t = TpuTable.from_arrays(X, attr_names=["a", "b", "c"], session=session)
+    model = FPGrowth(min_support=0.5).fit(t)
+    reloaded = _roundtrip(model, tmp_path)
+    assert reloaded.freq_itemsets() == model.freq_itemsets()
+    assert reloaded.association_rules_ == model.association_rules_
+
+
+def test_roundtrip_feature_models(session, tmp_path):
+    from orange3_spark_tpu.models.feature_extra import (
+        BucketedRandomProjectionLSH,
+        MinHashLSH,
+        RobustScaler,
+    )
+    from orange3_spark_tpu.models.text import CountVectorizer, Word2Vec
+
+    rng = np.random.default_rng(8)
+    t = TpuTable.from_arrays(
+        rng.standard_normal((100, 4)).astype(np.float32), session=session
+    )
+    for est in (RobustScaler(), BucketedRandomProjectionLSH(bucket_length=2.0),
+                MinHashLSH(num_hash_tables=2)):
+        model = est.fit(t)
+        before = model.transform(t).to_numpy()[0]
+        after = _roundtrip(model, tmp_path).transform(t).to_numpy()[0]
+        np.testing.assert_array_equal(before, after)
+
+
+def test_roundtrip_streaming_models(session, tmp_path):
+    from orange3_spark_tpu.io.streaming import (
+        StreamingKMeans,
+        StreamingLinearEstimator,
+    )
+
+    t = _cls_table(session)
+    _check(StreamingLinearEstimator(loss="logistic", epochs=5,
+                                    chunk_rows=128).fit(t), t, tmp_path)
+    tb, _ = make_blobs(300, 3, 3, seed=9, session=session)
+    _check(StreamingKMeans(k=3, chunk_rows=128).fit(tb), tb, tmp_path)
