@@ -165,22 +165,17 @@ _ADAM_UNIT = optax.adam(1.0)
 
 @partial(jax.jit, static_argnames=("loss_kind",), donate_argnums=(0, 1))
 def _stream_step(theta, opt_state, X, y, w, reg, lr, *, loss_kind: str):
+    # ONE loss implementation for in-memory and streaming fits: the row
+    # losses come from _linear._make_objective (col_scale=1 — streaming
+    # fits un-standardized, matching MLlib's online estimators)
+    from orange3_spark_tpu.models._linear import EPS_TOTAL_WEIGHT, _make_objective
+
+    objective = _make_objective(loss_kind, fit_intercept=True, compute_dtype=jnp.float32)
+    sum_w = jnp.maximum(jnp.sum(w), EPS_TOTAL_WEIGHT)
+    col_scale = jnp.ones((X.shape[1],), jnp.float32)
+
     def loss_fn(theta):
-        logits = X @ theta["coef"] + theta["intercept"]
-        if loss_kind == "logistic":
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            row = -jnp.take_along_axis(
-                logp, y.astype(jnp.int32)[:, None], axis=1
-            )[:, 0]
-        elif loss_kind == "squared":
-            row = 0.5 * (logits[:, 0] - y) ** 2
-        elif loss_kind == "squared_hinge":
-            sign = 2.0 * y - 1.0
-            row = jnp.maximum(0.0, 1.0 - sign * logits[:, 0]) ** 2
-        else:  # pragma: no cover
-            raise ValueError(loss_kind)
-        sw = jnp.maximum(jnp.sum(w), 1e-12)
-        return jnp.sum(row * w) / sw + 0.5 * reg * jnp.sum(theta["coef"] ** 2)
+        return objective(theta, X, y, w, reg, sum_w, col_scale)
 
     loss, g = jax.value_and_grad(loss_fn)(theta)
     updates, opt_state = _ADAM_UNIT.update(g, opt_state, theta)

@@ -318,7 +318,9 @@ class GeneralizedLinearRegression(Estimator):
             ll = -0.5 * n * (np.log(2 * np.pi * sigma2) + 1.0)
             return float(-2 * ll + 2 * (rank + 1))
         if family == "binomial":
-            mu_c = np.clip(mu, 1e-10, 1 - 1e-10)
+            # clip in float64: in float32, 1 - 1e-10 rounds to exactly 1.0 and
+            # the top-end clip is a no-op, sending log(1-mu) to log(0)
+            mu_c = np.clip(np.asarray(mu, np.float64), 1e-10, 1 - 1e-10)
             ll = np.sum(w * (y * np.log(mu_c) + (1 - y) * np.log(1 - mu_c)))
             return float(-2 * ll + 2 * rank)
         if family == "poisson":

@@ -124,8 +124,20 @@ def stage_transform_path(
 
 
 def _reaches(graph: WorkflowGraph, start: int, target: int) -> bool:
-    if start == target:
-        return True
-    return any(
-        _reaches(graph, e.dst, target) for e in graph.edges if e.src == start
-    )
+    """Reachability via iterative DFS over a prebuilt adjacency map — one
+    edge scan total (the naive recursive version re-walked shared suffixes
+    exponentially often on diamond DAGs)."""
+    adj: dict[int, list[int]] = {}
+    for e in graph.edges:
+        adj.setdefault(e.src, []).append(e.dst)
+    seen = set()
+    stack = [start]
+    while stack:
+        cur = stack.pop()
+        if cur == target:
+            return True
+        if cur in seen:
+            continue
+        seen.add(cur)
+        stack.extend(adj.get(cur, ()))
+    return False
