@@ -71,6 +71,137 @@ def lbfgs_minimize(value_fn, theta0, tol, max_iter, *, memory_size: int = 10):
     return theta, n_iter, final_value
 
 
+def owlqn_minimize(
+    smooth_fn,
+    x0,
+    l1_weight,
+    tol,
+    max_iter,
+    *,
+    memory_size: int = 10,
+    max_backtracks: int = 25,
+):
+    """Orthant-Wise Limited-memory Quasi-Newton (Andrew & Gao 2007), fused
+    into ONE ``lax.while_loop``: minimizes  smooth_fn(x) + Σ l1_weight·|x|.
+
+    MLlib fits elasticNetParam>0 linear models with Breeze's OWLQN, one
+    treeAggregate per iteration (SURVEY.md §2b row "LogisticRegression /
+    LinearSVC"; reconstructed, mount empty). Here the whole solver — pseudo-
+    gradient, two-loop recursion over fixed-size (m, n) memory buffers,
+    orthant-projected backtracking linesearch — is a single XLA program; the
+    gradient all-reduce falls out of GSPMD like the L2 path's.
+
+    Args:
+      smooth_fn: x[n] -> scalar, the differentiable part of the objective.
+      l1_weight: f32[n] per-coordinate L1 penalty (0 on unpenalized coords,
+        e.g. the intercept).
+    Returns (x, n_iter, final_full_value). Trace-time only — call under jit.
+    """
+    m = memory_size
+    c1 = 1e-4
+    grad_fn = jax.value_and_grad(smooth_fn)
+
+    def full_value(x):
+        return smooth_fn(x) + jnp.sum(l1_weight * jnp.abs(x))
+
+    def pseudo_grad(x, g):
+        # subgradient of minimum norm: steepest-descent direction of F
+        right = g + l1_weight
+        left = g - l1_weight
+        return jnp.where(
+            x > 0, right,
+            jnp.where(
+                x < 0, left,
+                jnp.where(right < 0, right, jnp.where(left > 0, left, 0.0)),
+            ),
+        )
+
+    def two_loop(gp, S, Y, rho, n_mem):
+        # newest pair at slot m-1; the last n_mem slots are valid
+        valid = jnp.arange(m) >= (m - n_mem)
+
+        def bwd(j, carry):
+            q, alpha = carry
+            i = m - 1 - j
+            a_i = jnp.where(valid[i], rho[i] * jnp.dot(S[i], q), 0.0)
+            return q - a_i * Y[i], alpha.at[i].set(a_i)
+
+        q, alpha = jax.lax.fori_loop(0, m, bwd, (gp, jnp.zeros((m,), gp.dtype)))
+        sy = jnp.dot(S[m - 1], Y[m - 1])
+        yy = jnp.dot(Y[m - 1], Y[m - 1])
+        gamma = jnp.where(n_mem > 0, sy / jnp.maximum(yy, 1e-30), 1.0)
+
+        def fwd(i, r):
+            b_i = jnp.where(valid[i], rho[i] * jnp.dot(Y[i], r), 0.0)
+            return r + S[i] * (alpha[i] - b_i)
+
+        return jax.lax.fori_loop(0, m, fwd, gamma * q)
+
+    def linesearch(x, F, gp, d, n_mem):
+        # orthant of the current point (sign forced by -gp on zero coords);
+        # every trial point is projected back into it
+        xi = jnp.where(x != 0, jnp.sign(x), jnp.sign(-gp))
+        t0 = jnp.where(
+            n_mem > 0, 1.0, 1.0 / jnp.maximum(jnp.linalg.norm(d), 1e-12)
+        )
+
+        def body(carry):
+            t, k, _, _, _ = carry
+            x_t = jnp.where((x + t * d) * xi > 0, x + t * d, 0.0)
+            F_t = full_value(x_t)
+            ok = F_t <= F + c1 * jnp.dot(gp, x_t - x)
+            return t * 0.5, k + 1, x_t, F_t, ok
+
+        def cond(carry):
+            _, k, _, _, ok = carry
+            return (~ok) & (k < max_backtracks)
+
+        _, _, x_t, F_t, ok = jax.lax.while_loop(
+            cond, body, (t0, 0, x, F, False)
+        )
+        # an exhausted linesearch must NOT adopt its rejected trial point —
+        # keep the last accepted iterate and let the stalled flag end the loop
+        x_t = jnp.where(ok, x_t, x)
+        F_t = jnp.where(ok, F_t, F)
+        return x_t, F_t, ok
+
+    def step(carry):
+        x, F, g, _, S, Y, rho, n_mem, it, _ = carry
+        gp = pseudo_grad(x, g)
+        d = -two_loop(gp, S, Y, rho, n_mem)
+        d = jnp.where(d * gp < 0, d, 0.0)  # keep only descent-aligned coords
+        x_new, F_new, ok = linesearch(x, F, gp, d, n_mem)
+        _, g_new = grad_fn(x_new)
+        s, yv = x_new - x, g_new - g
+        sy = jnp.dot(s, yv)
+        keep = sy > 1e-10  # curvature condition: only well-posed pairs enter
+        S = jnp.where(keep, jnp.roll(S, -1, axis=0).at[m - 1].set(s), S)
+        Y = jnp.where(keep, jnp.roll(Y, -1, axis=0).at[m - 1].set(yv), Y)
+        rho = jnp.where(
+            keep, jnp.roll(rho, -1).at[m - 1].set(1.0 / sy), rho
+        )
+        n_mem = jnp.where(keep, jnp.minimum(n_mem + 1, m), n_mem)
+        gpnorm = jnp.linalg.norm(pseudo_grad(x_new, g_new))
+        return x_new, F_new, g_new, gpnorm, S, Y, rho, n_mem, it + 1, ~ok
+
+    def keep_going(carry):
+        _, _, _, gpnorm, *_, it, stalled = carry
+        return (it < max_iter) & (gpnorm > tol) & (~stalled)
+
+    n = x0.shape[0]
+    f0, g0 = grad_fn(x0)
+    F0 = f0 + jnp.sum(l1_weight * jnp.abs(x0))
+    init = (
+        x0, F0, g0, jnp.linalg.norm(pseudo_grad(x0, g0)),
+        jnp.zeros((m, n), x0.dtype), jnp.zeros((m, n), x0.dtype),
+        jnp.zeros((m,), x0.dtype), jnp.int32(0), jnp.int32(0), False,
+    )
+    x, F, _, _, _, _, _, _, n_iter, _ = jax.lax.while_loop(
+        keep_going, step, init
+    )
+    return x, n_iter, F
+
+
 def _make_objective(loss_kind: str, fit_intercept: bool, compute_dtype):
     """Builds loss(theta, X, y, w, reg_l2, sum_w) -> scalar.
 
@@ -125,6 +256,7 @@ def fit_linear(
     tol,           # f32[] gradient-norm tolerance
     max_iter,      # i32[]
     col_scale=None,  # f32[d] standardization scale folded into the matmul
+    reg_l1=None,     # f32[] L1 strength (elasticNet); None -> pure-L2 L-BFGS
     *,
     loss_kind: str,
     k: int,
@@ -132,7 +264,12 @@ def fit_linear(
     memory_size: int = 10,
     compute_dtype=jnp.float32,
 ):
-    """One fused XLA program: full L-BFGS fit of a linear model.
+    """One fused XLA program: full L-BFGS (or OWLQN when reg_l1 is given)
+    fit of a linear model.
+
+    MLlib's regParam/elasticNetParam split maps to
+    ``reg_l2 = regParam*(1-alpha), reg_l1 = regParam*alpha``; with
+    standardization the L1 applies in the SCALED space, matching MLlib.
 
     Note: with ``col_scale`` the optimization runs in the scaled space; the
     returned coef is the SCALED-space coefficient — callers multiply by the
@@ -151,9 +288,24 @@ def fit_linear(
     def value_fn(theta):
         return objective(theta, X, y, w, reg_l2, sum_w, col_scale)
 
-    theta, n_iter, final_loss = lbfgs_minimize(
-        value_fn, theta0, tol, max_iter, memory_size=memory_size
-    )
+    if reg_l1 is not None:
+        from jax.flatten_util import ravel_pytree
+
+        x0, unravel = ravel_pytree(theta0)
+        # L1 hits the coefficients only — never the intercept (MLlib)
+        l1_mask, _ = ravel_pytree(
+            {"coef": jnp.ones((d, k), jnp.float32),
+             "intercept": jnp.zeros((k,), jnp.float32)}
+        )
+        x, n_iter, final_loss = owlqn_minimize(
+            lambda x: value_fn(unravel(x)),
+            x0, reg_l1 * l1_mask, tol, max_iter, memory_size=memory_size,
+        )
+        theta = unravel(x)
+    else:
+        theta, n_iter, final_loss = lbfgs_minimize(
+            value_fn, theta0, tol, max_iter, memory_size=memory_size
+        )
     return LinearFitResult(
         coef=theta["coef"],
         intercept=theta["intercept"] if fit_intercept else jnp.zeros((k,)),

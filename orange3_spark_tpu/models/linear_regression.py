@@ -25,6 +25,7 @@ from orange3_spark_tpu.ops.stats import EPS_TOTAL_WEIGHT
 class LinearRegressionParams(Params):
     max_iter: int = 100
     reg_param: float = 0.0
+    elastic_net_param: float = 0.0  # MLlib elasticNetParam (L1 mixing, OWLQN)
     tol: float = 1e-6
     fit_intercept: bool = True
     solver: str = "normal"  # 'normal' | 'l-bfgs'  (MLlib solver param)
@@ -81,8 +82,15 @@ class LinearRegression(Estimator):
 
     def _fit(self, table: TpuTable) -> LinearRegressionModel:
         p = self.params
+        if not 0.0 <= p.elastic_net_param <= 1.0:
+            raise ValueError(
+                f"elastic_net_param must be in [0, 1], got {p.elastic_net_param}"
+            )
         y, X, w = table.y, table.X, table.W
-        if p.solver == "normal":
+        # L1 has no closed form — normal equations only serve fits whose
+        # EFFECTIVE L1 strength reg_param*alpha is zero (MLlib's WLS solver
+        # makes the same quasi-newton fallback)
+        if p.solver == "normal" and p.reg_param * p.elastic_net_param == 0.0:
             XtX, Xty, x_sum, y_sum, tot = _normal_equations(X, y, w)
             d = X.shape[1]
             if p.fit_intercept:
@@ -101,9 +109,13 @@ class LinearRegression(Estimator):
             model = LinearRegressionModel(p, coef, intercept)
             model.n_iter_ = 1
             return model
+        alpha = p.elastic_net_param
         result = fit_linear(
             X, y, w,
-            jnp.float32(p.reg_param), jnp.float32(p.tol), jnp.int32(p.max_iter),
+            jnp.float32(p.reg_param * (1.0 - alpha)),
+            jnp.float32(p.tol), jnp.int32(p.max_iter),
+            None,
+            jnp.float32(p.reg_param * alpha) if alpha > 0.0 else None,
             loss_kind="squared", k=1, fit_intercept=p.fit_intercept,
             compute_dtype=jnp.dtype(p.compute_dtype),
         )

@@ -26,6 +26,9 @@ from orange3_spark_tpu.models.base import Estimator, Model, Params
 class LinearSVCParams(Params):
     max_iter: int = 100          # MLlib maxIter
     reg_param: float = 0.0       # MLlib regParam
+    elastic_net_param: float = 0.0  # L1 mixing — extension: MLlib LinearSVC
+    # is L2-only; offered here because the OWLQN path makes it free. Use
+    # loss='squared_hinge' with L1 (OWLQN assumes a smooth data term).
     tol: float = 1e-6            # MLlib tol
     fit_intercept: bool = True   # MLlib fitIntercept
     standardization: bool = True # MLlib standardization
@@ -91,12 +94,21 @@ class LinearSVC(Estimator):
             )
         X, w = table.X, table.W
         inv_std = column_inv_std(X, w) if p.standardization else None
+        alpha = p.elastic_net_param
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"elastic_net_param must be in [0, 1], got {alpha}")
+        if alpha > 0.0 and p.reg_param > 0.0 and p.loss == "hinge":
+            raise ValueError(
+                "elastic_net_param > 0 needs a smooth data term for OWLQN; "
+                "use loss='squared_hinge'"
+            )
         result = fit_linear(
             X, y, w,
-            jnp.float32(p.reg_param),
+            jnp.float32(p.reg_param * (1.0 - alpha)),
             jnp.float32(p.tol),
             jnp.int32(p.max_iter),
             inv_std,
+            jnp.float32(p.reg_param * alpha) if alpha > 0.0 else None,
             loss_kind=p.loss,
             k=1,
             fit_intercept=p.fit_intercept,

@@ -30,7 +30,7 @@ from orange3_spark_tpu.models.base import Estimator, Model, Params, infer_class_
 class LogisticRegressionParams(Params):
     max_iter: int = 100            # MLlib maxIter
     reg_param: float = 0.0         # MLlib regParam (L2 when elastic_net=0)
-    elastic_net_param: float = 0.0 # MLlib elasticNetParam (L1 mixing; TODO OWLQN)
+    elastic_net_param: float = 0.0 # MLlib elasticNetParam (L1 mixing, OWLQN)
     tol: float = 1e-6              # MLlib tol
     fit_intercept: bool = True     # MLlib fitIntercept
     family: str = "auto"           # 'auto' | 'binomial' | 'multinomial'
@@ -94,12 +94,6 @@ class LogisticRegression(Estimator):
 
     def _fit(self, table: TpuTable) -> LogisticRegressionModel:
         p = self.params
-        if p.elastic_net_param != 0.0:
-            # L1/elastic-net needs an OWLQN-style prox step; explicit error
-            # beats silently fitting pure L2 (MLlib would use OWLQN here).
-            raise NotImplementedError(
-                "elastic_net_param != 0 (L1) is not implemented yet; use reg_param (L2)"
-            )
         y = table.y
         class_values = infer_class_values(table)
         k = len(class_values)
@@ -110,12 +104,18 @@ class LogisticRegression(Estimator):
         # scale-only standardization folded INTO the fit matmul (no scaled
         # copy of the [N,d] data is ever materialized), MLlib-style
         inv_std = column_inv_std(X, w) if p.standardization else None
+        # MLlib regParam/elasticNetParam -> (L2, L1) split; alpha=0 keeps the
+        # pure-L2 fused L-BFGS path, alpha>0 switches to the fused OWLQN
+        alpha = p.elastic_net_param
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"elastic_net_param must be in [0, 1], got {alpha}")
         result = fit_linear(
             X, y, w,
-            jnp.float32(p.reg_param),
+            jnp.float32(p.reg_param * (1.0 - alpha)),
             jnp.float32(p.tol),
             jnp.int32(p.max_iter),
             inv_std,
+            jnp.float32(p.reg_param * alpha) if alpha > 0.0 else None,
             loss_kind="logistic",
             k=k,
             fit_intercept=p.fit_intercept,

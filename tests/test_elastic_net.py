@@ -1,0 +1,181 @@
+"""OWLQN elastic-net parity — MLlib fits elasticNetParam>0 with Breeze OWLQN
+(SURVEY.md §2b row "LogisticRegression / LinearSVC"; reconstructed, mount
+empty). Our fused owlqn_minimize must reproduce sklearn's saga/coordinate-
+descent solutions on the same objectives.
+
+Objective mapping (ours normalizes by total weight, sklearn by n or via C):
+  LogReg:    reg_param = 1/(C*n), elastic_net_param = l1_ratio
+  LinearReg: reg_param = sklearn alpha, elastic_net_param = l1_ratio
+Standardization is off so both sides optimize the identical objective.
+"""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.datasets import load_iris, make_classification
+from orange3_spark_tpu.models.linear_regression import LinearRegression
+from orange3_spark_tpu.models.linear_svc import LinearSVC
+from orange3_spark_tpu.models.logistic_regression import LogisticRegression
+
+
+def _regression_table(session, n=300, d=8, n_informative=3, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    w_true = np.zeros(d, np.float32)
+    w_true[:n_informative] = rng.uniform(1.0, 3.0, n_informative)
+    y = X @ w_true + 0.5 + 0.05 * rng.standard_normal(n).astype(np.float32)
+    dom = Domain(
+        [ContinuousVariable(f"f{i}") for i in range(d)], ContinuousVariable("y")
+    )
+    return TpuTable.from_numpy(dom, X, y, session=session), w_true
+
+
+def test_logreg_elasticnet_matches_sklearn_saga(session, iris):
+    """The multinomial elastic-net objective is extremely flat near its
+    optimum (coefficients move ~0.1 while the objective moves ~1e-6, and
+    sklearn's saga itself stops unconverged), so parity is asserted on what
+    is well-determined: the objective value our solver reaches must be at
+    least as good as saga's, with the same sparsity pattern and predictions."""
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    X, Y, _ = iris.to_numpy()
+    y = Y[:, 0]
+    n = len(y)
+    C, l1_ratio = 10.0, 0.5
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # saga stops on max_iter here
+        sk = SkLR(solver="saga", C=C, l1_ratio=l1_ratio, max_iter=20000,
+                  tol=1e-8).fit(X, y)
+
+    reg = 1.0 / (C * n)
+    est = LogisticRegression(
+        max_iter=2000, tol=1e-8, standardization=False,
+        reg_param=reg, elastic_net_param=l1_ratio,
+    )
+    model = est.fit(iris)
+
+    def objective(W, b):
+        logits = X @ W + b
+        lp = logits - np.log(np.sum(np.exp(logits), axis=1, keepdims=True))
+        data = -np.mean(lp[np.arange(n), y.astype(int)])
+        return (data + reg * l1_ratio * np.abs(W).sum()
+                + 0.5 * reg * (1 - l1_ratio) * (W ** 2).sum())
+
+    ours = objective(np.asarray(model.coef), np.asarray(model.intercept))
+    theirs = objective(sk.coef_.T, sk.intercept_)
+    assert ours <= theirs + 1e-6, f"OWLQN {ours} worse than saga {theirs}"
+    # L1 support recovery is well-determined even where magnitudes are not
+    np.testing.assert_array_equal(
+        np.abs(np.asarray(model.coef)) < 1e-6, np.abs(sk.coef_.T) < 1e-6
+    )
+    agree = np.mean(model.predict(iris) == sk.predict(X))
+    assert agree >= 0.99
+
+
+def test_logreg_l1_sparsifies_noise_features(session):
+    """Pure L1 (alpha=1) must zero out irrelevant features; L2 must not."""
+    rng = np.random.default_rng(3)
+    n, d_inf, d_noise = 500, 3, 12
+    X_inf = rng.standard_normal((n, d_inf)).astype(np.float32)
+    X = np.concatenate(
+        [X_inf, rng.standard_normal((n, d_noise)).astype(np.float32)], axis=1
+    )
+    y = (X_inf @ np.array([2.0, -2.0, 1.5], np.float32) > 0).astype(np.float32)
+    dom = Domain(
+        [ContinuousVariable(f"f{i}") for i in range(d_inf + d_noise)],
+        DiscreteVariable("y", ("0", "1")),
+    )
+    t = TpuTable.from_numpy(dom, X, y, session=None)
+
+    l1 = LogisticRegression(
+        max_iter=500, reg_param=0.05, elastic_net_param=1.0,
+        standardization=False,
+    ).fit(t)
+    coef = np.asarray(l1.coef)
+    noise_zero = np.mean(np.abs(coef[d_inf:]) < 1e-6)
+    assert noise_zero >= 0.8, f"L1 left noise coefs alive: {coef[d_inf:]}"
+    assert np.all(np.abs(coef[:d_inf]).max(axis=1) > 1e-3)
+
+    l2 = LogisticRegression(
+        max_iter=500, reg_param=0.05, standardization=False
+    ).fit(t)
+    assert np.mean(np.abs(np.asarray(l2.coef)[d_inf:]) < 1e-6) < 0.5
+
+
+def test_linear_regression_elasticnet_matches_sklearn(session):
+    from sklearn.linear_model import ElasticNet
+
+    t, _ = _regression_table(session)
+    X, Y, _ = t.to_numpy()
+    y = Y[:, 0]
+    alpha, l1_ratio = 0.1, 0.7
+    sk = ElasticNet(alpha=alpha, l1_ratio=l1_ratio, max_iter=50000,
+                    tol=1e-10).fit(X, y)
+
+    model = LinearRegression(
+        solver="l-bfgs", max_iter=2000, tol=1e-9,
+        reg_param=alpha, elastic_net_param=l1_ratio,
+    ).fit(t)
+    np.testing.assert_allclose(np.asarray(model.coef), sk.coef_, atol=2e-3)
+    np.testing.assert_allclose(
+        float(model.intercept), sk.intercept_, atol=2e-3
+    )
+
+
+def test_linear_regression_lasso_matches_sklearn(session):
+    from sklearn.linear_model import Lasso
+
+    t, w_true = _regression_table(session, seed=7)
+    X, Y, _ = t.to_numpy()
+    y = Y[:, 0]
+    alpha = 0.2
+    sk = Lasso(alpha=alpha, max_iter=50000, tol=1e-10).fit(X, y)
+
+    model = LinearRegression(
+        solver="l-bfgs", max_iter=2000, tol=1e-9,
+        reg_param=alpha, elastic_net_param=1.0,
+    ).fit(t)
+    np.testing.assert_allclose(np.asarray(model.coef), sk.coef_, atol=2e-3)
+    # the lasso solution itself recovers the support
+    assert np.all(np.abs(np.asarray(model.coef)[w_true == 0]) < 1e-4)
+
+
+def test_normal_solver_falls_back_for_elasticnet(session):
+    """solver='normal' has no L1 closed form — must take the OWLQN path."""
+    t, _ = _regression_table(session, seed=5)
+    model = LinearRegression(
+        solver="normal", max_iter=1000, reg_param=0.1, elastic_net_param=0.5
+    ).fit(t)
+    assert model.n_iter_ > 1  # normal equations would report 1
+
+
+def test_linear_svc_l1_smoke(session):
+    t = make_classification(400, 10, n_classes=2, seed=4, session=session)
+    model = LinearSVC(
+        max_iter=500, reg_param=0.01, elastic_net_param=0.5,
+        loss="squared_hinge", standardization=False,
+    ).fit(t)
+    y = t.to_numpy()[1][:, 0]
+    assert np.mean(model.predict(t) == y) > 0.9
+    assert np.all(np.isfinite(np.asarray(model.coef)))
+
+
+def test_elasticnet_zero_alpha_identical_to_l2_path(session, iris):
+    """alpha=0 must stay on the L-BFGS path and give the same fit."""
+    a = LogisticRegression(max_iter=200, reg_param=1e-3).fit(iris)
+    b = LogisticRegression(
+        max_iter=200, reg_param=1e-3, elastic_net_param=0.0
+    ).fit(iris)
+    np.testing.assert_allclose(np.asarray(a.coef), np.asarray(b.coef))
+
+
+def test_elastic_net_param_range_validated(session, iris):
+    with pytest.raises(ValueError, match="elastic_net_param"):
+        LogisticRegression(reg_param=0.1, elastic_net_param=1.5).fit(iris)
+    with pytest.raises(ValueError, match="squared_hinge"):
+        t = make_classification(100, 4, n_classes=2, seed=0, session=session)
+        LinearSVC(reg_param=0.1, elastic_net_param=0.5, loss="hinge").fit(t)
