@@ -99,8 +99,11 @@ class NativeCsvReader:
             raise FileNotFoundError(path)
         self.n_threads = n_threads
         self.ncols = self._lib.fcsv_ncols(self._h)
+        # strip RFC-4180 quoting from header names (pyarrow's writer quotes
+        # all string fields by default)
         self.colnames = [
-            self._lib.fcsv_colname(self._h, j).decode() for j in range(self.ncols)
+            self._lib.fcsv_colname(self._h, j).decode().strip('"')
+            for j in range(self.ncols)
         ]
 
     def read_chunk(self, max_rows: int) -> np.ndarray | None:

@@ -225,23 +225,29 @@ def _make_objective(loss_kind: str, fit_intercept: bool, compute_dtype):
                          preferred_element_type=jnp.float32)
         if fit_intercept:
             logits = logits + intercept
-        if loss_kind == "logistic":
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            row_loss = -jnp.take_along_axis(
-                logp, y.astype(jnp.int32)[:, None], axis=1
-            )[:, 0]
-        elif loss_kind in ("hinge", "squared_hinge"):
-            sign = 2.0 * y - 1.0
-            margin = jnp.maximum(0.0, 1.0 - sign * logits[:, 0])
-            row_loss = margin if loss_kind == "hinge" else margin**2
-        elif loss_kind == "squared":
-            row_loss = 0.5 * (logits[:, 0] - y) ** 2
-        else:  # pragma: no cover
-            raise ValueError(loss_kind)
+        row_loss = per_row_loss(loss_kind, logits, y)
         data_loss = jnp.sum(row_loss * w) / sum_w
         return data_loss + 0.5 * reg_l2 * jnp.sum(coef * coef)
 
     return objective
+
+
+def per_row_loss(loss_kind: str, logits, y):
+    """Per-row loss from precomputed logits — the ONE implementation shared
+    by the dense objective, the streaming step, and the hashed-sparse path
+    (whose logits come from an embedding gather, not a matmul)."""
+    if loss_kind == "logistic":
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(
+            logp, y.astype(jnp.int32)[:, None], axis=1
+        )[:, 0]
+    if loss_kind in ("hinge", "squared_hinge"):
+        sign = 2.0 * y - 1.0
+        margin = jnp.maximum(0.0, 1.0 - sign * logits[:, 0])
+        return margin if loss_kind == "hinge" else margin**2
+    if loss_kind == "squared":
+        return 0.5 * (logits[:, 0] - y) ** 2
+    raise ValueError(loss_kind)  # pragma: no cover
 
 
 @partial(

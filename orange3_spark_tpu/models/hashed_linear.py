@@ -1,0 +1,287 @@
+"""Hashed-sparse linear models — the Criteo-scale categorical path.
+
+BASELINE config 2 (the headline metric) is Criteo click-through: 13 dense
+numerics + 26 categoricals hashed to millions of dimensions. A dense design
+matrix is unrepresentable; MLlib fits it as a SparseVector pipeline
+(FeatureHasher -> LogisticRegression over treeAggregate; SURVEY.md §2b rows
+"Distributed dataframe"/"LogReg"; reconstructed, mount empty).
+
+TPU-native redesign — fixed-nnz-per-row, not CSR:
+
+* every row has EXACTLY n_cat categorical slots (Criteo's shape), so the
+  sparse structure is two static-shape arrays: raw codes [N, C] (hashed to
+  indices on device, ops/hashing.py) and an embedding table [n_dims, k].
+  Static shapes mean ONE compiled step for the whole stream — CSR's ragged
+  rows would force re-compilation or host-side bucketing.
+* the forward is an embedding gather ``take(emb, idx)`` + a dense matmul for
+  the numeric block; the backward is XLA's scatter-add. No SpMV kernel to
+  hand-write — gather/scatter are native TPU ops.
+* the chunk arrives as ONE [N, n_dense+n_cat] f32 array straight from
+  fastcsv (ints < 2^24 are exact in f32), so the host does zero per-cell
+  work and the transfer is a single DMA; dense/categorical split happens
+  inside the jit.
+* data parallelism: rows sharded P('data'); the embedding table is
+  replicated (8 MB at 2^20 x 2) and its gradient all-reduces over ICI by
+  GSPMD — treeAggregate without the shuffle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from orange3_spark_tpu.core.session import TpuSession
+from orange3_spark_tpu.models._linear import EPS_TOTAL_WEIGHT, per_row_loss
+from orange3_spark_tpu.models.base import Estimator, Model, Params
+from orange3_spark_tpu.ops.hashing import column_salts, hash_columns
+
+# unit-lr adam; the traced lr scales its updates (see io/streaming.py)
+_ADAM_UNIT = optax.adam(1.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class HashedLinearParams(Params):
+    n_dims: int = 1 << 20        # hashed feature space (power of two)
+    n_dense: int = 13            # leading numeric columns (Criteo I1-I13)
+    n_cat: int = 26              # trailing categorical columns (C1-C26)
+    loss: str = "logistic"       # 'logistic' | 'squared' | 'squared_hinge'
+    n_classes: int = 2
+    epochs: int = 1
+    step_size: float = 0.02
+    reg_param: float = 0.0       # L2 on emb + coef
+    chunk_rows: int = 1 << 18
+    threshold: float = 0.5
+    seed: int = 0
+    compute_dtype: str = "float32"
+
+
+def _hashed_logits(theta, dense, idx, compute_dtype):
+    emb_rows = jnp.take(theta["emb"].astype(compute_dtype), idx, axis=0)
+    logits = jnp.sum(emb_rows, axis=1, dtype=jnp.float32)       # [N, k]
+    if theta["coef"].shape[0]:
+        logits = logits + jnp.dot(
+            dense.astype(compute_dtype),
+            theta["coef"].astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        )
+    return logits + theta["intercept"]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("loss_kind", "n_dims", "n_dense", "compute_dtype"),
+    donate_argnums=(0, 1),
+)
+def _hashed_step(
+    theta, opt_state, Xall, y, w, salts, reg, lr,
+    *, loss_kind: str, n_dims: int, n_dense: int, compute_dtype=jnp.float32,
+):
+    dense = Xall[:, :n_dense]
+    idx = hash_columns(Xall[:, n_dense:], salts, n_dims)
+
+    def loss_fn(theta):
+        logits = _hashed_logits(theta, dense, idx, compute_dtype)
+        row = per_row_loss(loss_kind, logits, y)
+        sw = jnp.maximum(jnp.sum(w), EPS_TOTAL_WEIGHT)
+        data = jnp.sum(row * w) / sw
+        return data + 0.5 * reg * (
+            jnp.sum(theta["emb"] ** 2) + jnp.sum(theta["coef"] ** 2)
+        )
+
+    loss, g = jax.value_and_grad(loss_fn)(theta)
+    updates, opt_state = _ADAM_UNIT.update(g, opt_state, theta)
+    updates = jax.tree.map(lambda u: lr * u, updates)
+    return optax.apply_updates(theta, updates), opt_state, loss
+
+
+@partial(jax.jit, static_argnames=("n_dims", "n_dense"))
+def _hashed_predict(theta, Xall, salts, *, n_dims: int, n_dense: int):
+    dense = Xall[:, :n_dense]
+    idx = hash_columns(Xall[:, n_dense:], salts, n_dims)
+    return _hashed_logits(theta, dense, idx, jnp.float32)
+
+
+class HashedLinearModel(Model):
+    """Fitted hashed-sparse linear model; predicts on raw (dense+categorical)
+    chunks — the hashing travels with the model via its salts."""
+
+    def __init__(self, params: HashedLinearParams, theta, salts, class_values):
+        self.params = params
+        self.theta = theta            # {'emb': [D,k], 'coef': [dd,k], 'intercept': [k]}
+        self.salts = np.asarray(salts, np.uint32)
+        self.class_values = tuple(class_values) if class_values else None
+        self.n_steps_: int | None = None
+        self.final_loss_: float | None = None
+
+    @property
+    def state_pytree(self):
+        return dict(self.theta)
+
+    def _logits(self, Xall: np.ndarray) -> np.ndarray:
+        p = self.params
+        out = _hashed_predict(
+            self.theta, jnp.asarray(Xall, jnp.float32),
+            jnp.asarray(self.salts), n_dims=p.n_dims, n_dense=p.n_dense,
+        )
+        return np.asarray(out)
+
+    def predict(self, Xall: np.ndarray) -> np.ndarray:
+        p = self.params
+        logits = self._logits(Xall)
+        if p.loss == "logistic":
+            if logits.shape[1] == 2:
+                prob = 1.0 / (1.0 + np.exp(logits[:, 0] - logits[:, 1]))
+                return (prob > p.threshold).astype(np.float32)
+            return np.argmax(logits, axis=-1).astype(np.float32)
+        if p.loss == "squared":
+            return logits[:, 0]
+        return (logits[:, 0] > 0).astype(np.float32)  # hinge margins
+
+    def predict_proba(self, Xall: np.ndarray) -> np.ndarray:
+        z = self._logits(Xall)
+        z = z - z.max(axis=1, keepdims=True)
+        e = np.exp(z)
+        return e / e.sum(axis=1, keepdims=True)
+
+    def evaluate_stream(self, source: Callable[[], Iterator]) -> dict:
+        """Stream logloss + accuracy (+AUC when binary) without collecting
+        the dataset: exact running sums, fixed memory."""
+        p = self.params
+        n = 0
+        loss_sum = 0.0
+        correct = 0
+        # binary AUC via 4096-bin score histograms (rank-sum on bins)
+        bins = 4096
+        pos_h = np.zeros(bins)
+        neg_h = np.zeros(bins)
+        for chunk in source():
+            Xall, y = chunk[0], chunk[1]
+            if y is None:
+                raise ValueError("evaluate_stream needs labeled chunks")
+            prob = self.predict_proba(Xall)
+            yi = np.asarray(y).astype(int)
+            pi = np.clip(prob[np.arange(len(yi)), yi], 1e-12, 1.0)
+            loss_sum += float(-np.log(pi).sum())
+            correct += int((prob.argmax(1) == yi).sum())
+            n += len(yi)
+            if prob.shape[1] == 2:
+                b = np.minimum((prob[:, 1] * bins).astype(int), bins - 1)
+                pos_h += np.bincount(b[yi == 1], minlength=bins)
+                neg_h += np.bincount(b[yi == 0], minlength=bins)
+        out = {"logloss": loss_sum / max(n, 1), "accuracy": correct / max(n, 1)}
+        npos, nneg = pos_h.sum(), neg_h.sum()
+        if npos and nneg:
+            # P(score_pos > score_neg) + 0.5 P(tie), binned
+            cum_neg = np.concatenate([[0.0], np.cumsum(neg_h)[:-1]])
+            out["auc"] = float(
+                (pos_h * (cum_neg + 0.5 * neg_h)).sum() / (npos * nneg)
+            )
+        return out
+
+
+class StreamingHashedLinearEstimator(Estimator):
+    """Out-of-core hashed-sparse fit over (fastcsv) chunk streams.
+
+    ``fit_stream(source)`` consumes chunks of ``(Xall [n, n_dense+n_cat], y)``
+    — exactly what ``io.streaming.csv_chunk_source`` yields — and returns a
+    HashedLinearModel. The full Criteo pipeline is therefore:
+    ``csv_chunk_source(path, 'label') -> fit_stream -> model.evaluate_stream``.
+    """
+
+    ParamsCls = HashedLinearParams
+    params: HashedLinearParams
+
+    def _fit(self, table):  # Estimator protocol: in-memory fallback
+        from orange3_spark_tpu.io.streaming import array_chunk_source
+
+        X, Y, W = table.to_numpy()
+        y = Y[:, 0] if Y is not None else None
+        return self.fit_stream(
+            array_chunk_source(X, y, W, chunk_rows=self.params.chunk_rows),
+            session=table.session,
+        )
+
+    def fit_stream(
+        self,
+        source: Callable[[], Iterator],
+        *,
+        session: TpuSession | None = None,
+        class_values: tuple | None = None,
+        checkpointer=None,
+    ) -> HashedLinearModel:
+        from orange3_spark_tpu.io.streaming import _pad_chunk, _rechunk
+
+        p = self.params
+        session = session or TpuSession.active()
+        k = p.n_classes if p.loss == "logistic" else 1
+        n_cols = p.n_dense + p.n_cat
+        theta = {
+            "emb": jnp.zeros((p.n_dims, k), jnp.float32),
+            "coef": jnp.zeros((p.n_dense, k), jnp.float32),
+            "intercept": jnp.zeros((k,), jnp.float32),
+        }
+        opt_state = _ADAM_UNIT.init(theta)
+        salts_np = column_salts(p.n_cat, p.seed)
+        salts = jax.device_put(salts_np, session.replicated)
+        resume_from = 0
+        ckpt_meta = {"params": p.to_dict(), "k": k}
+        if checkpointer is not None:
+            step0, saved = checkpointer.load(expect_meta=ckpt_meta)
+            if saved is not None:
+                theta = jax.tree.map(jnp.asarray, saved["theta"])
+                opt_state = jax.tree.map(
+                    lambda tmpl, v: jnp.asarray(v)
+                    if isinstance(tmpl, (jax.Array, np.ndarray)) else v,
+                    opt_state, saved["opt_state"],
+                )
+                resume_from = step0
+
+        pad_rows = session.pad_rows(p.chunk_rows)
+        row_sh = session.row_sharding
+        vec_sh = session.vector_sharding
+        reg = jnp.float32(p.reg_param)
+        lr = jnp.float32(p.step_size)
+        compute_dtype = jnp.dtype(p.compute_dtype)
+        n_steps = 0
+        last_loss = None
+        for _ in range(p.epochs):
+            for X_np, y_np, w_np in _rechunk(source(), pad_rows):
+                if n_steps < resume_from:
+                    n_steps += 1
+                    continue
+                if X_np.shape[1] != n_cols:
+                    raise ValueError(
+                        f"chunk has {X_np.shape[1]} columns, expected "
+                        f"n_dense+n_cat={n_cols}"
+                    )
+                Xp, yp, wp = _pad_chunk(X_np, y_np, w_np, pad_rows, n_cols)
+                Xd = jax.device_put(Xp, row_sh)
+                yd = jax.device_put(yp, vec_sh)
+                wd = jax.device_put(wp, vec_sh)
+                theta, opt_state, loss = _hashed_step(
+                    theta, opt_state, Xd, yd, wd, salts, reg, lr,
+                    loss_kind=p.loss, n_dims=p.n_dims, n_dense=p.n_dense,
+                    compute_dtype=compute_dtype,
+                )
+                n_steps += 1
+                last_loss = loss
+                if checkpointer is not None:
+                    checkpointer.maybe_save(
+                        n_steps, {"theta": theta, "opt_state": opt_state},
+                        meta=ckpt_meta,
+                    )
+        model = HashedLinearModel(
+            p, theta, salts_np,
+            class_values or (tuple(str(i) for i in range(k)) if k > 1 else None),
+        )
+        model.n_steps_ = n_steps
+        model.final_loss_ = float(last_loss) if last_loss is not None else None
+        if checkpointer is not None:
+            checkpointer.delete()
+        return model
