@@ -106,11 +106,16 @@ class TpuTable:
             W = np.asarray(W, dtype=np.float32)
             Wp = np.zeros((n_pad,), dtype=np.float32)
             Wp[:n] = W
+        # put_sharded == device_put single-process; on multi-host deployments
+        # each process contributes its local block and the table's arrays are
+        # the GLOBAL assembly (io/multihost.py)
+        from orange3_spark_tpu.io.multihost import put_sharded
+
         row = session.row_sharding
         vec = session.vector_sharding
-        Xd = jax.device_put(Xp, row)
-        Yd = jax.device_put(Yp, row) if Yp is not None else None
-        Wd = jax.device_put(Wp, vec)
+        Xd = put_sharded(Xp, row)
+        Yd = put_sharded(Yp, row) if Yp is not None else None
+        Wd = put_sharded(Wp, vec)
         if metas is not None:
             metas = np.asarray(metas, dtype=object)
             if metas.ndim == 1:

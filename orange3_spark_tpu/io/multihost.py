@@ -1,0 +1,56 @@
+"""Multi-host ingest — the cluster half of ``spark.read``.
+
+Spark splits input files across executors and each reads its own slice; the
+TPU-native equivalent is: every PROCESS (host) parses its own row block with
+the same single-host readers, then ``jax.make_array_from_process_local_data``
+assembles one global sharded array from the per-process blocks — no data ever
+funnels through a head node (SURVEY.md §2b "Data ingest"; reconstructed,
+mount empty).
+
+All call sites go through ``put_sharded`` which is gated on
+``jax.process_count()``: single-process keeps the plain ``device_put`` fast
+path, multi-process switches to the global-assembly path with IDENTICAL call
+signatures — the estimator/table code never knows which world it is in.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+__all__ = ["put_sharded", "process_row_slice", "shard_paths"]
+
+
+def put_sharded(local: np.ndarray, sharding, *, force_global: bool = False):
+    """Host block -> sharded jax.Array.
+
+    Single-process: ``jax.device_put`` (zero extra cost). Multi-process: the
+    array is PROCESS-LOCAL rows; every process contributes its block and the
+    returned array's shape is the GLOBAL concatenation along the sharded
+    row axis. Every process must contribute the same local row count (pad
+    with the table's weight-mask semantics first).
+
+    force_global exercises the multi-process assembly path in single-process
+    tests (with one process, local block == global array).
+    """
+    if jax.process_count() == 1 and not force_global:
+        return jax.device_put(local, sharding)
+    return jax.make_array_from_process_local_data(sharding, local)
+
+
+def process_row_slice(n_total: int) -> slice:
+    """Contiguous row range THIS process should read from a shared file.
+
+    Spark's input-split assignment, reduced to arithmetic: near-equal blocks
+    by process index (earlier processes take the remainder)."""
+    pc, pi = jax.process_count(), jax.process_index()
+    base, rem = divmod(n_total, pc)
+    start = pi * base + min(pi, rem)
+    return slice(start, start + base + (1 if pi < rem else 0))
+
+
+def shard_paths(paths) -> list[str]:
+    """File-per-executor splitting: the subset of ``paths`` this process
+    reads (round-robin by process index — balanced when file sizes are)."""
+    pc, pi = jax.process_count(), jax.process_index()
+    return [p for j, p in enumerate(sorted(paths)) if j % pc == pi]

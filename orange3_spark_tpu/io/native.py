@@ -15,6 +15,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import sys
 import threading
 
 import numpy as np
@@ -28,6 +29,22 @@ _lib = None
 
 class NativeUnavailable(RuntimeError):
     pass
+
+
+def _register_close(owner, lib, handle):
+    """weakref.finalize hook closing a native handle exactly once.
+
+    The callback captures only (lib, handle) — never the owner — and skips
+    the native call when the interpreter is finalizing (the CDLL's function
+    pointers may already be invalid there; leaking one FILE* at process exit
+    is free, calling through a dead libffi trampoline is a SIGABRT)."""
+    import weakref
+
+    def _close(lib=lib, handle=handle):
+        if not sys.is_finalizing():
+            lib.fcsv_close(handle)
+
+    return weakref.finalize(owner, _close)
 
 
 def _build() -> str:
@@ -75,6 +92,10 @@ def get_lib():
         ]
         lib.fcsv_close.restype = None
         lib.fcsv_close.argtypes = [ctypes.c_void_p]
+        lib.fcsv_set_categorical.restype = ctypes.c_int
+        lib.fcsv_set_categorical.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ]
         _lib = lib
         return _lib
 
@@ -90,21 +111,55 @@ class NativeCsvReader:
     """
 
     def __init__(self, path: str, *, delimiter: str = ",", header: bool = True,
-                 n_threads: int = 0):
+                 n_threads: int = 0,
+                 categorical_cols: "tuple[int | str, ...]" = ()):
+        """categorical_cols: column indices or header names whose cells are
+        crc32&0xFFFFFF string-hashed at parse time (the native twin of
+        ops.hashing.strings_to_u32) instead of float-parsed — real Criteo's
+        hex-string categories flow through the native path losslessly."""
         self._lib = get_lib()
         self._h = self._lib.fcsv_open(
             path.encode(), delimiter.encode()[0:1] or b",", int(header)
         )
         if not self._h:
             raise FileNotFoundError(path)
+        # GC safety net. weakref.finalize, NOT __del__: __del__ can fire from
+        # an arbitrary thread's GC cycle or during interpreter finalization
+        # when the ctypes CDLL machinery is already torn down — a native call
+        # there is the classic 'Fatal Python error' SIGABRT at pytest exit.
+        # finalize() runs before module teardown and is atomic/idempotent
+        # against an explicit close().
+        self._finalizer = _register_close(self, self._lib, self._h)
         self.n_threads = n_threads
         self.ncols = self._lib.fcsv_ncols(self._h)
         # strip RFC-4180 quoting from header names (pyarrow's writer quotes
-        # all string fields by default)
+        # all string fields by default): one matching outer pair only, with
+        # doubled-quote unescaping — a name legitimately containing quotes
+        # must survive
+        def _unquote(s: str) -> str:
+            if len(s) >= 2 and s[0] == '"' and s[-1] == '"':
+                return s[1:-1].replace('""', '"')
+            return s
+
         self.colnames = [
-            self._lib.fcsv_colname(self._h, j).decode().strip('"')
+            _unquote(self._lib.fcsv_colname(self._h, j).decode())
             for j in range(self.ncols)
         ]
+        self.categorical_cols: tuple[int, ...] = tuple(
+            sorted(self._resolve_col(c) for c in categorical_cols)
+        )
+        for j in self.categorical_cols:
+            self._lib.fcsv_set_categorical(self._h, j, 1)
+
+    def _resolve_col(self, col: "int | str") -> int:
+        if isinstance(col, str):
+            if col not in self.colnames:
+                raise ValueError(f"column {col!r} not in {self.colnames}")
+            return self.colnames.index(col)
+        j = int(col)
+        if not 0 <= j < self.ncols:
+            raise ValueError(f"column index {j} out of range 0..{self.ncols - 1}")
+        return j
 
     def read_chunk(self, max_rows: int) -> np.ndarray | None:
         """Next up-to-max_rows rows as f32 [rows, ncols]; None at EOF."""
@@ -138,21 +193,18 @@ class NativeCsvReader:
         return np.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
 
     def close(self):
-        if self._h is not None:
+        # the finalizer owns the one-and-only-once native close; detach()
+        # returns None on the second call, making close() idempotent and
+        # race-free against GC
+        if self._finalizer.detach() is not None:
             self._lib.fcsv_close(self._h)
-            self._h = None
+        self._h = None
 
     def __enter__(self):
         return self
 
     def __exit__(self, *exc):
         self.close()
-
-    def __del__(self):  # pragma: no cover - GC safety net
-        try:
-            self.close()
-        except Exception:
-            pass
 
 
 def read_csv_native(path: str, class_col: str = "", *, delimiter: str = ",",

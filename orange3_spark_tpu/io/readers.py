@@ -2,11 +2,13 @@
 
 Spark reads files split-per-executor; the TPU-native path is: host parses
 (pyarrow CSV/parquet readers — C++ under the hood, multithreaded), columns
-land in numpy, one ``jax.device_put`` shards rows over the mesh
+land in numpy, one ``put_sharded`` shards rows over the mesh
 (SURVEY.md §2b "Data ingest"; reconstructed, mount empty). On multi-host
-deployments each process would read its slice and
-``jax.make_array_from_process_local_data`` assembles the global array — same
-call sites, gated on process count.
+deployments each process reads its slice (``io.multihost.shard_paths`` /
+``process_row_slice``) and ``put_sharded`` — which every table/stream
+device feed goes through — switches to
+``jax.make_array_from_process_local_data`` global assembly, gated on
+``jax.process_count()`` (io/multihost.py).
 
 Schema inference: numeric columns → ContinuousVariable; string columns with
 few uniques → DiscreteVariable (value-indexed); other strings → metas. The

@@ -27,6 +27,8 @@ difference.
 from __future__ import annotations
 
 import dataclasses
+import queue
+import threading
 from functools import partial
 from typing import Callable, Iterable, Iterator
 
@@ -36,6 +38,7 @@ import numpy as np
 import optax
 
 from orange3_spark_tpu.core.session import TpuSession
+from orange3_spark_tpu.io.multihost import put_sharded
 from orange3_spark_tpu.models.base import Estimator, Params
 
 # (X [n,d], y [n] or None) or (X, y, w) — sources may carry row weights
@@ -68,6 +71,80 @@ def csv_chunk_source(
                     yield c, None
 
     return open_stream
+
+
+def csv_raw_chunk_source(
+    path: str, *, chunk_rows: int = 1 << 20, delimiter: str = ",",
+    header: bool = True, n_threads: int = 0,
+    categorical_cols: tuple = (),
+) -> Callable[[], Iterator[np.ndarray]]:
+    """Re-iterable source of RAW [n, ncols] f32 chunks — no host-side
+    label split, so the parser's output buffer is device_put as-is (zero
+    host copies). Pair with an estimator's ``label_in_chunk`` mode, which
+    slices the label column inside the jit. ``categorical_cols`` marks
+    string columns for parse-time crc32 hashing (io/native.py)."""
+    from orange3_spark_tpu.io.native import NativeCsvReader
+
+    def open_stream() -> Iterator[np.ndarray]:
+        with NativeCsvReader(path, delimiter=delimiter, header=header,
+                             n_threads=n_threads,
+                             categorical_cols=categorical_cols) as r:
+            yield from r.chunks(chunk_rows)
+
+    return open_stream
+
+
+_PREFETCH_EOF = object()
+
+
+def prefetch_map(fn: Callable, items: Iterator, *, depth: int = 2) -> Iterator:
+    """Run ``fn`` over ``items`` on a daemon thread, yielding results in
+    order through a bounded queue.
+
+    This is the chunk pipeline's overlap engine: with
+    ``fn = parse+pad+device_put`` the host prepares (and DMAs) chunk t+1
+    while the device runs step t. The native parser and ``device_put`` both
+    release the GIL, so the worker genuinely overlaps the main thread's
+    dispatch work even on a single-core host (the transfer's wait-on-DMA
+    time is free CPU for the parser). Worker exceptions re-raise at the
+    consuming ``next()``; closing the generator early stops the worker."""
+    q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+
+    def worker():
+        try:
+            for item in items:
+                out = fn(item)
+                while not stop.is_set():
+                    try:
+                        q.put(out, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+            payload = (_PREFETCH_EOF, None)
+        except BaseException as e:  # noqa: BLE001 - re-raised on the consumer
+            payload = (_PREFETCH_EOF, e)
+        while not stop.is_set():
+            try:
+                q.put(payload, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    t = threading.Thread(target=worker, daemon=True, name="chunk-prefetch")
+    t.start()
+    try:
+        while True:
+            got = q.get()
+            if isinstance(got, tuple) and len(got) == 2 and got[0] is _PREFETCH_EOF:
+                if got[1] is not None:
+                    raise got[1]
+                return
+            yield got
+    finally:
+        stop.set()
 
 
 def array_chunk_source(X: np.ndarray, y: np.ndarray | None = None,
@@ -260,8 +337,8 @@ class StreamingKMeans(Estimator):
                         session.replicated,
                     )
                 Xp, _, wp = _pad_chunk(X_np, None, w_np, pad_rows, n_features)
-                Xd = jax.device_put(Xp, row_sh)
-                wd = jax.device_put(wp, vec_sh)
+                Xd = put_sharded(Xp, row_sh)
+                wd = put_sharded(wp, vec_sh)
                 centers, counts, cost = _kmeans_stream_step(
                     centers, counts, Xd, wd, decay, k=p.k
                 )
@@ -363,9 +440,9 @@ class StreamingLinearEstimator(Estimator):
                             "true class count"
                         )
                 Xp, yp, wp = _pad_chunk(X_np, y_np, w_np, pad_rows, n_features)
-                Xd = jax.device_put(Xp, row_sh)
-                yd = jax.device_put(yp, vec_sh)
-                wd = jax.device_put(wp, vec_sh)
+                Xd = put_sharded(Xp, row_sh)
+                yd = put_sharded(yp, vec_sh)
+                wd = put_sharded(wp, vec_sh)
                 theta, opt_state, loss = _stream_step(
                     theta, opt_state, Xd, yd, wd, reg, lr,
                     loss_kind=p.loss,

@@ -170,6 +170,10 @@ def owlqn_minimize(
         gp = pseudo_grad(x, g)
         d = -two_loop(gp, S, Y, rho, n_mem)
         d = jnp.where(d * gp < 0, d, 0.0)  # keep only descent-aligned coords
+        # a fully-zeroed direction would make the linesearch accept x_t == x
+        # (Armijo holds trivially at step 0) and spin to max_iter — treat it
+        # as converged/stalled instead
+        d_zero = ~jnp.any(d != 0.0)
         x_new, F_new, ok = linesearch(x, F, gp, d, n_mem)
         _, g_new = grad_fn(x_new)
         s, yv = x_new - x, g_new - g
@@ -182,7 +186,7 @@ def owlqn_minimize(
         )
         n_mem = jnp.where(keep, jnp.minimum(n_mem + 1, m), n_mem)
         gpnorm = jnp.linalg.norm(pseudo_grad(x_new, g_new))
-        return x_new, F_new, g_new, gpnorm, S, Y, rho, n_mem, it + 1, ~ok
+        return x_new, F_new, g_new, gpnorm, S, Y, rho, n_mem, it + 1, ~ok | d_zero
 
     def keep_going(carry):
         _, _, _, gpnorm, *_, it, stalled = carry
@@ -241,6 +245,12 @@ def per_row_loss(loss_kind: str, logits, y):
         return -jnp.take_along_axis(
             logp, y.astype(jnp.int32)[:, None], axis=1
         )[:, 0]
+    if loss_kind == "binary_logistic":
+        # single-logit sigmoid form (k=1): numerically stable softplus(z)-z*y.
+        # Identical optimum to 2-column softmax but HALF the embedding-table
+        # gather/scatter traffic — the hashed Criteo path's hot bytes.
+        z = logits[:, 0]
+        return jnp.maximum(z, 0.0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
     if loss_kind in ("hinge", "squared_hinge"):
         sign = 2.0 * y - 1.0
         margin = jnp.maximum(0.0, 1.0 - sign * logits[:, 0])

@@ -45,7 +45,10 @@ class ALSParams(Params):
     reg_param: float = 0.1         # MLlib regParam (ALS-WR: scaled by n_ratings)
     implicit_prefs: bool = False   # MLlib implicitPrefs
     alpha: float = 1.0             # MLlib alpha (implicit confidence)
-    nonnegative: bool = False      # MLlib nonnegative (NNLS) — not implemented
+    nonnegative: bool = False      # MLlib nonnegative: batched NNLS solves
+    nnls_sweeps: int = 48          # coordinate-descent sweeps per NNLS solve
+    n_users: int = 0               # explicit user-dim (0 = infer from data max)
+    n_items: int = 0               # explicit item-dim (0 = infer from data max)
     seed: int = 0                  # MLlib seed
     user_col: str = "user"         # MLlib userCol
     item_col: str = "item"         # MLlib itemCol
@@ -54,8 +57,41 @@ class ALSParams(Params):
     chunk_size: int = 1 << 18      # ratings per scan chunk (HBM knob)
 
 
+def _nnls_cd(A, b, x0, sweeps: int):
+    """Batched NNLS: min_x 0.5 xᵀAx - bᵀx s.t. x >= 0, for PSD A.
+
+    Cyclic projected coordinate descent (x_j <- max(0, x_j - g_j/A_jj)),
+    ``sweeps`` full cycles, vectorized over all entities at once — the
+    TPU-shaped replacement for MLlib's per-entity active-set NNLS (one
+    [n_entities]-wide VPU update per coordinate, no data-dependent loops).
+    Warm-started from the clipped unconstrained solve, convergence is linear;
+    48 sweeps puts KKT residuals below 1e-4 at rank<=64 in practice.
+
+    A: [n, k, k], b: [n, k], x0: [n, k] -> [n, k]
+    """
+    k = b.shape[1]
+    diag = jnp.maximum(jnp.diagonal(A, axis1=1, axis2=2), 1e-12)  # [n, k]
+
+    def coord(j, x):
+        Aj = jax.lax.dynamic_slice_in_dim(A, j, 1, axis=1)[:, 0, :]  # [n, k]
+        g = jnp.sum(Aj * x, axis=1) - jax.lax.dynamic_slice_in_dim(
+            b, j, 1, axis=1)[:, 0]
+        dj = jax.lax.dynamic_slice_in_dim(diag, j, 1, axis=1)[:, 0]
+        xj = jax.lax.dynamic_slice_in_dim(x, j, 1, axis=1)[:, 0]
+        new = jnp.maximum(0.0, xj - g / dj)
+        return jax.lax.dynamic_update_slice_in_dim(
+            x, new[:, None], j, axis=1
+        )
+
+    def sweep(_, x):
+        return jax.lax.fori_loop(0, k, coord, x)
+
+    return jax.lax.fori_loop(0, sweeps, sweep, jnp.maximum(x0, 0.0))
+
+
 def _solve_side(idx, other_idx, rating, w, other_factors, n_entities: int,
-                reg: float, implicit: bool, alpha: float, chunk: int):
+                reg: float, implicit: bool, alpha: float, chunk: int,
+                nonnegative: bool = False, nnls_sweeps: int = 48):
     """Normal-equation solve for one side given the other side's factors."""
     k = other_factors.shape[1]
     n = idx.shape[0]
@@ -101,27 +137,47 @@ def _solve_side(idx, other_idx, rating, w, other_factors, n_entities: int,
     eye = jnp.eye(k, dtype=jnp.float32)
     reg_scale = cnt if not implicit else jnp.ones_like(cnt)
     A = A + (lam * jnp.maximum(reg_scale, 1.0))[:, None, None] * eye
-    return jnp.linalg.solve(A, b[..., None])[..., 0]  # [n_entities, k]
+    x = jnp.linalg.solve(A, b[..., None])[..., 0]  # [n_entities, k]
+    if nonnegative:
+        x = _nnls_cd(A, b, x, nnls_sweeps)
+    return x
 
 
 @partial(
     jax.jit,
-    static_argnames=("n_users", "n_items", "rank", "max_iter", "implicit", "chunk"),
+    static_argnames=("n_users", "n_items", "rank", "max_iter", "implicit",
+                     "chunk", "nonnegative", "nnls_sweeps", "factor_sharding"),
 )
 def _als_fit(user_idx, item_idx, rating, w, *, n_users: int, n_items: int,
              rank: int, max_iter: int, reg: float, implicit: bool,
-             alpha: float, chunk: int, seed: int = 0):
+             alpha: float, chunk: int, seed: int = 0,
+             nonnegative: bool = False, nnls_sweeps: int = 48,
+             factor_sharding=None):
+    """factor_sharding: optional NamedSharding (hashable, static) pinning the
+    factor tables over the mesh's 'model' axis — entities shard, so each
+    half-step's batched Cholesky/NNLS solves run model-parallel and GSPMD
+    reduce-scatters the segment-summed normal equations (MLlib's rating-block
+    shuffle, as one collective over ICI)."""
     key_u, key_v = jax.random.split(jax.random.PRNGKey(seed))
     # MLlib init: abs(normal)/sqrt(rank) keeps initial predictions positive
     U = jnp.abs(jax.random.normal(key_u, (n_users, rank))) / jnp.sqrt(rank)
     V = jnp.abs(jax.random.normal(key_v, (n_items, rank))) / jnp.sqrt(rank)
 
+    def pin(F):
+        if factor_sharding is None:
+            return F
+        return jax.lax.with_sharding_constraint(F, factor_sharding)
+
+    U, V = pin(U), pin(V)
+
     def one_iter(carry, _):
         U, V = carry
-        U = _solve_side(user_idx, item_idx, rating, w, V, n_users,
-                        reg, implicit, alpha, chunk)
-        V = _solve_side(item_idx, user_idx, rating, w, U, n_items,
-                        reg, implicit, alpha, chunk)
+        U = pin(_solve_side(user_idx, item_idx, rating, w, V, n_users,
+                            reg, implicit, alpha, chunk,
+                            nonnegative, nnls_sweeps))
+        V = pin(_solve_side(item_idx, user_idx, rating, w, U, n_items,
+                            reg, implicit, alpha, chunk,
+                            nonnegative, nnls_sweeps))
         return (U, V), None
 
     (U, V), _ = jax.lax.scan(one_iter, (U, V), None, length=max_iter)
@@ -196,20 +252,42 @@ class ALS(Estimator):
 
     def _fit(self, table: TpuTable) -> ALSModel:
         p = self.params
-        if p.nonnegative:
-            raise NotImplementedError(
-                "nonnegative=True (NNLS solves) is not implemented yet"
-            )
         u = table.column(p.user_col).astype(jnp.int32)
         i = table.column(p.item_col).astype(jnp.int32)
         r = table.column(p.rating_col)
-        n_users = int(np.asarray(jnp.max(jnp.where(table.W > 0, u, 0))).item()) + 1
-        n_items = int(np.asarray(jnp.max(jnp.where(table.W > 0, i, 0))).item()) + 1
+        # one device->host sync for the observed index range; with explicit
+        # dims it becomes a RANGE CHECK (a fit that silently clipped or
+        # under-sized its factor tables would be quietly wrong)
+        max_u = int(np.asarray(jnp.max(jnp.where(table.W > 0, u, 0))).item())
+        max_i = int(np.asarray(jnp.max(jnp.where(table.W > 0, i, 0))).item())
+        if p.n_users > 0:
+            if max_u >= p.n_users:
+                raise ValueError(
+                    f"user index {max_u} out of range for n_users={p.n_users}"
+                )
+            n_users = p.n_users
+        else:
+            n_users = max_u + 1
+        if p.n_items > 0:
+            if max_i >= p.n_items:
+                raise ValueError(
+                    f"item index {max_i} out of range for n_items={p.n_items}"
+                )
+            n_items = p.n_items
+        else:
+            n_items = max_i + 1
+        session = table.session
+        factor_sharding = None
+        if session is not None and session.model_axis is not None and \
+                session.mesh.shape.get(session.model_axis, 1) > 1:
+            factor_sharding = session.sharding(session.model_axis, None)
         U, V = _als_fit(
             u, i, r, table.W,
             n_users=n_users, n_items=n_items, rank=p.rank, max_iter=p.max_iter,
             reg=p.reg_param, implicit=p.implicit_prefs, alpha=p.alpha,
             chunk=min(p.chunk_size, table.n_pad), seed=p.seed,
+            nonnegative=p.nonnegative, nnls_sweeps=p.nnls_sweeps,
+            factor_sharding=factor_sharding,
         )
         return ALSModel(p, U, V)
 

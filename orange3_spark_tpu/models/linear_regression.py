@@ -115,7 +115,7 @@ class LinearRegression(Estimator):
             jnp.float32(p.reg_param * (1.0 - alpha)),
             jnp.float32(p.tol), jnp.int32(p.max_iter),
             None,
-            jnp.float32(p.reg_param * alpha) if alpha > 0.0 else None,
+            jnp.float32(p.reg_param * alpha) if p.reg_param * alpha > 0.0 else None,
             loss_kind="squared", k=1, fit_intercept=p.fit_intercept,
             compute_dtype=jnp.dtype(p.compute_dtype),
         )

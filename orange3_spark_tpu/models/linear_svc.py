@@ -108,7 +108,7 @@ class LinearSVC(Estimator):
             jnp.float32(p.tol),
             jnp.int32(p.max_iter),
             inv_std,
-            jnp.float32(p.reg_param * alpha) if alpha > 0.0 else None,
+            jnp.float32(p.reg_param * alpha) if p.reg_param * alpha > 0.0 else None,
             loss_kind=p.loss,
             k=1,
             fit_intercept=p.fit_intercept,

@@ -115,7 +115,7 @@ class LogisticRegression(Estimator):
             jnp.float32(p.tol),
             jnp.int32(p.max_iter),
             inv_std,
-            jnp.float32(p.reg_param * alpha) if alpha > 0.0 else None,
+            jnp.float32(p.reg_param * alpha) if p.reg_param * alpha > 0.0 else None,
             loss_kind="logistic",
             k=k,
             fit_intercept=p.fit_intercept,

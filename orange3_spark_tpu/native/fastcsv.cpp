@@ -3,7 +3,7 @@
 // Plays the role of Spark's native ingest substrate (the JVM CSV reader +
 // Tungsten columnar memory behind `spark.read.csv`; SURVEY.md §2b "Data
 // ingest" — reconstructed, reference mount empty). The TPU framework's hot
-// ingest path must keep the single host core from becoming the bottleneck
+// ingest path must keep the host core(s) from becoming the bottleneck
 // between disk and `jax.device_put`, so parsing is:
 //
 //   * chunked: the file is read in large blocks clipped to line boundaries,
@@ -11,18 +11,31 @@
 //     (out-of-core — the NYC-Taxi/Criteo configs never fit in RAM);
 //   * parallel: each chunk's rows are split across threads; every thread
 //     writes disjoint [row, col] slots of the caller's buffer, no locks;
-//   * allocation-free in steady state: one pass memchr's newline offsets,
-//     then a hand-rolled float parser (no strtof locale machinery) fills
-//     the row-major float32 buffer the Python side hands in (which is the
+//   * allocation-free in steady state: the block buffer's capacity is
+//     reserved once (sized from the observed bytes/row) and REUSED across
+//     chunks — regrowing a vector 4 MB at a time is a quadratic memcpy
+//     that single-handedly halves parse throughput on a 1-core host;
+//   * a hand-rolled float parser (no strtof locale machinery) fills the
+//     row-major float32 buffer the Python side hands in (which is the
 //     exact layout device_put wants for P('data', None) sharding).
+//
+// Categorical columns (fcsv_set_categorical): real Criteo ships hex-string
+// categories. Columns marked categorical are not float-parsed; the cell's
+// exact bytes (after RFC-4180 unquoting) are crc32-hashed (zlib polynomial,
+// so the code equals python's `zlib.crc32(cell)`), masked to 24 bits so the
+// value is EXACT in float32 (matching ops/hashing.py strings_to_u32 —
+// models checkpoint-port between the host and native on-ramps), and stored
+// as that integer's float value. Numeric-looking cells in a categorical
+// column hash like any other string — a declared categorical is opaque.
 //
 // C API only (extern "C") — bound from Python with ctypes; no pybind11.
 //
 // Dialect: RFC-4180-ish. Quoted cells may contain the delimiter ("" escapes
-// a quote); numeric quoted content parses, text becomes NaN. Embedded
-// NEWLINES inside quoted cells are NOT supported (the chunker's newline scan
-// is quote-blind by design — it is what keeps chunk splitting O(memchr)) —
-// use io/readers.py (pyarrow) for such files.
+// a quote); numeric quoted content parses, text becomes NaN (or a crc32
+// code in categorical columns). Embedded NEWLINES inside quoted cells are
+// NOT supported (the chunker's newline scan is quote-blind by design — it
+// is what keeps chunk splitting O(memchr)) — use io/readers.py (pyarrow)
+// for such files.
 
 #include <atomic>
 #include <cstdint>
@@ -41,32 +54,97 @@ struct CsvHandle {
   char delim = ',';
   std::vector<std::string> colnames;
   int ncols = 0;
+  std::vector<uint8_t> is_cat;  // per-column categorical flag
   // carry: bytes of a trailing partial line from the previous block
   std::vector<char> carry;
+  // reusable block buffer (capacity persists across chunks)
+  std::vector<char> buf;
+  std::vector<size_t> starts, ends;
   bool eof = false;
   long rows_read = 0;
+  size_t est_row_bytes = 64;  // adapted after the first chunk
 };
+
+// ----------------------------------------------------------------- crc32
+// zlib-compatible crc32 (poly 0xEDB88320), table generated at first use so
+// codes match python's zlib.crc32 byte-for-byte.
+const uint32_t* crc_table() {
+  static uint32_t table[256];
+  static std::atomic<bool> ready{false};
+  if (!ready.load(std::memory_order_acquire)) {
+    static std::atomic<bool> building{false};
+    bool expected = false;
+    if (building.compare_exchange_strong(expected, true)) {
+      for (uint32_t i = 0; i < 256; ++i) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; ++k)
+          c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+        table[i] = c;
+      }
+      ready.store(true, std::memory_order_release);
+    } else {
+      while (!ready.load(std::memory_order_acquire)) {}
+    }
+  }
+  return table;
+}
+
+inline uint32_t crc32_bytes(const char* p, size_t n) {
+  const uint32_t* t = crc_table();
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i)
+    c = t[(c ^ (uint8_t)p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// 24-bit mask: codes must survive a float32 round-trip exactly
+// (ops/hashing.py STRING_CODE_MASK).
+constexpr uint32_t kStringCodeMask = 0x00FFFFFF;
+
+// powers of ten for the mantissa/exponent recombination; f32 underflows
+// below 1e-45 and overflows above ~3.4e38, so +-60 covers everything a
+// float32 output can represent (clamped beyond).
+struct Pow10Table {
+  double t[121];
+  Pow10Table() {
+    for (int i = 0; i <= 120; ++i) t[i] = std::pow(10.0, i - 60);
+  }
+};
+
+const double* pow10_table() {
+  // C++11 magic static: thread-safe one-time init (parse threads race here
+  // on the very first multi-threaded chunk)
+  static const Pow10Table table;
+  return table.t + 60;  // index by exponent directly
+}
 
 // fast float parser: [-+]?digits[.digits][(e|E)[-+]digits]; NaN on garbage.
 // Returns value, advances *p to the first unconsumed char.
+//
+// Digits accumulate into an int64 mantissa (int multiply chain — roughly
+// half the latency of the naive double val*10+d chain, which is THE hot
+// serial dependency at 80M cells/chunk) and recombine with one table-lookup
+// multiply. 18 significant digits are kept — beyond float32's 24-bit
+// mantissa by a comfortable margin.
 inline float parse_float(const char* p, const char* end, const char** out) {
   const char* s = p;
   while (s < end && (*s == ' ' || *s == '\t')) ++s;
   bool neg = false;
   if (s < end && (*s == '-' || *s == '+')) { neg = (*s == '-'); ++s; }
-  double val = 0.0;
+  uint64_t mant = 0;
+  int exp10 = 0;
+  int ndig = 0;
   bool any = false;
   while (s < end && *s >= '0' && *s <= '9') {
-    val = val * 10.0 + (*s - '0');
+    if (ndig < 18) { mant = mant * 10 + (uint64_t)(*s - '0'); ++ndig; }
+    else ++exp10;  // overflow digits only shift the magnitude
     any = true;
     ++s;
   }
   if (s < end && *s == '.') {
     ++s;
-    double frac = 0.1;
     while (s < end && *s >= '0' && *s <= '9') {
-      val += (*s - '0') * frac;
-      frac *= 0.1;
+      if (ndig < 18) { mant = mant * 10 + (uint64_t)(*s - '0'); ++ndig; --exp10; }
       any = true;
       ++s;
     }
@@ -83,52 +161,143 @@ inline float parse_float(const char* p, const char* end, const char** out) {
       ++es;
     }
     if (eany) {
-      val *= std::pow(10.0, eneg ? -ev : ev);
+      exp10 += eneg ? -ev : ev;
       s = es;
     }
   }
   *out = s;
   if (!any) return std::nanf("");
+  double val;
+  if (exp10 == 0) {
+    val = (double)mant;
+  } else if (exp10 >= -60 && exp10 <= 60) {
+    val = (double)mant * pow10_table()[exp10];
+  } else {
+    val = (double)mant * std::pow(10.0, exp10);  // clamps to inf/0 in f32
+  }
   return static_cast<float>(neg ? -val : val);
+}
+
+// crc32-hash one cell's content; quoted cells hash their unescaped interior
+// ("" -> "). The unescape path copies into a small stack/local buffer only
+// when an escape is actually present.
+inline float hash_cell(const char* p, const char* cell_end, bool quoted) {
+  uint32_t code;
+  if (!quoted) {
+    code = crc32_bytes(p, cell_end - p);
+  } else {
+    // p points INSIDE the quotes, cell_end at the closing quote
+    const char* esc = nullptr;
+    for (const char* q = p; q + 1 < cell_end; ++q)
+      if (*q == '"' && q[1] == '"') { esc = q; break; }
+    if (!esc) {
+      code = crc32_bytes(p, cell_end - p);
+    } else {
+      std::string tmp;
+      tmp.reserve(cell_end - p);
+      for (const char* q = p; q < cell_end; ++q) {
+        tmp.push_back(*q);
+        if (*q == '"' && q + 1 < cell_end && q[1] == '"') ++q;
+      }
+      code = crc32_bytes(tmp.data(), tmp.size());
+    }
+  }
+  return static_cast<float>(code & kStringCodeMask);
+}
+
+// Fast-path numeric cell parse over a KNOWN cell extent [s, e):
+// [-+]?digits[.digits] with NO bounds re-checks inside the digit loops
+// (caller guarantees e - s <= 19, so the uint64 mantissa cannot overflow).
+// Returns false when the cell needs the careful parser (exponent, spaces,
+// stray bytes).
+inline bool parse_cell_fast(const char* s, const char* e, float* out) {
+  if (s == e) { *out = std::nanf(""); return true; }  // empty cell
+  bool neg = false;
+  if (*s == '-' || *s == '+') { neg = (*s == '-'); ++s; }
+  uint64_t mant = 0;
+  int frac = 0;
+  bool any = false;
+  const char* q = s;
+  while (q < e) {
+    unsigned d = (unsigned)(*q - '0');
+    if (d <= 9) { mant = mant * 10 + d; any = true; ++q; continue; }
+    if (*q == '.') {
+      ++q;
+      const char* f0 = q;
+      while (q < e) {
+        unsigned fd = (unsigned)(*q - '0');
+        if (fd > 9) return false;  // exponent or junk -> careful path
+        mant = mant * 10 + fd;
+        ++q;
+      }
+      frac = (int)(q - f0);
+      any = any || frac > 0;
+      break;
+    }
+    return false;  // 'e', 'E', spaces, text -> careful path
+  }
+  if (!any) return false;  // no digits at all ('-', '.', nan)
+  double val = (double)mant;
+  if (frac) val *= pow10_table()[-frac];
+  *out = (float)(neg ? -val : val);
+  return true;
 }
 
 // parse rows [r0, r1) given newline offsets; writes out[row*ncols + col].
 void parse_rows(const char* buf, const std::vector<size_t>& starts,
                 const std::vector<size_t>& ends, size_t r0, size_t r1,
-                int ncols, char delim, float* out) {
+                int ncols, char delim, const uint8_t* is_cat, float* out) {
   for (size_t r = r0; r < r1; ++r) {
     const char* p = buf + starts[r];
     const char* end = buf + ends[r];
     float* row = out + r * ncols;
     int c = 0;
     while (c < ncols) {
-      const char* next;
+      const bool cat = is_cat[c];
       if (p < end && *p == '"') {
         // quoted cell: delimiters inside the quotes belong to the cell
-        // ("" escapes a quote). Numeric content still parses; text -> NaN.
+        // ("" escapes a quote)
         const char* q = p + 1;
-        row[c] = parse_float(q, end, &next);
+        const char* content = q;
         while (q < end) {
           if (*q == '"') {
             if (q + 1 < end && q[1] == '"') { q += 2; continue; }
-            ++q;  // closing quote
-            break;
+            break;  // closing quote
           }
           ++q;
         }
-        p = q;
+        if (cat) {
+          row[c] = hash_cell(content, q, /*quoted=*/true);
+        } else {
+          const char* next;
+          row[c] = parse_float(content, q, &next);
+        }
+        p = (q < end) ? q + 1 : q;  // past closing quote
+        // skip to the delimiter
+        while (p < end && *p != delim) ++p;
       } else {
-        row[c] = parse_float(p, end, &next);
-        p = next;
+        // one scan finds the cell extent; the parse then runs bounds-free
+        const char* cell_end = p;
+        while (cell_end < end && *cell_end != delim) ++cell_end;
+        if (cat) {
+          row[c] = hash_cell(p, cell_end, /*quoted=*/false);
+        } else if (cell_end - p <= 19) {
+          if (!parse_cell_fast(p, cell_end, &row[c])) {
+            const char* next;
+            row[c] = parse_float(p, cell_end, &next);
+          }
+        } else {
+          const char* next;
+          row[c] = parse_float(p, cell_end, &next);
+        }
+        p = cell_end;
       }
-      // skip to the delimiter (unquoted junk until the delimiter belongs to
-      // this cell; non-numeric cells came back NaN)
-      while (p < end && *p != delim) ++p;
       if (p < end) ++p;  // eat delimiter
       ++c;
       if (p >= end) break;
     }
-    for (; c < ncols; ++c) row[c] = std::nanf("");
+    for (; c < ncols; ++c)
+      row[c] = is_cat[c] ? hash_cell(nullptr, nullptr, false) : std::nanf("");
   }
 }
 
@@ -150,6 +319,7 @@ void* fcsv_open(const char* path, char delim, int header) {
   int ncols = 1;
   for (char c : line) ncols += (c == delim);
   h->ncols = ncols;
+  h->is_cat.assign(ncols, 0);
   size_t start = 0;
   for (int j = 0; j < ncols; ++j) {
     size_t pos = line.find(delim, start);
@@ -163,6 +333,7 @@ void* fcsv_open(const char* path, char delim, int header) {
     h->carry.assign(line.begin(), line.end());
     h->carry.push_back('\n');
   }
+  h->est_row_bytes = line.size() + 2;
   return h;
 }
 
@@ -174,17 +345,35 @@ const char* fcsv_colname(void* hv, int j) {
   return h->colnames[j].c_str();
 }
 
+// Mark column j categorical (cells crc32&0xFFFFFF-hashed instead of
+// float-parsed). Returns 0 on success, -1 on bad index.
+int fcsv_set_categorical(void* hv, int j, int on) {
+  auto* h = static_cast<CsvHandle*>(hv);
+  if (j < 0 || j >= h->ncols) return -1;
+  h->is_cat[j] = on ? 1 : 0;
+  return 0;
+}
+
 // Parse up to max_rows rows into out (row-major f32 [max_rows, ncols]).
 // Returns rows produced; 0 => EOF. nthreads <= 0 => hardware concurrency.
 long fcsv_read_chunk(void* hv, float* out, long max_rows, int nthreads) {
   auto* h = static_cast<CsvHandle*>(hv);
   if (max_rows <= 0) return 0;
   const int ncols = h->ncols;
-  // target block: ~48 bytes/cell upper bound keeps us under max_rows lines
-  // in almost all cases; loop tops up if lines are shorter.
-  std::vector<char> buf(std::move(h->carry));
+  // move the carry to the front of the REUSED block buffer; capacity is
+  // reserved once from the bytes/row estimate so steady-state chunks do
+  // zero reallocation (a growing vector re-copies everything it holds on
+  // every 4 MB top-up — quadratic and measurable at 1-core Criteo scale)
+  std::vector<char>& buf = h->buf;
+  buf.clear();
+  size_t reserve_hint = h->est_row_bytes * (size_t)max_rows + (8u << 20);
+  if (buf.capacity() < reserve_hint) buf.reserve(reserve_hint);
+  buf.insert(buf.end(), h->carry.begin(), h->carry.end());
   h->carry.clear();
-  std::vector<size_t> starts, ends;
+  std::vector<size_t>& starts = h->starts;
+  std::vector<size_t>& ends = h->ends;
+  starts.clear();
+  ends.clear();
   starts.reserve(max_rows);
   ends.reserve(max_rows);
   size_t scan_from = 0;
@@ -234,12 +423,17 @@ long fcsv_read_chunk(void* hv, float* out, long max_rows, int nthreads) {
     h->carry.assign(buf.begin() + scan_from, buf.end());
   }
   if (nrows == 0) return 0;
+  if (h->rows_read == 0 && nrows > 16) {
+    // adapt the reserve hint to the observed data density
+    h->est_row_bytes = (ends[nrows - 1] - starts[0]) / (size_t)nrows + 2;
+  }
   int T = nthreads > 0 ? nthreads
                        : (int)std::thread::hardware_concurrency();
   if (T < 1) T = 1;
   if ((long)T > nrows) T = (int)nrows;
   if (T == 1) {
-    parse_rows(buf.data(), starts, ends, 0, nrows, ncols, h->delim, out);
+    parse_rows(buf.data(), starts, ends, 0, nrows, ncols, h->delim,
+               h->is_cat.data(), out);
   } else {
     std::vector<std::thread> threads;
     size_t per = (nrows + T - 1) / T;
@@ -248,7 +442,8 @@ long fcsv_read_chunk(void* hv, float* out, long max_rows, int nthreads) {
       size_t r1 = std::min<size_t>(r0 + per, nrows);
       if (r0 >= r1) break;
       threads.emplace_back(parse_rows, buf.data(), std::cref(starts),
-                           std::cref(ends), r0, r1, ncols, h->delim, out);
+                           std::cref(ends), r0, r1, ncols, h->delim,
+                           h->is_cat.data(), out);
     }
     for (auto& th : threads) th.join();
   }

@@ -19,7 +19,7 @@ import zlib
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["hash_columns", "column_salts", "strings_to_u32"]
+__all__ = ["hash_columns", "column_salts", "strings_to_u32", "STRING_CODE_MASK"]
 
 
 def _fmix32(h):
@@ -52,15 +52,27 @@ def hash_columns(cats, salts, n_dims: int):
     return (h & jnp.uint32(n_dims - 1)).astype(jnp.int32)
 
 
+#: String codes are masked to 24 bits so they survive a float32 round-trip
+#: EXACTLY (f32 mantissa = 24 bits) — the chunk pipeline carries categoricals
+#: as one f32 array (see models/hashed_linear.py) and full-range u32 codes
+#: would collapse above 2^24. The native parser's categorical mode
+#: (native/fastcsv.cpp fcsv_set_categorical) applies the SAME crc32 & mask so
+#: models checkpoint-port between the host and native on-ramps.
+STRING_CODE_MASK = 0x00FFFFFF
+
+
 def strings_to_u32(arr) -> np.ndarray:
     """Host-side: stable uint32 codes for string categoricals (crc32 — python
     ``hash()`` is per-process salted and therefore useless for checkpoints).
     Real Criteo ships hex-string categories; this is their on-ramp into the
-    integer pipeline. Vectorized per unique value, so cost is O(cardinality)."""
+    integer pipeline. Vectorized per unique value, so cost is O(cardinality).
+
+    Codes are ``crc32 & STRING_CODE_MASK`` (24 bits): exact in float32, so
+    the f32 chunk path cannot merge distinct codes."""
     arr = np.asarray(arr)
     uniq, inv = np.unique(arr, return_inverse=True)
     codes = np.fromiter(
-        (zlib.crc32(str(u).encode()) for u in uniq),
+        (zlib.crc32(str(u).encode()) & STRING_CODE_MASK for u in uniq),
         dtype=np.uint32,
         count=len(uniq),
     )
