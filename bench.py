@@ -174,6 +174,17 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS) -> dict:
     rows_per_sec_per_chip = rows_streamed / wall / n_chips
     row_bytes = (1 + N_DENSE + N_CAT) * 4  # device-feed bytes per row
     epoch_s = stage_times.get("epoch_s", [])
+    # analytic HBM traffic of one device step (k=1 table): chunk read
+    # (41 f32 cols) + embedding gather/scatter (26 idx/row: value read +
+    # grad write + index reads) + 6 adam passes over the 4 MB table;
+    # divided by the measured HBM-replay step time. Far below the chip's
+    # ~800 GB/s peak == scatter-OP-bound, not bandwidth-bound (BASELINE.md).
+    hbm_gbps = None
+    steps_per_epoch = model.n_steps_ // max(epochs, 1)
+    if len(epoch_s) > 1 and steps_per_epoch:
+        step_s = (sum(epoch_s[1:]) / (len(epoch_s) - 1)) / steps_per_epoch
+        step_bytes = CHUNK_ROWS * (41 * 4 + 26 * 12) + 6 * N_DIMS * 4
+        hbm_gbps = round(step_bytes / step_s / 1e9, 1)
     return {
         "metric": "criteo_hashed_logreg_rows_per_sec_per_chip",
         "value": round(rows_per_sec_per_chip, 1),
@@ -198,6 +209,7 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS) -> dict:
         "device_epoch_s": (round(sum(epoch_s[1:]) / max(len(epoch_s) - 1, 1), 2)
                           if len(epoch_s) > 1 else None),
         "input_gbps": round(n_rows * row_bytes / wall / 1e9, 3),
+        "device_hbm_gbps_est": hbm_gbps,
         "final_logloss": (None if model.final_loss_ is None
                           else round(model.final_loss_, 4)),
         "holdout_logloss": round(ev["logloss"], 4),

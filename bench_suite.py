@@ -1,0 +1,264 @@
+"""Benchmark suite — BASELINE configs 3-5 (bench.py owns config 2).
+
+Prints ONE JSON line per config:
+
+  3 HIGGS-proxy    GBTClassifier + RandomForestClassifier fit wall + AUC
+  4 MovieLens-proxy ALS rank-16 over 25M ratings, fit wall + RMSE
+  5 Taxi-proxy      KMeans+PCA feature pipeline, eager widget-graph wall vs
+                    staged single-XLA-computation wall
+
+No published reference numbers exist (BASELINE.md: empty mount,
+`published: {}`), so every `vs_baseline` is null — the honest fields are the
+absolute wall-clocks, quality metrics, and rows/s. Shapes follow the
+BASELINE configs' datasets (synthetic, same dimensionality); row counts are
+sized to one chip's HBM and can be overridden with --rows-scale.
+
+Run: python bench_suite.py [--config 3|4|5|all] [--rows-scale 1.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------- config 3
+def bench_higgs_trees(scale: float) -> dict:
+    """HIGGS-11M proxy: 28 features (21 kinematic + 7 derived), binary
+    signal-vs-background with nonlinear structure only trees can see."""
+    import jax
+    import numpy as np
+
+    from orange3_spark_tpu.core.domain import (
+        ContinuousVariable, DiscreteVariable, Domain,
+    )
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.models.gbt import GBTClassifier
+    from orange3_spark_tpu.models.random_forest import RandomForestClassifier
+
+    n_rows = int(11_000_000 * scale)
+    n_feat = 28
+    session = TpuSession.builder_get_or_create()
+    rng = np.random.default_rng(0)
+    _log(f"[higgs] generating {n_rows} x {n_feat} ...")
+    X = rng.standard_normal((n_rows, n_feat), dtype=np.float32)
+    # nonlinear signal: pairwise products + a radial term (tree-learnable,
+    # linear-model-opaque) — the HIGGS shape
+    z = (X[:, 0] * X[:, 1] - X[:, 2] * X[:, 3]
+         + 0.8 * (X[:, 4] ** 2 - 1.0)
+         + 0.6 * np.sign(X[:, 5]) * X[:, 6])
+    y = (z + 0.5 * rng.standard_normal(n_rows).astype(np.float32) > 0
+         ).astype(np.float32)
+    domain = Domain(
+        [ContinuousVariable(f"f{i}") for i in range(n_feat)],
+        DiscreteVariable("signal", ("0", "1")),
+    )
+    holdout = min(1 << 18, n_rows // 4)
+    table = TpuTable.from_numpy(domain, X[:-holdout], y[:-holdout],
+                                session=session)
+    eval_table = TpuTable.from_numpy(domain, X[-holdout:], y[-holdout:],
+                                     session=session)
+
+    def auc(scores, labels):
+        order = np.argsort(scores)
+        ranks = np.empty_like(order, dtype=np.float64)
+        ranks[order] = np.arange(1, len(scores) + 1)
+        npos = labels.sum()
+        nneg = len(labels) - npos
+        return float((ranks[labels > 0.5].sum() - npos * (npos + 1) / 2)
+                     / (npos * nneg))
+
+    out = {"metric": "higgs_trees_fit", "unit": "s", "vs_baseline": None,
+           "rows": n_rows, "features": n_feat}
+    for name, est in (
+        ("gbt", GBTClassifier(max_iter=20, max_depth=5, max_bins=32)),
+        ("rf", RandomForestClassifier(num_trees=20, max_depth=5, max_bins=32)),
+    ):
+        _log(f"[higgs] warm-up {name} (compile at the timed shape) ...")
+        est.fit(table)  # identical shape/statics: the timed fit reuses the jit
+        _log(f"[higgs] timed {name} fit ...")
+        t0 = time.perf_counter()
+        model = est.fit(table)
+        jax.block_until_ready(model.state_pytree)
+        dt = time.perf_counter() - t0
+        proba = model.predict_proba(eval_table)
+        out[f"{name}_fit_s"] = round(dt, 2)
+        out[f"{name}_rows_per_sec_per_chip"] = round(
+            (n_rows - holdout) / dt / session.n_devices, 1
+        )
+        out[f"{name}_holdout_auc"] = round(auc(proba[:, 1], y[-holdout:]), 4)
+    out["value"] = out["gbt_fit_s"]
+    return out
+
+
+# ---------------------------------------------------------------- config 4
+def bench_movielens_als(scale: float) -> dict:
+    """MovieLens-25M proxy: 25M ratings over 162k users x 59k items,
+    low-rank + noise, explicit feedback, rank-16 ALS."""
+    import jax
+    import numpy as np
+
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.models.als import ALS, ratings_table
+
+    n_ratings = int(25_000_000 * scale)
+    n_users, n_items, true_rank, rank = 162_541, 59_047, 12, 16
+    session = TpuSession.builder_get_or_create()
+    rng = np.random.default_rng(1)
+    _log(f"[als] generating {n_ratings} ratings ...")
+    Ut = rng.normal(0, 0.6, (n_users, true_rank)).astype(np.float32)
+    Vt = rng.normal(0, 0.6, (n_items, true_rank)).astype(np.float32)
+    uu = rng.integers(0, n_users, n_ratings, dtype=np.int64)
+    ii = rng.integers(0, n_items, n_ratings, dtype=np.int64)
+    rr = (np.einsum("nk,nk->n", Ut[uu], Vt[ii]) + 3.5
+          + 0.3 * rng.standard_normal(n_ratings).astype(np.float32))
+    ratings = np.stack(
+        [uu.astype(np.float32), ii.astype(np.float32), rr], axis=1
+    ).astype(np.float32)
+    holdout = min(1 << 18, n_ratings // 4)
+    t = ratings_table(ratings[:-holdout], session)
+    t_eval = ratings_table(ratings[-holdout:], session)
+
+    est = ALS(rank=rank, max_iter=10, reg_param=0.05,
+              n_users=n_users, n_items=n_items, seed=2)
+    _log("[als] warm-up (compile at the timed shape/statics) ...")
+    est.fit(t)  # max_iter is a static arg: warm-up must use the SAME value
+    _log("[als] timed fit ...")
+    t0 = time.perf_counter()
+    model = est.fit(t)
+    jax.block_until_ready(model.state_pytree)
+    dt = time.perf_counter() - t0
+
+    def rmse(tbl):
+        scored = model.transform(tbl)
+        X, _, W = scored.to_numpy()
+        pred, r = X[:, -1], X[:, 2]
+        live = (W > 0) & np.isfinite(pred)
+        return float(np.sqrt(np.mean((pred[live] - r[live]) ** 2)))
+
+    return {
+        "metric": "movielens_als_fit", "unit": "s", "value": round(dt, 2),
+        "vs_baseline": None,
+        "ratings": n_ratings, "rank": rank, "iters": 10,
+        "ratings_per_sec_per_chip": round(
+            (n_ratings - holdout) * 10 * 2 / dt / session.n_devices, 1
+        ),  # each iter scans all ratings twice (user + item half-steps)
+        "train_rmse": round(rmse(t), 4),
+        "holdout_rmse": round(rmse(t_eval), 4),
+        "noise_floor": 0.3,
+    }
+
+
+# ---------------------------------------------------------------- config 5
+def bench_taxi_pipeline(scale: float) -> dict:
+    """NYC-Taxi-1B proxy: scaler -> PCA -> KMeans feature pipeline over
+    10M x 8 trip features; the workflow staged into ONE XLA computation vs
+    eager widget-by-widget execution."""
+    import jax
+    import numpy as np
+
+    from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.widgets.catalog import WIDGET_REGISTRY, OWTable
+    from orange3_spark_tpu.workflow.graph import WorkflowGraph
+    from orange3_spark_tpu.workflow.staging import stage_graph
+
+    n_rows = int(10_000_000 * scale)
+    session = TpuSession.builder_get_or_create()
+    rng = np.random.default_rng(2)
+    _log(f"[taxi] generating {n_rows} x 8 ...")
+    # trip-shaped features: lognormal distances/fares, correlated lat/lon
+    dist = rng.lognormal(0.5, 1.0, n_rows).astype(np.float32)
+    dur = (dist * 3.2 + rng.lognormal(0, 0.4, n_rows)).astype(np.float32)
+    fare = (2.5 + 1.8 * dist + 0.4 * dur
+            + rng.standard_normal(n_rows)).astype(np.float32)
+    X = np.stack(
+        [dist, dur, fare,
+         rng.uniform(-74.05, -73.75, n_rows).astype(np.float32),
+         rng.uniform(40.6, 40.9, n_rows).astype(np.float32),
+         rng.integers(0, 24, n_rows).astype(np.float32),
+         rng.integers(0, 7, n_rows).astype(np.float32),
+         rng.integers(1, 7, n_rows).astype(np.float32)], axis=1
+    )
+    domain = Domain([ContinuousVariable(c) for c in
+                     ("dist", "dur", "fare", "lon", "lat", "hour", "dow",
+                      "pax")])
+    table = TpuTable.from_numpy(domain, X, session=session)
+
+    g = WorkflowGraph()
+    src = g.add(OWTable(table))
+    sc = g.add(WIDGET_REGISTRY["OWStandardScaler"](with_mean=True))
+    pca = g.add(WIDGET_REGISTRY["OWPCA"](k=4))
+    km = g.add(WIDGET_REGISTRY["OWKMeans"](k=10, max_iter=10))
+    g.connect(src, "data", sc, "data")
+    g.connect(sc, "data", pca, "data")
+    g.connect(pca, "data", km, "data")
+
+    _log("[taxi] eager workflow run (fits scaler/PCA/KMeans) ...")
+    t0 = time.perf_counter()
+    out_eager = g.run()[km]["data"]
+    jax.block_until_ready(out_eager.X)
+    wall_fit_eager = time.perf_counter() - t0
+
+    # transform path: eager widget-by-widget re-execution vs staged single
+    # XLA computation on the same batch
+    staged = stage_graph(g, km)
+    staged()  # compile
+    t0 = time.perf_counter()
+    out_staged = staged()
+    jax.block_until_ready(out_staged.X)
+    wall_staged = time.perf_counter() - t0
+
+    def eager_transform():
+        t = table
+        for nid in (sc, pca, km):
+            model = g.nodes[nid].outputs["model"]
+            t = model.transform(t)
+        return t
+
+    eager_transform()  # warm
+    t0 = time.perf_counter()
+    out_e2 = eager_transform()
+    jax.block_until_ready(out_e2.X)
+    wall_eager_tr = time.perf_counter() - t0
+
+    np.testing.assert_allclose(
+        np.asarray(out_staged.X[:1024]), np.asarray(out_e2.X[:1024]),
+        rtol=1e-4, atol=1e-4,
+    )
+    return {
+        "metric": "taxi_kmeans_pca_pipeline", "unit": "s",
+        "value": round(wall_staged, 3), "vs_baseline": None,
+        "rows": n_rows,
+        "workflow_fit_s": round(wall_fit_eager, 2),
+        "transform_eager_s": round(wall_eager_tr, 3),
+        "transform_staged_s": round(wall_staged, 3),
+        "staged_speedup": round(wall_eager_tr / max(wall_staged, 1e-9), 2),
+        "staged_rows_per_sec_per_chip": round(
+            n_rows / wall_staged / session.n_devices, 1
+        ),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="all", choices=["3", "4", "5", "all"])
+    ap.add_argument("--rows-scale", type=float, default=1.0)
+    args = ap.parse_args()
+    benches = {"3": bench_higgs_trees, "4": bench_movielens_als,
+               "5": bench_taxi_pipeline}
+    keys = ["3", "4", "5"] if args.config == "all" else [args.config]
+    for k in keys:
+        print(json.dumps(benches[k](args.rows_scale)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

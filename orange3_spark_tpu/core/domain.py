@@ -28,6 +28,14 @@ class Variable:
     def __hash__(self) -> int:
         return hash((type(self).__name__, self.name))
 
+    def renamed(self, name: str) -> "Variable":
+        """Copy with a different name (used by column-merge suffixing)."""
+        import copy
+
+        out = copy.copy(self)
+        out.name = str(name)
+        return out
+
     @property
     def is_continuous(self) -> bool:
         return isinstance(self, ContinuousVariable)

@@ -343,6 +343,8 @@ class StreamingKMeans(Estimator):
                     centers, counts, Xd, wd, decay, k=p.k
                 )
                 n_steps += 1
+                if (n_steps & 15) == 0:
+                    jax.block_until_ready(cost)  # bound the dispatch queue
         if centers is None:
             raise ValueError("stream produced no live rows")
         model = KMeansModel(KMeansParams(k=p.k), centers)
@@ -449,6 +451,8 @@ class StreamingLinearEstimator(Estimator):
                 )
                 n_steps += 1
                 last_loss = loss
+                if (n_steps & 15) == 0:
+                    jax.block_until_ready(loss)  # bound the dispatch queue
                 if checkpointer is not None:
                     checkpointer.maybe_save(
                         n_steps, {"theta": theta, "opt_state": opt_state},

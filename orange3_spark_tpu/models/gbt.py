@@ -96,11 +96,21 @@ def _boost(B, edges, W, y, depth, n_bins, p: GBTParams, loss: str):
     F = jnp.full((N,), f0)
 
     trees = []
-    for _ in range(p.max_iter):
+    for r in range(p.max_iter):
         key, sub = jax.random.split(key)
         F, tree = _gbt_round(F, B, edges, W, y, sub, p=p, loss=loss,
                              depth=depth, n_bins=n_bins)
         trees.append(tree)
+        if (r & 3) == 3:
+            # bound the async dispatch queue. An unthrottled 40-round loop
+            # piles up 40 multi-device programs x n_devices rendezvous on the
+            # XLA:CPU in-process collective runtime, which (observed on
+            # oversubscribed 1-core hosts, 8 fake devices) can wedge a
+            # rendezvous and hang/abort the process at the eager stack
+            # below. Four in flight keeps real-TPU pipelining; dependency
+            # order makes the sync free beyond dispatch latency.
+            jax.block_until_ready(F)
+    jax.block_until_ready(trees)
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
     return float(f0), stacked
 

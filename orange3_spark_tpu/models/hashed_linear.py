@@ -314,9 +314,13 @@ class HashedLinearModel(Model):
             "logloss": float(loss_sum / max(wsum, 1e-12)),
             "accuracy": float(correct / max(wsum, 1e-12)),
         }
-        auc = _auc_from_hists(np.asarray(pos), np.asarray(neg))
-        if auc is not None:
-            out["auc"] = auc
+        # AUC only for probability-calibrated scores (matching
+        # evaluate_stream): margin losses produce unbounded scores whose
+        # [0,1]-binned histogram would mass-tie at the edge bins
+        if kind in ("binary_logistic", "logistic"):
+            auc = _auc_from_hists(np.asarray(pos), np.asarray(neg))
+            if auc is not None:
+                out["auc"] = auc
         return out
 
 
@@ -502,6 +506,12 @@ class StreamingHashedLinearEstimator(Estimator):
             )
             n_steps += 1
             last_loss = loss
+            if (n_steps & 15) == 0:
+                # bound the async dispatch queue (see models/gbt.py _boost:
+                # unthrottled multi-device dispatch loops can wedge XLA:CPU's
+                # in-process rendezvous on oversubscribed hosts); every 16
+                # steps costs one dispatch latency, invisible at step scale
+                jax.block_until_ready(loss)
             if checkpointer is not None:
                 checkpointer.maybe_save(
                     n_steps, {"theta": theta, "opt_state": opt_state},

@@ -192,6 +192,39 @@ def join(left: TpuTable, right: TpuTable, on: str, how: str = "left") -> TpuTabl
     return out
 
 
+def merge_columns(left: TpuTable, right: TpuTable, *,
+                  suffix: str = "_r") -> TpuTable:
+    """Row-aligned column merge (Orange's 'Merge Data' by position; Spark's
+    two-branch pipeline re-join). DEVICE-PURE — one concat, no host hop — so
+    branching workflow DAGs that fan out and re-merge stage into a single
+    XLA computation (workflow/staging.py).
+
+    Both tables must have the same (padded) row count; weights intersect
+    (a row dead on either side is dead in the merge). Right-side attribute
+    names clashing with left get ``suffix`` appended. Keeps left's class
+    vars and metas."""
+    if left.X.shape[0] != right.X.shape[0]:
+        raise ValueError(
+            f"merge_columns needs row-aligned tables, got {left.X.shape[0]} "
+            f"vs {right.X.shape[0]} padded rows"
+        )
+    taken = {v.name for v in left.domain.attributes}
+    rattrs = []
+    for v in right.domain.attributes:
+        name = v.name
+        while name in taken:     # suffix until unique ('a_r' may exist too)
+            name += suffix
+        taken.add(name)
+        rattrs.append(v if name == v.name else v.renamed(name))
+    domain = Domain(
+        list(left.domain.attributes) + rattrs,
+        left.domain.class_vars, left.domain.metas,
+    )
+    X = jnp.concatenate([left.X, right.X], axis=1)
+    W = jnp.minimum(left.W, right.W)
+    return TpuTable(domain, X, left.Y, W, left.metas, left.n_rows, left.session)
+
+
 def sort(table: TpuTable, by: str, ascending: bool = True) -> TpuTable:
     """Full device sort of all rows by one column (df.orderBy).
 

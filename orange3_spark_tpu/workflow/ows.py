@@ -53,7 +53,7 @@ _CHANNEL_MAP = {
     "data": "data", "preprocesseddata": "data", "sampledata": "data",
     "table": "data", "dataframe": "data",
     "model": "model", "learner": "model", "classifier": "model",
-    "predictor": "model", "transformer": "model",
+    "predictor": "model", "predictors": "model", "transformer": "model",
     "evaluationresults": "score",
 }
 

@@ -5,21 +5,22 @@ plain pytest without a TPU — the analogue of PySpark's local[N] test master
 import os
 import sys
 
-# Root cause of the round-2 "pytest -q SIGABRT at dot 243": XLA:CPU
-# TERMINATES the process (abort from a non-Python worker thread; the C++
-# message dies in pytest's fd-level capture) when an 8-participant collective
-# rendezvous stays stuck past xla_cpu_collective_call_terminate_timeout_seconds.
-# On a 1-core host running concurrent jobs, the 8 fake devices time-slice one
-# core and a psum under the suite's heaviest compile pressure (late
-# test_trees) can legitimately take minutes. Raise the stuck/terminate
-# timeouts so slow-but-progressing collectives warn instead of killing the
-# run.
+# Round-2 "pytest -q SIGABRT at dot 243", root-caused in round 3: XLA:CPU's
+# in-process collective runtime can wedge a multi-device rendezvous when an
+# unthrottled dispatch loop piles dozens of 8-participant programs onto an
+# oversubscribed 1-core host (reproduced at test_gbt_regressor's 40-round
+# loop; abort arrives from a non-Python worker thread and the C++ message
+# dies in pytest's fd-level capture). Two-part fix: the dispatch loops bound
+# their in-flight depth (models/gbt.py _boost), and the stuck/terminate
+# timeouts here give slow-but-progressing collectives minutes instead of the
+# default seconds — while still ABORTING (visibly) on a genuine deadlock
+# rather than hanging CI forever.
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
     + " --xla_force_host_platform_device_count=8"
     + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-    + " --xla_cpu_collective_call_terminate_timeout_seconds=3000"
-    + " --xla_cpu_collective_timeout_seconds=3000"
+    + " --xla_cpu_collective_call_terminate_timeout_seconds=900"
+    + " --xla_cpu_collective_timeout_seconds=900"
 )
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

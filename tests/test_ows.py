@@ -90,3 +90,105 @@ def test_ows_roundtrip_runs(session, tmp_path, iris):
     g2 = read_ows(out_path)
     assert len(g2.nodes) == 3 and len(g2.edges) == 2
     assert g2.nodes[0].widget.params.path == str(data_csv)
+
+
+# A canvas-SAVED scheme as Orange actually writes it: session_state +
+# window_presets cruft, pickle-format properties (unreadable without Qt —
+# must be skipped, not crash), literal properties polluted with GUI keys
+# (savedWidgetGeometry, controlAreaVisible, __version__), a Distances
+# widget we have no equivalent for, and canvas channel names with spaces.
+CANVAS_OWS = """<?xml version='1.0' encoding='utf-8'?>
+<scheme version="2.0" title="CTR pipeline" description="built in canvas">
+  <nodes>
+    <node id="0" name="File" qualified_name="Orange.widgets.data.owfile.OWFile"
+          project_name="Orange3" version="" title="File" position="(90, 160)" />
+    <node id="1" name="Spark Context"
+          qualified_name="orangecontrib.spark.widgets.ow_spark_context.OWSparkContext"
+          project_name="Orange3-Spark" version="0.1" title="Spark Context"
+          position="(95, 320)" />
+    <node id="2" name="Spark Standard Scaler"
+          qualified_name="orangecontrib.spark.widgets.ow_standard_scaler.OWSparkStandardScaler"
+          project_name="Orange3-Spark" version="0.1" title="Standard Scaler"
+          position="(240, 160)" />
+    <node id="3" name="Spark Logistic Regression"
+          qualified_name="orangecontrib.spark.widgets.ow_logistic_regression.OWSparkLogisticRegression"
+          project_name="Orange3-Spark" version="0.1" title="Logistic Regression"
+          position="(400, 160)" />
+    <node id="4" name="Distances"
+          qualified_name="Orange.widgets.unsupervised.owdistances.OWDistances"
+          project_name="Orange3" version="" title="Distances" position="(400, 330)" />
+    <node id="5" name="Predictions"
+          qualified_name="Orange.widgets.evaluate.owpredictions.OWPredictions"
+          project_name="Orange3" version="" title="Predictions" position="(560, 160)" />
+  </nodes>
+  <links>
+    <link id="0" source_node_id="0" sink_node_id="2"
+          source_channel="Data" sink_channel="Data" enabled="true" />
+    <link id="1" source_node_id="2" sink_node_id="3"
+          source_channel="Data" sink_channel="Data" enabled="true" />
+    <link id="2" source_node_id="3" sink_node_id="5"
+          source_channel="Model" sink_channel="Predictors" enabled="true" />
+    <link id="3" source_node_id="2" sink_node_id="5"
+          source_channel="Data" sink_channel="Data" enabled="true" />
+    <link id="4" source_node_id="2" sink_node_id="4"
+          source_channel="Data" sink_channel="Data" enabled="true" />
+  </links>
+  <annotations>
+    <text id="0" type="text/plain" rect="(37.0, 29.0, 150.0, 50.0)"
+          font-family="Sans" font-size="16">train CTR model</text>
+    <arrow id="1" start="(120.0, 90.0)" end="(120.0, 130.0)"
+           fill="#C1272D" />
+  </annotations>
+  <thumbnail />
+  <node_properties>
+    <properties node_id="0" format="pickle">gASVKgAAAAAAAAB9lIwJc2F2ZWRf</properties>
+    <properties node_id="2" format="literal">{'with_mean': False,
+      'savedWidgetGeometry': None, 'controlAreaVisible': True,
+      '__version__': 1}</properties>
+    <properties node_id="3" format="literal">{'max_iter': 77,
+      'reg_param': 0.5, 'auto_apply': True, '__version__': 2,
+      'savedWidgetGeometry': b'\\x01\\xd9\\xd0\\xcb'}</properties>
+  </node_properties>
+  <session_state>
+    <window_groups />
+  </session_state>
+</scheme>
+"""
+
+
+def test_read_canvas_saved_ows(session, tmp_path):
+    """A scheme with real canvas structure (pickle props, GUI cruft keys,
+    spaces in channel names, annotations, an unmappable widget) imports:
+    strict=True names the unmappable widget; strict=False imports the rest,
+    applies only Params-field settings, and reports every drop."""
+    p = tmp_path / "canvas.ows"
+    p.write_text(CANVAS_OWS)
+
+    with pytest.raises(ValueError, match="Distances"):
+        read_ows(str(p))
+
+    g = read_ows(str(p), strict=False)
+    by_name = {}
+    for nid, node in g.nodes.items():
+        by_name.setdefault(node.widget.name, nid)
+    # the mappable five imported, Distances skipped and reported
+    assert set(by_name) == {"OWCsvReader", "OWTpuContext",
+                            "OWStandardScaler", "OWLogisticRegression",
+                            "OWApplyModel"}
+    assert any("Distances" in s for s in g.import_report)
+    assert any("link" in s for s in g.import_report)  # its link dropped too
+
+    # literal settings applied, GUI cruft filtered, pickle skipped silently
+    lr = g.nodes[by_name["OWLogisticRegression"]].widget
+    assert lr.params.max_iter == 77
+    assert lr.params.reg_param == 0.5
+    sc = g.nodes[by_name["OWStandardScaler"]].widget
+    assert sc.params.with_mean is False
+
+    # canvas channel names (Data/Model/Predictors) mapped onto our ports
+    ports = {(e.src, e.src_port, e.dst, e.dst_port) for e in g.edges}
+    lrid, apid = by_name["OWLogisticRegression"], by_name["OWApplyModel"]
+    assert (lrid, "model", apid, "model") in ports
+    scid = by_name["OWStandardScaler"]
+    assert (scid, "data", lrid, "data") in ports
+    assert (scid, "data", apid, "data") in ports

@@ -206,3 +206,88 @@ def test_csv_bad_class_col_errors(session, tmp_path):
 
     with pytest.raises(ValueError, match="not found"):
         read_csv(str(csv), class_col="lable")
+
+
+def test_staged_dag_branches_merge_one_program(session):
+    """VERDICT r2 #6 done-when: reader -> scaler -> {logreg, pca} -> merge
+    lowers to ONE jitted function matching eager output. Exercises branching
+    (scaler fans out), multi-input staging (OWMergeColumns), fitted-state
+    closure (logreg + pca), and the explicit frontier (the source)."""
+    from orange3_spark_tpu.workflow.staging import stage_graph
+
+    iris = load_iris(session)
+    g = WorkflowGraph()
+    src = g.add(OWTable(iris))
+    sc = g.add(WIDGET_REGISTRY["OWStandardScaler"](with_mean=True))
+    lr = g.add(WIDGET_REGISTRY["OWLogisticRegression"](max_iter=100))
+    pca = g.add(WIDGET_REGISTRY["OWPCA"](k=2))
+    merge = g.add(WIDGET_REGISTRY["OWMergeColumns"]())
+    g.connect(src, "data", sc, "data")
+    g.connect(sc, "data", lr, "data")
+    g.connect(sc, "data", pca, "data")
+    g.connect(lr, "data", merge, "left")
+    g.connect(pca, "data", merge, "right")
+
+    eager = g.run()[merge]["data"]
+    staged = stage_graph(g, merge)
+
+    # the fused program's only argument is the source table
+    assert staged.input_keys == [(src, "data")]
+    assert [f["widget"] for f in staged.frontier] == ["OWTable"]
+
+    out = staged()
+    np.testing.assert_allclose(
+        np.asarray(out.X), np.asarray(eager.X), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_array_equal(np.asarray(out.W), np.asarray(eager.W))
+    assert out.domain == eager.domain
+
+    # ONE XLA computation
+    hlo = staged.lower_text()
+    assert hlo.count("module @") == 1
+
+    # reusable on fresh data through the same compiled program
+    fresh = load_iris(session)
+    out2 = staged({src: fresh})
+    np.testing.assert_allclose(
+        np.asarray(out2.X), np.asarray(eager.X), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_staged_dag_apply_model_and_frontier(session):
+    """ApplyModel nodes stage with their model closed over; a host-side
+    widget (OWDataInfo) upstream terminates staging with a reported reason."""
+    from orange3_spark_tpu.workflow.staging import stage_graph
+
+    t = make_classification(512, 6, n_classes=2, seed=21, session=session)
+    g = WorkflowGraph()
+    src = g.add(OWTable(t))
+    lr = g.add(WIDGET_REGISTRY["OWLogisticRegression"](max_iter=50))
+    ap = g.add(OWApplyModel())
+    g.connect(src, "data", lr, "data")
+    g.connect(src, "data", ap, "data")
+    g.connect(lr, "model", ap, "model")
+
+    eager = g.run()[ap]["data"]
+    staged = stage_graph(g, ap)
+    np.testing.assert_allclose(
+        np.asarray(staged().X), np.asarray(eager.X), rtol=1e-5, atol=1e-6
+    )
+
+    # a non-stageable sink is rejected with the reason
+    info = g.add(WIDGET_REGISTRY["OWDataInfo"]())
+    g.connect(ap, "data", info, "data")
+    with pytest.raises(ValueError, match="not stageable"):
+        stage_graph(g, info)
+
+
+def test_merge_columns_device_pure(session):
+    """merge_columns: row-aligned concat, weight intersection, name suffixing."""
+    from orange3_spark_tpu.ops.relational import merge_columns
+
+    t = load_iris(session)
+    m = merge_columns(t, t)
+    assert m.n_attrs == 2 * t.n_attrs
+    names = [v.name for v in m.domain.attributes]
+    assert len(set(names)) == len(names)      # suffixed, no clashes
+    np.testing.assert_array_equal(np.asarray(m.W), np.asarray(t.W))
