@@ -39,6 +39,7 @@ import optax
 
 from orange3_spark_tpu.core.session import TpuSession
 from orange3_spark_tpu.io.multihost import put_sharded
+from orange3_spark_tpu.utils.dispatch import bound_dispatch
 from orange3_spark_tpu.models.base import Estimator, Params
 
 # (X [n,d], y [n] or None) or (X, y, w) — sources may carry row weights
@@ -343,8 +344,7 @@ class StreamingKMeans(Estimator):
                     centers, counts, Xd, wd, decay, k=p.k
                 )
                 n_steps += 1
-                if (n_steps & 15) == 0:
-                    jax.block_until_ready(cost)  # bound the dispatch queue
+                bound_dispatch(n_steps, cost)  # utils/dispatch.py: queue cap
         if centers is None:
             raise ValueError("stream produced no live rows")
         model = KMeansModel(KMeansParams(k=p.k), centers)
@@ -451,8 +451,7 @@ class StreamingLinearEstimator(Estimator):
                 )
                 n_steps += 1
                 last_loss = loss
-                if (n_steps & 15) == 0:
-                    jax.block_until_ready(loss)  # bound the dispatch queue
+                bound_dispatch(n_steps, loss)  # utils/dispatch.py: queue cap
                 if checkpointer is not None:
                     checkpointer.maybe_save(
                         n_steps, {"theta": theta, "opt_state": opt_state},

@@ -29,6 +29,7 @@ from orange3_spark_tpu.models._tree import (
     tree_apply,
 )
 from orange3_spark_tpu.models.base import Estimator, Model, Params
+from orange3_spark_tpu.utils.dispatch import bound_dispatch
 
 EPS = 1e-12
 
@@ -101,15 +102,10 @@ def _boost(B, edges, W, y, depth, n_bins, p: GBTParams, loss: str):
         F, tree = _gbt_round(F, B, edges, W, y, sub, p=p, loss=loss,
                              depth=depth, n_bins=n_bins)
         trees.append(tree)
-        if (r & 3) == 3:
-            # bound the async dispatch queue. An unthrottled 40-round loop
-            # piles up 40 multi-device programs x n_devices rendezvous on the
-            # XLA:CPU in-process collective runtime, which (observed on
-            # oversubscribed 1-core hosts, 8 fake devices) can wedge a
-            # rendezvous and hang/abort the process at the eager stack
-            # below. Four in flight keeps real-TPU pipelining; dependency
-            # order makes the sync free beyond dispatch latency.
-            jax.block_until_ready(F)
+        # rounds are heavyweight: keep at most 4 in flight
+        # (utils/dispatch.py has the full story on the XLA:CPU rendezvous
+        # wedge this prevents)
+        bound_dispatch(r + 1, F, period=4)
     jax.block_until_ready(trees)
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
     return float(f0), stacked

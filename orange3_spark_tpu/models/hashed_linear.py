@@ -56,6 +56,7 @@ from orange3_spark_tpu.io.multihost import put_sharded
 from orange3_spark_tpu.models._linear import EPS_TOTAL_WEIGHT, per_row_loss
 from orange3_spark_tpu.models.base import Estimator, Model, Params
 from orange3_spark_tpu.ops.hashing import column_salts, hash_columns
+from orange3_spark_tpu.utils.dispatch import bound_dispatch
 
 # unit-lr adam; the traced lr scales its updates (see io/streaming.py)
 _ADAM_UNIT = optax.adam(1.0)
@@ -506,12 +507,7 @@ class StreamingHashedLinearEstimator(Estimator):
             )
             n_steps += 1
             last_loss = loss
-            if (n_steps & 15) == 0:
-                # bound the async dispatch queue (see models/gbt.py _boost:
-                # unthrottled multi-device dispatch loops can wedge XLA:CPU's
-                # in-process rendezvous on oversubscribed hosts); every 16
-                # steps costs one dispatch latency, invisible at step scale
-                jax.block_until_ready(loss)
+            bound_dispatch(n_steps, loss)  # utils/dispatch.py: queue cap
             if checkpointer is not None:
                 checkpointer.maybe_save(
                     n_steps, {"theta": theta, "opt_state": opt_state},

@@ -1,0 +1,25 @@
+"""Dispatch-queue bounding for Python-level step loops.
+
+JAX dispatch is async: a Python loop that fires one multi-device program per
+iteration can pile dozens of in-flight executions (each an n-participant
+rendezvous) onto the runtime. XLA:CPU's in-process collective runtime has
+been observed to wedge a rendezvous under that pressure on oversubscribed
+hosts (root-caused in round 3 at GBT's 40-round boosting loop: hang or
+SIGABRT at suite scale). Every sequential step loop therefore calls
+``bound_dispatch`` — one synchronization per ``period`` steps costs a single
+dispatch latency (the steps are data-dependent anyway) and caps the queue.
+"""
+
+from __future__ import annotations
+
+import jax
+
+#: steps between synchronizations; small enough to cap rendezvous pressure,
+#: large enough that the sync cost vanishes against real step times
+DISPATCH_SYNC_PERIOD = 16
+
+
+def bound_dispatch(step: int, token, period: int = DISPATCH_SYNC_PERIOD) -> None:
+    """Block on ``token`` every ``period``-th ``step`` (1-based count)."""
+    if step % period == 0:
+        jax.block_until_ready(token)

@@ -166,7 +166,18 @@ class StagedGraph:
         for boundary input nodes (same domains/shapes — the compiled program
         is reused)."""
         X, Y, W = self._jitted(*self._flat_args(replacements))
-        metas, n_rows = self._out_meta
+        if replacements:
+            # every staged widget is row-preserving, so the output's LOGICAL
+            # row count follows the (row-aligned) inputs of THIS call — the
+            # eager run's n_rows/metas would mislabel padding as live rows
+            n_rows = min(
+                (replacements.get(k[0], self.templates[k]).n_rows
+                 for k in self.input_keys),
+                default=self._out_meta[1],
+            )
+            metas = None  # host-side metas do not flow through the device path
+        else:
+            metas, n_rows = self._out_meta
         return TpuTable(self.out_domain, X, Y, W, metas, n_rows, self.session)
 
     def lower_text(self) -> str:
