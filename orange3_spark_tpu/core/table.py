@@ -197,6 +197,45 @@ class TpuTable:
         W = jnp.where(mask.astype(bool), self.W, 0.0)
         return TpuTable(self.domain, self.X, self.Y, W, self.metas, self.n_rows, self.session)
 
+    # Spark spells DataFrame.filter as where() too
+    def where(self, predicate) -> "TpuTable":
+        return self.filter(predicate)
+
+    def fillna(self, value) -> "TpuTable":
+        """Replace NaNs (DataFrame.fillna / na.fill): a float fills every
+        attribute column; a {column_name: float} dict fills per column.
+        Device-pure (one where per filled column)."""
+        if isinstance(value, dict):
+            X = self.X
+            for name, v in value.items():
+                try:
+                    j = self.domain.index(self.domain[name])
+                except (KeyError, ValueError) as e:
+                    raise ValueError(f"fillna: unknown column {name!r}") from e
+                col = jnp.where(jnp.isnan(X[:, j]), jnp.float32(v), X[:, j])
+                X = X.at[:, j].set(col)
+            return self.with_X(X)
+        X = jnp.where(jnp.isnan(self.X), jnp.float32(value), self.X)
+        return self.with_X(X)
+
+    def dropna(self, subset: Sequence[str] | None = None) -> "TpuTable":
+        """Drop rows with NaNs (DataFrame.dropna / na.drop): weight-zeroes
+        them under the static-shape rule, like filter()."""
+        if subset is None:
+            bad = jnp.any(jnp.isnan(self.X), axis=1)
+            if self.Y is not None:
+                bad = bad | jnp.any(jnp.isnan(self.Y), axis=1)
+        else:
+            bad = jnp.zeros((self.n_pad,), bool)
+            for name in subset:
+                try:
+                    bad = bad | jnp.isnan(self.column(name))  # attr OR class
+                except (KeyError, ValueError) as e:
+                    raise ValueError(
+                        f"dropna: unknown column {name!r}"
+                    ) from e
+        return self.with_weights(jnp.where(bad, 0.0, self.W))
+
     def with_weights(self, W) -> "TpuTable":
         return TpuTable(self.domain, self.X, self.Y, W, self.metas, self.n_rows, self.session)
 

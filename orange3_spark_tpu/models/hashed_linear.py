@@ -78,6 +78,7 @@ class HashedLinearParams(Params):
     compute_dtype: str = "float32"
     label_in_chunk: bool = False  # chunks carry the label as column 0
     prefetch_depth: int = 2       # host->device pipeline depth (0 disables)
+    per_column_update: bool = False  # C independent scatters vs one fused
 
 
 def _effective_k(p: HashedLinearParams) -> int:
@@ -94,9 +95,19 @@ def _row_loss_kind(p: HashedLinearParams) -> str:
     return p.loss
 
 
-def _hashed_logits(theta, dense, idx, compute_dtype):
-    emb_rows = jnp.take(theta["emb"].astype(compute_dtype), idx, axis=0)
-    logits = jnp.sum(emb_rows, axis=1, dtype=jnp.float32)       # [N, k]
+def _hashed_logits(theta, dense, idx, compute_dtype, per_column: bool = False):
+    """per_column: express the embedding lookup as C independent [N]-gathers
+    (autodiff then emits C independent [N]-scatters) instead of one fused
+    [N, C] gather/scatter — an A/B lever for the scatter-bound step; both
+    formulations are numerically identical."""
+    emb = theta["emb"].astype(compute_dtype)
+    if per_column:
+        logits = jnp.zeros((idx.shape[0], emb.shape[1]), jnp.float32)
+        for c in range(idx.shape[1]):
+            logits = logits + jnp.take(emb, idx[:, c], axis=0)
+    else:
+        emb_rows = jnp.take(emb, idx, axis=0)
+        logits = jnp.sum(emb_rows, axis=1, dtype=jnp.float32)    # [N, k]
     if theta["coef"].shape[0]:
         logits = logits + jnp.dot(
             dense.astype(compute_dtype),
@@ -127,13 +138,14 @@ def _split_chunk(Xall, n_valid, y, w, *, label_in_chunk: bool, n_dense: int):
     jax.jit,
     static_argnames=(
         "loss_kind", "n_dims", "n_dense", "compute_dtype", "label_in_chunk",
+        "per_column",
     ),
     donate_argnums=(0, 1),
 )
 def _hashed_step(
     theta, opt_state, Xall, n_valid, y, w, salts, reg, lr,
     *, loss_kind: str, n_dims: int, n_dense: int, compute_dtype=jnp.float32,
-    label_in_chunk: bool = False,
+    label_in_chunk: bool = False, per_column: bool = False,
 ):
     yv, dense, cats, wv = _split_chunk(
         Xall, n_valid, y, w, label_in_chunk=label_in_chunk, n_dense=n_dense
@@ -141,7 +153,7 @@ def _hashed_step(
     idx = hash_columns(cats, salts, n_dims)
 
     def loss_fn(theta):
-        logits = _hashed_logits(theta, dense, idx, compute_dtype)
+        logits = _hashed_logits(theta, dense, idx, compute_dtype, per_column)
         row = per_row_loss(loss_kind, logits, yv)
         sw = jnp.maximum(jnp.sum(wv), EPS_TOTAL_WEIGHT)
         data = jnp.sum(row * wv) / sw
@@ -504,6 +516,7 @@ class StreamingHashedLinearEstimator(Estimator):
                 theta, opt_state, Xd, n_valid, yd, wd, salts, reg, lr,
                 loss_kind=loss_kind, n_dims=p.n_dims, n_dense=p.n_dense,
                 compute_dtype=compute_dtype, label_in_chunk=p.label_in_chunk,
+                per_column=p.per_column_update,
             )
             n_steps += 1
             last_loss = loss

@@ -1,0 +1,199 @@
+"""RFormula — parity with ``pyspark.ml.feature.RFormula``.
+
+MLlib's RFormula compiles an R-style model formula into a feature/label
+preparation pipeline on the JVM (SURVEY.md §2b "Feature transformers";
+reconstructed, mount empty). Supported formula surface (the same subset
+MLlib documents): ``~``, ``+``, ``-`` (term removal, ``- 1`` drops the
+intercept flag), ``.`` (all non-label columns), ``:`` (interaction).
+
+TPU-native redesign: fit compiles the formula against the table's Domain
+into a static column PLAN (indices, one-hot widths, interaction products);
+transform executes the plan as pure jnp gathers/one-hots/products — a
+device-only re-layout that fuses into whatever model consumes it (and
+stages into whole-workflow XLA programs like every other transformer).
+Categorical terms expand to reference-level dummy columns — the FIRST level
+is dropped, R's default treatment contrasts (MLlib instead drops the last
+frequency-ordered index; same rank, different reference level). With
+``- 1`` (no intercept) the first categorical main-effect term is full-coded,
+as in R. Interactions multiply the encoded blocks columnwise. The label
+moves to the table's class variable, as MLlib moves it to ``labelCol``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orange3_spark_tpu.core.domain import (
+    ContinuousVariable,
+    DiscreteVariable,
+    Domain,
+)
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models.base import Estimator, Model, Params
+
+
+@dataclasses.dataclass(frozen=True)
+class RFormulaParams(Params):
+    formula: str = ""
+
+
+def _parse(formula: str):
+    """-> (label, included term tuples, excluded term tuples, intercept)."""
+    if "~" not in formula:
+        raise ValueError(f"formula needs '~': {formula!r}")
+    lhs, rhs = formula.split("~", 1)
+    label = lhs.strip()
+    if not label:
+        raise ValueError("formula needs a label on the left of '~'")
+    include, exclude, intercept = [], [], True
+    # '+' separates terms; a '-' flips the following terms to removals
+    for signed in rhs.replace("-", "+-").split("+"):
+        t = signed.strip()
+        if not t:
+            continue
+        neg = t.startswith("-")
+        t = t.lstrip("-").strip()
+        if t == "1":
+            if neg:
+                intercept = False
+            continue
+        factors = tuple(f.strip() for f in t.split(":") if f.strip())
+        if not factors:
+            continue
+        (exclude if neg else include).append(factors)
+    return label, include, exclude, intercept
+
+
+class RFormulaModel(Model):
+    def __init__(self, params, plan, out_domain, label_var, label_src):
+        self.params = params
+        self.plan = plan            # [(name, [(col_idx, n_onehot|0), ...])]
+        self.out_domain = out_domain
+        self.label_var = label_var
+        self.label_src = label_src  # ('attr', j) | ('class', j)
+        self.has_intercept = True   # '- 1' in the formula flips this
+
+    @property
+    def state_pytree(self):
+        return {}
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        X = table.X
+        blocks = []
+        for _, factors in self.plan:
+            encoded = []
+            for j, width in factors:
+                col = X[:, j]
+                if width < 0:    # full coding (no-intercept first factor)
+                    encoded.append(
+                        jax.nn.one_hot(col.astype(jnp.int32), -width,
+                                       dtype=jnp.float32)
+                    )
+                elif width:
+                    encoded.append(
+                        jax.nn.one_hot(col.astype(jnp.int32), width + 1,
+                                       dtype=jnp.float32)[:, 1:]
+                    )  # drop the FIRST level: R treatment contrasts
+                else:
+                    encoded.append(col[:, None])
+            block = encoded[0]
+            for nxt in encoded[1:]:
+                # interaction: columnwise cross product of the blocks
+                block = (block[:, :, None] * nxt[:, None, :]).reshape(
+                    block.shape[0], -1
+                )
+            blocks.append(block)
+        feats = (jnp.concatenate(blocks, axis=1) if blocks
+                 else jnp.zeros((X.shape[0], 0), jnp.float32))
+        kind, j = self.label_src
+        ycol = table.Y[:, j] if kind == "class" else X[:, j]
+        return TpuTable(
+            self.out_domain, feats, ycol[:, None], table.W, table.metas,
+            table.n_rows, table.session,
+        )
+
+
+class RFormula(Estimator):
+    ParamsCls = RFormulaParams
+    params: RFormulaParams
+
+    def _fit(self, table: TpuTable) -> RFormulaModel:
+        label, include, exclude, intercept = _parse(self.params.formula)
+        domain = table.domain
+        attr_names = [v.name for v in domain.attributes]
+        class_names = [v.name for v in domain.class_vars]
+        if label in attr_names:
+            label_src = ("attr", attr_names.index(label))
+            label_var = domain.attributes[label_src[1]]
+        elif label in class_names:
+            label_src = ("class", class_names.index(label))
+            label_var = domain.class_vars[label_src[1]]
+        else:
+            raise ValueError(f"label {label!r} not in table columns")
+
+        # '.' expands to every attribute except the label, in domain order
+        expanded: list[tuple[str, ...]] = []
+        for t in include:
+            if t == (".",):
+                expanded.extend(
+                    (n,) for n in attr_names if n != label
+                )
+            else:
+                expanded.append(t)
+        for t in exclude:
+            for f in t:
+                if f not in attr_names:
+                    raise ValueError(
+                        f"unknown column {f!r} in formula exclusion"
+                    )
+        removed = {t for t in exclude}
+        terms = [t for t in expanded if t not in removed]
+        # dedupe, preserving first occurrence (R keeps term order)
+        seen: set = set()
+        terms = [t for t in terms if not (t in seen or seen.add(t))]
+        if not terms:
+            raise ValueError(f"formula {self.params.formula!r} selects no terms")
+
+        plan = []
+        out_vars: list[ContinuousVariable] = []
+        # R rule: without an intercept, the FIRST categorical main effect is
+        # full-coded (all k levels) so the column space still spans the mean
+        full_code_budget = 0 if intercept else 1
+        for t in terms:
+            factors = []
+            factor_names: list[list[str]] = []
+            for f in t:
+                if f == label:
+                    raise ValueError(f"label {label!r} cannot be a feature term")
+                if f not in attr_names:
+                    raise ValueError(f"unknown column {f!r} in formula")
+                j = attr_names.index(f)
+                var = domain.attributes[j]
+                if isinstance(var, DiscreteVariable) and var.values:
+                    k = len(var.values)
+                    if len(t) == 1 and full_code_budget:
+                        full_code_budget = 0
+                        factors.append((j, -k))       # full coding marker
+                        factor_names.append(
+                            [f"{f}_{v}" for v in var.values]
+                        )
+                    else:
+                        factors.append((j, k - 1))
+                        factor_names.append(
+                            [f"{f}_{v}" for v in var.values[1:]]
+                        )
+                else:
+                    factors.append((j, 0))
+                    factor_names.append([f])
+            plan.append((":".join(t), factors))
+            for combo in itertools.product(*factor_names):
+                out_vars.append(ContinuousVariable(":".join(combo)))
+        out_domain = Domain(out_vars, label_var)
+        model = RFormulaModel(self.params, plan, out_domain, label_var, label_src)
+        model.has_intercept = intercept
+        return model

@@ -1,0 +1,109 @@
+"""RFormula (pyspark.ml.feature.RFormula parity): formula compilation to a
+static device plan — terms, '.', exclusions, interactions, reference-coded
+categoricals, label relocation."""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.core.domain import (
+    ContinuousVariable,
+    DiscreteVariable,
+    Domain,
+)
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models.rformula import RFormula
+
+
+@pytest.fixture()
+def table(session):
+    rng = np.random.default_rng(0)
+    n = 64
+    x1 = rng.standard_normal(n).astype(np.float32)
+    x2 = rng.standard_normal(n).astype(np.float32)
+    cat = rng.integers(0, 3, n).astype(np.float32)      # values a/b/c
+    y = (x1 + cat > 0.5).astype(np.float32)
+    dom = Domain([
+        ContinuousVariable("x1"), ContinuousVariable("x2"),
+        DiscreteVariable("cat", ("a", "b", "c")),
+        ContinuousVariable("y"),
+    ])
+    X = np.stack([x1, x2, cat, y], axis=1)
+    return TpuTable.from_numpy(dom, X, session=session), x1, x2, cat, y
+
+
+def test_rformula_basic_terms_and_label(table):
+    t, x1, x2, cat, y = table
+    m = RFormula(formula="y ~ x1 + x2").fit(t)
+    out = m.transform(t)
+    assert [v.name for v in out.domain.attributes] == ["x1", "x2"]
+    assert out.domain.class_var.name == "y"
+    np.testing.assert_allclose(np.asarray(out.X[:, 0])[:64], x1, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out.Y[:, 0])[:64], y, rtol=1e-6)
+
+
+def test_rformula_dot_and_exclusion(table):
+    t, *_ = table
+    m = RFormula(formula="y ~ . - x2").fit(t)
+    out = m.transform(t)
+    names = [v.name for v in out.domain.attributes]
+    assert names == ["x1", "cat_b", "cat_c"]   # '.' minus label minus x2
+    assert m.has_intercept
+    m2 = RFormula(formula="y ~ . - 1").fit(t)
+    assert m2.has_intercept is False
+
+
+def test_rformula_categorical_reference_coding(table):
+    t, x1, x2, cat, y = table
+    out = RFormula(formula="y ~ cat").fit(t).transform(t)
+    X = np.asarray(out.X)[:64]
+    # drop-first (reference level 'a'): dummies for b, c only
+    np.testing.assert_allclose(X[:, 0], (cat == 1).astype(np.float32))
+    np.testing.assert_allclose(X[:, 1], (cat == 2).astype(np.float32))
+
+
+def test_rformula_interaction(table):
+    t, x1, x2, cat, y = table
+    out = RFormula(formula="y ~ x1:x2 + x1:cat").fit(t).transform(t)
+    names = [v.name for v in out.domain.attributes]
+    assert names == ["x1:x2", "x1:cat_b", "x1:cat_c"]
+    X = np.asarray(out.X)[:64]
+    np.testing.assert_allclose(X[:, 0], x1 * x2, rtol=1e-5)
+    np.testing.assert_allclose(X[:, 1], x1 * (cat == 1), rtol=1e-5)
+
+
+def test_rformula_feeds_estimator(table, session):
+    """The documented MLlib use: RFormula output straight into a learner."""
+    from orange3_spark_tpu.models.logistic_regression import LogisticRegression
+
+    t, *_ , y = table
+    prepped = RFormula(formula="y ~ x1 + cat").fit(t).transform(t)
+    model = LogisticRegression(max_iter=100).fit(prepped)
+    acc = np.mean(model.predict(prepped) == y)
+    assert acc > 0.9, acc
+
+
+def test_rformula_errors(table):
+    t, *_ = table
+    with pytest.raises(ValueError, match="label"):
+        RFormula(formula="~ x1").fit(t)
+    with pytest.raises(ValueError, match="unknown column"):
+        RFormula(formula="y ~ nope").fit(t)
+    with pytest.raises(ValueError, match="cannot be a feature"):
+        RFormula(formula="y ~ x1:y").fit(t)
+    with pytest.raises(ValueError, match="selects no terms"):
+        RFormula(formula="y ~ x1 - x1").fit(t)
+
+
+def test_rformula_no_intercept_full_codes_first_categorical(table):
+    t, x1, x2, cat, y = table
+    out = RFormula(formula="y ~ cat - 1").fit(t).transform(t)
+    names = [v.name for v in out.domain.attributes]
+    assert names == ["cat_a", "cat_b", "cat_c"]   # all 3 levels (R rule)
+    X = np.asarray(out.X)[:64]
+    np.testing.assert_allclose(X.sum(axis=1), 1.0)  # spans the mean
+
+
+def test_rformula_exclusion_typo_raises(table):
+    t, *_ = table
+    with pytest.raises(ValueError, match="exclusion"):
+        RFormula(formula="y ~ . - x2_typo").fit(t)

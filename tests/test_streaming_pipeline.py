@@ -245,3 +245,19 @@ def test_fastcsv_categorical_end_to_end(session, tmp_path):
     model = est.fit_stream(src, session=session, cache_device=True)
     ev = model.evaluate_device(model.device_chunks_)
     assert ev["accuracy"] > 0.85, ev
+
+
+def test_per_column_update_matches_fused(session):
+    """The per-column scatter formulation (perf A/B lever) must be
+    numerically identical to the fused [N, C] gather/scatter."""
+    Xall, y = _criteo_shaped(3000, seed=8)
+    fused = StreamingHashedLinearEstimator(**KW).fit_stream(
+        array_chunk_source(Xall, y, chunk_rows=1024), session=session
+    )
+    percol = StreamingHashedLinearEstimator(
+        **KW, per_column_update=True
+    ).fit_stream(array_chunk_source(Xall, y, chunk_rows=1024), session=session)
+    np.testing.assert_allclose(
+        np.asarray(fused.theta["emb"]), np.asarray(percol.theta["emb"]),
+        rtol=1e-6, atol=1e-7,
+    )

@@ -102,3 +102,38 @@ def test_head_respects_filter(session):
     h = t.filter(lambda tb: tb.X[:, 0] > 0).head(5)
     expected = X[X[:, 0] > 0][:5]
     np.testing.assert_allclose(h, expected, rtol=1e-6)
+
+
+def test_fillna_and_dropna(session):
+    import jax.numpy as jnp
+    from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+
+    X = np.array([[1.0, np.nan], [np.nan, 2.0], [3.0, 4.0]], np.float32)
+    dom = Domain([ContinuousVariable("a"), ContinuousVariable("b")])
+    t = TpuTable.from_numpy(dom, X, session=session)
+
+    filled = t.fillna(0.0)
+    got = np.asarray(filled.X)[:3]
+    np.testing.assert_allclose(got, [[1, 0], [0, 2], [3, 4]])
+
+    per_col = t.fillna({"a": -1.0})
+    got = np.asarray(per_col.X)[:3]
+    assert got[1, 0] == -1.0 and np.isnan(got[0, 1])
+    with pytest.raises(ValueError, match="unknown column"):
+        t.fillna({"zzz": 0.0})
+
+    assert t.dropna().count() == 1          # only row 3 is NaN-free
+    assert t.dropna(subset=["a"]).count() == 2
+    assert t.where(t.X[:, 0] > 2).count() == 1  # filter alias
+
+
+def test_dropna_on_class_var(session):
+    from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+
+    X = np.array([[1.0], [2.0], [3.0]], np.float32)
+    y = np.array([0.0, np.nan, 1.0], np.float32)
+    dom = Domain([ContinuousVariable("a")], ContinuousVariable("y"))
+    t = TpuTable.from_numpy(dom, X, y, session=session)
+    assert t.dropna(subset=["y"]).count() == 2
+    with pytest.raises(ValueError, match="unknown column"):
+        t.dropna(subset=["nope"])
