@@ -206,15 +206,22 @@ class TpuTable:
         attribute column; a {column_name: float} dict fills per column.
         Device-pure (one where per filled column)."""
         if isinstance(value, dict):
-            X = self.X
+            X, Y = self.X, self.Y
             for name, v in value.items():
                 try:
-                    j = self.domain.index(self.domain[name])
-                except (KeyError, ValueError) as e:
+                    var = self.domain[name]
+                except KeyError as e:
                     raise ValueError(f"fillna: unknown column {name!r}") from e
-                col = jnp.where(jnp.isnan(X[:, j]), jnp.float32(v), X[:, j])
-                X = X.at[:, j].set(col)
-            return self.with_X(X)
+                if var in self.domain.class_vars:
+                    j = list(self.domain.class_vars).index(var)
+                    col = jnp.where(jnp.isnan(Y[:, j]), jnp.float32(v), Y[:, j])
+                    Y = Y.at[:, j].set(col)
+                else:
+                    j = self.domain.index(var)
+                    col = jnp.where(jnp.isnan(X[:, j]), jnp.float32(v), X[:, j])
+                    X = X.at[:, j].set(col)
+            return TpuTable(self.domain, X, Y, self.W, self.metas,
+                            self.n_rows, self.session)
         X = jnp.where(jnp.isnan(self.X), jnp.float32(value), self.X)
         return self.with_X(X)
 

@@ -78,7 +78,7 @@ class HashedLinearParams(Params):
     compute_dtype: str = "float32"
     label_in_chunk: bool = False  # chunks carry the label as column 0
     prefetch_depth: int = 2       # host->device pipeline depth (0 disables)
-    per_column_update: bool = False  # C independent scatters vs one fused
+    emb_update: str = "fused"    # 'fused' | 'per_column' | 'sorted' scatter
 
 
 def _effective_k(p: HashedLinearParams) -> int:
@@ -95,16 +95,59 @@ def _row_loss_kind(p: HashedLinearParams) -> str:
     return p.loss
 
 
-def _hashed_logits(theta, dense, idx, compute_dtype, per_column: bool = False):
-    """per_column: express the embedding lookup as C independent [N]-gathers
-    (autodiff then emits C independent [N]-scatters) instead of one fused
-    [N, C] gather/scatter — an A/B lever for the scatter-bound step; both
-    formulations are numerically identical."""
+@jax.custom_vjp
+def _emb_sum_sorted_grad(emb, idx):
+    """Same forward as take+sum; the BACKWARD sorts the flattened
+    (index, grad) pairs and scatter-adds with indices_are_sorted=True — the
+    classic TPU trade of one O(M log M) sort for a conflict-free scatter.
+    An A/B lever against the plain scatter (emb_update='sorted')."""
+    return jnp.sum(jnp.take(emb, idx, axis=0), axis=1, dtype=jnp.float32)
+
+
+def _emb_sum_sorted_fwd(emb, idx):
+    # dtype travels as a zero-size array (a bare dtype is not a JAX type)
+    proto = jnp.zeros((0,), emb.dtype)
+    return _emb_sum_sorted_grad(emb, idx), (idx, emb.shape, proto)
+
+
+def _emb_sum_sorted_bwd(res, g):
+    idx, (D, k), proto = res
+    dtype = proto.dtype
+    N, C = idx.shape
+    flat_idx = idx.reshape(-1)
+    flat_g = jnp.broadcast_to(g[:, None, :], (N, C, k)).reshape(N * C, k)
+    order = jnp.argsort(flat_idx)
+    sidx = flat_idx[order]
+    sg = flat_g[order]
+    grad = jnp.zeros((D, k), dtype).at[sidx].add(
+        sg.astype(dtype), indices_are_sorted=True, unique_indices=False
+    )
+    return grad, None
+
+
+_emb_sum_sorted_grad.defvjp(_emb_sum_sorted_fwd, _emb_sum_sorted_bwd)
+
+
+def _hashed_logits(theta, dense, idx, compute_dtype, emb_update: str = "fused"):
+    """emb_update selects the gather/scatter formulation — all numerically
+    identical, different XLA lowerings (the step is scatter-bound; see
+    tools/step_ab.py for the on-hardware A/B):
+      'fused'      one [N, C] gather; autodiff emits one fused scatter
+      'per_column' C independent [N] gathers/scatters
+      'sorted'     custom-vjp backward: sort pairs, conflict-free scatter
+    """
     emb = theta["emb"].astype(compute_dtype)
-    if per_column:
+    if emb_update == "per_column":
         logits = jnp.zeros((idx.shape[0], emb.shape[1]), jnp.float32)
         for c in range(idx.shape[1]):
             logits = logits + jnp.take(emb, idx[:, c], axis=0)
+    elif emb_update == "sorted":
+        logits = _emb_sum_sorted_grad(emb, idx)
+    elif emb_update != "fused":
+        raise ValueError(
+            f"emb_update must be 'fused' | 'per_column' | 'sorted', "
+            f"got {emb_update!r}"
+        )
     else:
         emb_rows = jnp.take(emb, idx, axis=0)
         logits = jnp.sum(emb_rows, axis=1, dtype=jnp.float32)    # [N, k]
@@ -138,14 +181,14 @@ def _split_chunk(Xall, n_valid, y, w, *, label_in_chunk: bool, n_dense: int):
     jax.jit,
     static_argnames=(
         "loss_kind", "n_dims", "n_dense", "compute_dtype", "label_in_chunk",
-        "per_column",
+        "emb_update",
     ),
     donate_argnums=(0, 1),
 )
 def _hashed_step(
     theta, opt_state, Xall, n_valid, y, w, salts, reg, lr,
     *, loss_kind: str, n_dims: int, n_dense: int, compute_dtype=jnp.float32,
-    label_in_chunk: bool = False, per_column: bool = False,
+    label_in_chunk: bool = False, emb_update: str = "fused",
 ):
     yv, dense, cats, wv = _split_chunk(
         Xall, n_valid, y, w, label_in_chunk=label_in_chunk, n_dense=n_dense
@@ -153,7 +196,7 @@ def _hashed_step(
     idx = hash_columns(cats, salts, n_dims)
 
     def loss_fn(theta):
-        logits = _hashed_logits(theta, dense, idx, compute_dtype, per_column)
+        logits = _hashed_logits(theta, dense, idx, compute_dtype, emb_update)
         row = per_row_loss(loss_kind, logits, yv)
         sw = jnp.maximum(jnp.sum(wv), EPS_TOTAL_WEIGHT)
         data = jnp.sum(row * wv) / sw
@@ -516,7 +559,7 @@ class StreamingHashedLinearEstimator(Estimator):
                 theta, opt_state, Xd, n_valid, yd, wd, salts, reg, lr,
                 loss_kind=loss_kind, n_dims=p.n_dims, n_dense=p.n_dense,
                 compute_dtype=compute_dtype, label_in_chunk=p.label_in_chunk,
-                per_column=p.per_column_update,
+                emb_update=p.emb_update,
             )
             n_steps += 1
             last_loss = loss

@@ -193,7 +193,7 @@ class RFormula(Estimator):
             plan.append((":".join(t), factors))
             for combo in itertools.product(*factor_names):
                 out_vars.append(ContinuousVariable(":".join(combo)))
-        out_domain = Domain(out_vars, label_var)
+        out_domain = Domain(out_vars, label_var, domain.metas)
         model = RFormulaModel(self.params, plan, out_domain, label_var, label_src)
         model.has_intercept = intercept
         return model

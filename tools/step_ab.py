@@ -1,0 +1,83 @@
+"""A/B the hashed-step embedding-update formulations on real hardware.
+
+The Criteo step is scatter-OP-bound (BASELINE.md roofline). Three
+numerically-identical lowerings exist behind ``HashedLinearParams.emb_update``
+('fused' | 'per_column' | 'sorted'); this tool times each on the current
+backend and prints one JSON line so the winner can be promoted to the bench
+default. Run on the TPU host:
+
+    python tools/step_ab.py [--rows 262144] [--dims 4194304] [--steps 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(
+        globals().get("__file__", "tools/step_ab.py"))))
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1 << 18)
+    ap.add_argument("--dims", type=int, default=1 << 22)
+    ap.add_argument("--steps", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from orange3_spark_tpu.models.hashed_linear import (
+        _ADAM_UNIT,
+        _hashed_step,
+    )
+    from orange3_spark_tpu.ops.hashing import column_salts
+
+    n_dense, n_cat = 13, 26
+    rng = np.random.default_rng(0)
+    Xall = np.concatenate(
+        [rng.integers(0, 2, (args.rows, 1)).astype(np.float32),
+         rng.lognormal(0, 1, (args.rows, n_dense)).astype(np.float32),
+         rng.integers(0, 200_000, (args.rows, n_cat)).astype(np.float32)],
+        axis=1,
+    )
+    Xd = jax.device_put(Xall)
+    salts = jnp.asarray(column_salts(n_cat, 0))
+    zero = jnp.zeros((1,), jnp.float32)
+    out = {"metric": "hashed_step_ms_by_emb_update", "unit": "ms/step",
+           "rows": args.rows, "dims": args.dims,
+           "backend": jax.default_backend()}
+    for variant in ("fused", "per_column", "sorted"):
+        theta = {"emb": jnp.zeros((args.dims, 1), jnp.float32),
+                 "coef": jnp.zeros((n_dense, 1), jnp.float32),
+                 "intercept": jnp.zeros((1,), jnp.float32)}
+        opt = _ADAM_UNIT.init(theta)
+        kw = dict(loss_kind="binary_logistic", n_dims=args.dims,
+                  n_dense=n_dense, label_in_chunk=True, emb_update=variant)
+        theta, opt, loss = _hashed_step(
+            theta, opt, Xd, jnp.int32(args.rows), zero, zero, salts,
+            jnp.float32(0.0), jnp.float32(0.04), **kw)
+        jax.block_until_ready(loss)     # compile
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            theta, opt, loss = _hashed_step(
+                theta, opt, Xd, jnp.int32(args.rows), zero, zero, salts,
+                jnp.float32(0.0), jnp.float32(0.04), **kw)
+        jax.block_until_ready(loss)
+        ms = (time.perf_counter() - t0) / args.steps * 1e3
+        out[variant] = round(ms, 2)
+        out[f"{variant}_rows_per_sec"] = round(args.rows / ms * 1e3, 1)
+    best = min(("fused", "per_column", "sorted"), key=lambda v: out[v])
+    out["best"] = best
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
