@@ -122,6 +122,35 @@ def read_parquet(path: str, class_col: str = "", *, session=None) -> TpuTable:
     return _table_from_columns(names, columns, class_col, session)
 
 
+def read_sql(query: str, database: str, class_col: str = "", *,
+             session=None) -> TpuTable:
+    """SQL query → sharded TpuTable — the ``spark.read.jdbc`` role.
+
+    The reference reads cluster-side JDBC sources; the single-host
+    equivalent here is any SQLite database file (stdlib driver, no new
+    dependency). Column types follow the same inference as the CSV reader:
+    numeric → continuous, low-cardinality strings → discrete, long strings
+    → metas."""
+    import sqlite3
+
+    with sqlite3.connect(database) as conn:
+        cur = conn.execute(query)
+        names = [d[0] for d in cur.description]
+        rows = cur.fetchall()
+    columns = {
+        n: np.asarray([r[j] for r in rows], dtype=object)
+        for j, n in enumerate(names)
+    }
+    # numeric columns come back as python numbers; tighten their dtype
+    for n, col in columns.items():
+        if all(v is None or isinstance(v, (int, float)) for v in col):
+            columns[n] = np.asarray(
+                [np.nan if v is None else float(v) for v in col],
+                dtype=np.float32,
+            )
+    return _table_from_columns(names, columns, class_col, session)
+
+
 def write_csv(table: TpuTable, path: str) -> None:
     """Collect + write (df.write.csv role; host boundary by design)."""
     X, Y, _ = table.to_numpy()

@@ -542,3 +542,89 @@ class FeatureHasher(Transformer):
             table.domain.class_vars, table.domain.metas,
         )
         return table.with_X(out, new_domain)
+
+
+# ---------------------------------------------------------------------------
+# Target encoding (pyspark.ml.feature.TargetEncoder, Spark 4.0)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class TargetEncoderParams(Params):
+    input_cols: tuple = ()        # discrete attribute names
+    target_type: str = "binary"   # MLlib targetType: 'binary' | 'continuous'
+    smoothing: float = 0.0        # MLlib smoothing (shrink toward the prior)
+    handle_invalid: str = "error" # 'error' | 'keep' (unseen -> global prior)
+
+
+class TargetEncoderModel(Model):
+    """Per-category target means, smoothing-shrunk toward the global prior:
+    enc[c] = (sum_y[c] + smoothing * prior) / (count[c] + smoothing)."""
+
+    def __init__(self, params, col_idx, tables, prior):
+        self.params = params
+        self.col_idx = col_idx     # list[int]
+        self.tables = tables       # list[f32[k+1]] (last slot = unseen)
+        self.prior = prior
+
+    @property
+    def state_pytree(self):
+        return {f"enc_{j}": t for j, t in zip(self.col_idx, self.tables)}
+
+    def transform(self, table: TpuTable) -> TpuTable:
+        p = self.params
+        X = table.X
+        new_attrs = list(table.domain.attributes)
+        for j, enc, name in zip(self.col_idx, self.tables,
+                                p.input_cols, strict=True):
+            k = enc.shape[0] - 1
+            raw = X[:, j].astype(jnp.int32)
+            if p.handle_invalid == "error":
+                live = jnp.where(table.W > 0, raw, 0)
+                mx = int(np.asarray(jnp.max(live)).item())
+                if mx >= k:
+                    raise ValueError(
+                        f"column {name!r} has unseen category {mx} "
+                        "(handle_invalid='error')"
+                    )
+            idx = jnp.clip(raw, 0, k - 1)
+            idx = jnp.where((raw < 0) | (raw >= k), k, idx)  # unseen slot
+            X = X.at[:, j].set(jnp.take(enc, idx))
+            new_attrs[j] = ContinuousVariable(f"{name}_te")
+        domain = Domain(new_attrs, table.domain.class_vars, table.domain.metas)
+        return table.with_X(X, domain)
+
+
+class TargetEncoder(Estimator):
+    """Mean target encoding per category — the hashed/one-hot alternative
+    for high-cardinality categoricals (segment_sum over the sharded rows;
+    the per-category reduction GSPMD all-reduces over ICI)."""
+
+    ParamsCls = TargetEncoderParams
+    params: TargetEncoderParams
+
+    def _fit(self, table: TpuTable) -> TargetEncoderModel:
+        p = self.params
+        if not p.input_cols:
+            raise ValueError("TargetEncoder needs input_cols")
+        y = table.y
+        W = table.W
+        prior = float(jnp.sum(y * W) / jnp.maximum(jnp.sum(W), 1e-12))
+        col_idx, tables = [], []
+        for name in p.input_cols:
+            var = table.domain[name]
+            j = table.domain.index(var)
+            col_idx.append(j)
+            if isinstance(var, DiscreteVariable) and var.values:
+                k = len(var.values)
+            else:
+                k = int(np.asarray(
+                    jnp.max(jnp.where(W > 0, table.X[:, j], 0.0))).item()) + 1
+            idx = jnp.clip(table.X[:, j].astype(jnp.int32), 0, k - 1)
+            sum_y = jax.ops.segment_sum(y * W, idx, num_segments=k)
+            cnt = jax.ops.segment_sum(W, idx, num_segments=k)
+            enc = (sum_y + p.smoothing * prior) / jnp.maximum(
+                cnt + p.smoothing, 1e-12
+            )
+            enc = jnp.where(cnt > 0, enc, prior)
+            # slot k serves unseen categories at transform time
+            tables.append(jnp.concatenate([enc, jnp.asarray([prior])]))
+        return TargetEncoderModel(p, col_idx, tables, prior)

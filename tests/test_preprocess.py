@@ -322,3 +322,43 @@ def test_minmax_custom_range_roundtrips_state(session):
     got = restored.transform(t).to_numpy()[0]
     assert got.min() >= -1 - 1e-5 and got.max() <= 1 + 1e-5
     assert got.min() < -0.5  # actually uses the custom range
+
+
+def test_target_encoder_means_smoothing_and_unseen(session):
+    """TargetEncoder (Spark 4.0): per-category target means, smoothing
+    shrink toward the prior, unseen categories -> prior."""
+    from orange3_spark_tpu.core.domain import (
+        ContinuousVariable, DiscreteVariable, Domain,
+    )
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.models.preprocess import TargetEncoder
+
+    cat = np.array([0, 0, 1, 1, 1, 2], np.float32)
+    y   = np.array([1, 1, 0, 0, 1, 1], np.float32)
+    dom = Domain([DiscreteVariable("c", ("a", "b", "z")),
+                  ContinuousVariable("x")],
+                 DiscreteVariable("y", ("0", "1")))
+    X = np.stack([cat, np.arange(6, dtype=np.float32)], 1)
+    t = TpuTable.from_numpy(dom, X, y, session=session)
+
+    m = TargetEncoder(input_cols=("c",)).fit(t)
+    out = m.transform(t)
+    enc = np.asarray(out.X)[:6, 0]
+    np.testing.assert_allclose(enc[:2], 1.0)          # cat a: mean 1
+    np.testing.assert_allclose(enc[2:5], 1 / 3, rtol=1e-5)
+    assert out.domain.attributes[0].name == "c_te"
+
+    # smoothing shrinks toward the prior (4/6)
+    ms = TargetEncoder(input_cols=("c",), smoothing=2.0).fit(t)
+    enc_s = np.asarray(ms.transform(t).X)[:6, 0]
+    prior = 4 / 6
+    np.testing.assert_allclose(enc_s[0], (2 + 2 * prior) / (2 + 2), rtol=1e-5)
+
+    # unseen category at transform: error by default, prior with 'keep'
+    X2 = X.copy(); X2[0, 0] = 7
+    t2 = TpuTable.from_numpy(dom, X2, y, session=session)
+    with pytest.raises(ValueError, match="unseen"):
+        m.transform(t2)
+    mk = TargetEncoder(input_cols=("c",), handle_invalid="keep").fit(t)
+    enc_k = np.asarray(mk.transform(t2).X)[:6, 0]
+    np.testing.assert_allclose(enc_k[0], prior, rtol=1e-5)

@@ -137,3 +137,30 @@ def test_dropna_on_class_var(session):
     assert t.dropna(subset=["y"]).count() == 2
     with pytest.raises(ValueError, match="unknown column"):
         t.dropna(subset=["nope"])
+
+
+def test_read_sql_roundtrip(session, tmp_path):
+    """spark.read.jdbc role: SQL query -> typed sharded table."""
+    import sqlite3
+    from orange3_spark_tpu.io.readers import read_sql
+
+    db = str(tmp_path / "t.db")
+    with sqlite3.connect(db) as c:
+        c.execute("CREATE TABLE trips (dist REAL, fare REAL, kind TEXT)")
+        c.executemany(
+            "INSERT INTO trips VALUES (?, ?, ?)",
+            [(1.5, 8.0, "card"), (3.0, 14.5, "cash"), (0.5, None, "card")],
+        )
+    t = read_sql("SELECT * FROM trips WHERE dist > 0.4", db, session=session)
+    assert [v.name for v in t.domain.attributes] == ["dist", "fare", "kind"]
+    assert t.domain["kind"].is_discrete
+    X, _, W = t.to_numpy()
+    assert X.shape == (3, 3)
+    assert np.isnan(X[2, 1])          # NULL -> NaN
+    assert t.count() == 3
+
+    from orange3_spark_tpu.widgets.catalog import WIDGET_REGISTRY
+    w = WIDGET_REGISTRY["OWSqlReader"](query="SELECT dist, fare FROM trips",
+                                       database=db)
+    out = w.process()["data"]
+    assert out.n_attrs == 2
