@@ -390,11 +390,15 @@ def crosstab(table: TpuTable, col1: str, col2: str) -> np.ndarray:
 def with_column(table: TpuTable, name: str, expr) -> TpuTable:
     """df.withColumn: append a computed column.
 
-    ``expr``: a callable (table) -> f32[N_pad] column, or a SQL-ish string
-    over attribute names ("a + log(b)") evaluated by the SQLTransformer
-    expression engine — either way one fused elementwise XLA op.
+    ``expr``: a ready [N_pad] column (device/numpy array — e.g. a window
+    function result from ops/window.py), a callable (table) -> f32[N_pad],
+    or a SQL-ish string over attribute names ("a + log(b)") evaluated by
+    the SQLTransformer expression engine — in every case one fused
+    elementwise XLA op.
     """
-    if callable(expr):
+    if isinstance(expr, (jax.Array, np.ndarray)):
+        col = jnp.asarray(expr)
+    elif callable(expr):
         col = expr(table)
     else:
         import ast as _ast

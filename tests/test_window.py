@@ -1,0 +1,67 @@
+"""Window functions (pyspark.sql.Window subset): row_number / lag / lead /
+running_sum over discrete partitions via one device sort (SURVEY §2b
+relational ops)."""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.core.domain import (
+    ContinuousVariable,
+    DiscreteVariable,
+    Domain,
+)
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.ops.window import lag, lead, row_number, running_sum
+
+
+@pytest.fixture()
+def trips(session):
+    #         part  t     fare
+    data = [
+        (0, 3.0, 10.0),
+        (0, 1.0, 20.0),
+        (1, 2.0, 5.0),
+        (0, 2.0, 30.0),
+        (1, 1.0, 7.0),
+    ]
+    dom = Domain([
+        DiscreteVariable("city", ("nyc", "sf")),
+        ContinuousVariable("t"), ContinuousVariable("fare"),
+    ])
+    X = np.asarray(data, np.float32)
+    return TpuTable.from_numpy(dom, X, session=session)
+
+
+def test_row_number(trips):
+    rn = np.asarray(row_number(trips, "city", "t"))[:5]
+    # city 0 ordered by t: rows 1(t=1) -> 1, 3(t=2) -> 2, 0(t=3) -> 3
+    np.testing.assert_allclose(rn, [3, 1, 2, 2, 1])
+
+
+def test_lag_and_lead(trips):
+    lg = np.asarray(lag(trips, "fare", "city", "t"))[:5]
+    assert np.isnan(lg[1]) and np.isnan(lg[4])    # partition starts
+    assert lg[3] == 20.0      # city 0, t=2: previous (t=1) fare 20
+    assert lg[0] == 30.0      # city 0, t=3: previous (t=2) fare 30
+    assert lg[2] == 7.0       # city 1, t=2: previous (t=1) fare 7
+    ld = np.asarray(lead(trips, "fare", "city", "t"))[:5]
+    assert ld[1] == 30.0 and ld[3] == 10.0
+    assert np.isnan(ld[0]) and np.isnan(ld[2])    # partition ends
+
+
+def test_running_sum_and_filter(trips):
+    rs = np.asarray(running_sum(trips, "fare", "city", "t"))[:5]
+    np.testing.assert_allclose(rs, [60.0, 20.0, 12.0, 50.0, 7.0])
+    # a filtered row leaves the window entirely
+    t2 = trips.filter(trips.X[:, 1] != 2.0)       # drop both t=2 rows
+    rn2 = np.asarray(row_number(t2, "city", "t"))[:5]
+    assert np.isnan(rn2[3]) and np.isnan(rn2[2])
+    np.testing.assert_allclose(rn2[[0, 1, 4]], [2, 1, 1])
+
+
+def test_window_with_column_roundtrip(trips):
+    from orange3_spark_tpu.ops.relational import with_column
+
+    out = with_column(trips, "rn", row_number(trips, "city", "t"))
+    assert out.domain["rn"].is_continuous
+    np.testing.assert_allclose(np.asarray(out.X[:5, -1]), [3, 1, 2, 2, 1])
