@@ -96,6 +96,11 @@ def get_lib():
         lib.fcsv_set_categorical.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
         ]
+        lib.fcsv_write.restype = ctypes.c_int
+        lib.fcsv_write.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_float), ctypes.c_long,
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_char,
+        ]
         _lib = lib
         return _lib
 
@@ -205,6 +210,39 @@ class NativeCsvReader:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def write_csv_native(path: str, data: np.ndarray, names=None, *,
+                     delimiter: str = ",") -> None:
+    """f32 matrix -> CSV via the native writer (df.write.csv at scale;
+    shortest-round-trip floats, ~an order of magnitude past np.savetxt).
+    Raises NativeUnavailable when the engine can't build."""
+    lib = get_lib()
+    data = np.ascontiguousarray(data, dtype=np.float32)
+    if data.ndim != 2:
+        raise ValueError(f"data must be 2-D, got {data.shape}")
+    header = b""
+    if names is not None:
+        if len(names) != data.shape[1]:
+            raise ValueError(
+                f"{len(names)} names for {data.shape[1]} columns"
+            )
+        quoted = []
+        for n in names:
+            s = str(n)
+            if "\n" in s or "\r" in s:
+                # '\n' is the transport separator to the native writer
+                raise ValueError(f"column name {s!r} contains a newline")
+            if delimiter in s or '"' in s:
+                s = '"' + s.replace('"', '""') + '"'  # RFC-4180 quoting
+            quoted.append(s)
+        header = "\n".join(quoted).encode()
+    rc = lib.fcsv_write(
+        path.encode(), data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        data.shape[0], data.shape[1], header, delimiter.encode()[0:1] or b",",
+    )
+    if rc != 0:
+        raise OSError(f"fcsv_write failed for {path!r}")
 
 
 def read_csv_native(path: str, class_col: str = "", *, delimiter: str = ",",

@@ -152,12 +152,21 @@ def read_sql(query: str, database: str, class_col: str = "", *,
 
 
 def write_csv(table: TpuTable, path: str) -> None:
-    """Collect + write (df.write.csv role; host boundary by design)."""
+    """Collect + write (df.write.csv role; host boundary by design).
+    Uses the native C++ writer when available (shortest-round-trip floats,
+    ~10x np.savetxt); falls back to numpy otherwise."""
     X, Y, _ = table.to_numpy()
     names = [v.name for v in table.domain.attributes]
     data = X
     if Y is not None:
         names += [v.name for v in table.domain.class_vars]
         data = np.concatenate([X, Y], axis=1)
+    try:
+        from orange3_spark_tpu.io.native import NativeUnavailable, write_csv_native
+
+        write_csv_native(path, data, names)
+        return
+    except NativeUnavailable:
+        pass
     header = ",".join(names)
     np.savetxt(path, data, delimiter=",", header=header, comments="", fmt="%.9g")

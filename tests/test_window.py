@@ -65,3 +65,36 @@ def test_window_with_column_roundtrip(trips):
     out = with_column(trips, "rn", row_number(trips, "city", "t"))
     assert out.domain["rn"].is_continuous
     np.testing.assert_allclose(np.asarray(out.X[:5, -1]), [3, 1, 2, 2, 1])
+
+
+def test_running_sum_skips_nan_and_nan_partition_key(session):
+    """Spark semantics: NaN values are skipped by the sum (not poisoning
+    later partitions); rows with a NaN partition KEY form their own group."""
+    from orange3_spark_tpu.ops.window import Window
+
+    dom = Domain([DiscreteVariable("city", ("nyc", "sf")),
+                  ContinuousVariable("t"), ContinuousVariable("fare")])
+    X = np.asarray([
+        [0, 1.0, np.nan],
+        [0, 2.0, 10.0],
+        [1, 1.0, 5.0],
+        [1, 2.0, 6.0],
+        [np.nan, 1.0, 9.0],     # NULL partition key: its own group
+    ], np.float32)
+    t = TpuTable.from_numpy(dom, X, session=session)
+    w = Window(t, "city", "t")
+    rs = np.asarray(w.running_sum("fare"))[:5]
+    np.testing.assert_allclose(rs, [0.0, 10.0, 5.0, 11.0, 9.0])
+    rn = np.asarray(w.row_number())[:5]
+    np.testing.assert_allclose(rn, [1, 2, 1, 2, 1])   # NaN-key row ranks alone
+
+
+def test_window_shared_view(trips):
+    from orange3_spark_tpu.ops.window import Window
+
+    w = Window(trips, "city", "t")
+    np.testing.assert_allclose(np.asarray(w.row_number())[:5], [3, 1, 2, 2, 1])
+    assert np.asarray(w.lag("fare"))[3] == 20.0
+    np.testing.assert_allclose(
+        np.asarray(w.running_sum("fare"))[:5], [60.0, 20.0, 12.0, 50.0, 7.0]
+    )
