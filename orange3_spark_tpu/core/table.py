@@ -297,6 +297,19 @@ class TpuTable:
         stats = _describe_jit(self.X, self.W)
         return {k: np.asarray(v) for k, v in stats.items()}
 
+    def approx_quantile(self, cols, probabilities) -> np.ndarray:
+        """DataFrame.approxQuantile — exact here, not Greenwald-Khanna: one
+        batched device sort beats a host sketch while the column fits HBM
+        (ops/stats.weighted_quantiles). Returns [n_cols, n_probs]."""
+        from orange3_spark_tpu.ops.stats import weighted_quantiles
+
+        if isinstance(cols, str):
+            cols = [cols]
+        # column() resolves attributes AND class vars (X vs Y storage)
+        Xsel = jnp.stack([self.column(c) for c in cols], axis=1)
+        qs = jnp.asarray(list(probabilities), jnp.float32)
+        return np.asarray(weighted_quantiles(Xsel, self.W, qs)).T
+
     def __repr__(self) -> str:  # pragma: no cover - debug aid
         return (
             f"TpuTable[{self.n_rows} rows x {self.n_attrs} attrs, "

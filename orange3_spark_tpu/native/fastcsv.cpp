@@ -39,6 +39,7 @@
 
 #include <atomic>
 #include <charconv>
+#include <limits>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -132,6 +133,15 @@ inline float parse_float(const char* p, const char* end, const char** out) {
   while (s < end && (*s == ' ' || *s == '\t')) ++s;
   bool neg = false;
   if (s < end && (*s == '-' || *s == '+')) { neg = (*s == '-'); ++s; }
+  // literal inf/nan (the writer emits them; real CSVs contain them too)
+  if (s < end && (*s == 'i' || *s == 'I')) {
+    if (end - s >= 3 && (s[1] == 'n' || s[1] == 'N')
+        && (s[2] == 'f' || s[2] == 'F')) {
+      *out = end;
+      float v = std::numeric_limits<float>::infinity();
+      return neg ? -v : v;
+    }
+  }
   uint64_t mant = 0;
   int exp10 = 0;
   int ndig = 0;

@@ -47,9 +47,10 @@ class Window:
             jnp.isnan(raw), n_groups, raw.astype(jnp.int32)
         ).astype(jnp.int32)
         val = table.column(order_by)
-        val = jnp.where(jnp.isnan(val), jnp.inf, val)
         if not ascending:
             val = -val
+        # NULLS LAST in either direction (Spark's asc/desc default)
+        val = jnp.where(jnp.isnan(val), jnp.inf, val)
         live = table.W > 0
         # stable lexsort: partition id, dead-row bump (dead rows land after
         # every live row of their partition), then the order value
@@ -84,9 +85,7 @@ class Window:
             self._pos - offset < n
         )
         ok = same_part & in_range & self._live_s & jnp.roll(self._live_s, offset)
-        out = jnp.where(ok & self._live_s, shifted, jnp.nan)
-        out = jnp.where(self._live_s, out, jnp.nan)
-        return out[self._inv]
+        return jnp.where(ok, shifted, jnp.nan)[self._inv]
 
     def lag(self, col: str, offset: int = 1):
         """Value of ``col`` ``offset`` rows earlier in the partition's

@@ -238,3 +238,14 @@ def test_native_writer_quotes_delimiter_names(tmp_path):
         assert r.read_all().shape == (2, 2)
     with pytest.raises(ValueError, match="newline"):
         write_csv_native(p, np.ones((1, 1), np.float32), ["a\nb"])
+
+
+def test_native_writer_inf_roundtrip(tmp_path):
+    from orange3_spark_tpu.io.native import write_csv_native
+
+    p = str(tmp_path / "inf.csv")
+    data = np.array([[np.inf, -np.inf, 1.5]], np.float32)
+    write_csv_native(p, data, ["a", "b", "c"])
+    with NativeCsvReader(p) as r:
+        back = r.read_all()
+    np.testing.assert_array_equal(back, data)

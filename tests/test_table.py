@@ -164,3 +164,31 @@ def test_read_sql_roundtrip(session, tmp_path):
                                        database=db)
     out = w.process()["data"]
     assert out.n_attrs == 2
+
+
+def test_approx_quantile(session):
+    from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(5001).astype(np.float32)
+    dom = Domain([ContinuousVariable("a"), ContinuousVariable("b")])
+    t = TpuTable.from_numpy(dom, np.stack([x, 2 * x], 1), session=session)
+    q = t.approx_quantile(["a", "b"], [0.25, 0.5, 0.75])
+    assert q.shape == (2, 3)
+    np.testing.assert_allclose(q[0], np.quantile(x, [0.25, 0.5, 0.75]),
+                               atol=2e-3)
+    np.testing.assert_allclose(q[1], 2 * q[0], rtol=1e-5)
+    # filtered rows leave the quantiles
+    t2 = t.filter(t.X[:, 0] > 0)
+    q2 = t2.approx_quantile("a", [0.0])
+    assert q2[0, 0] > 0
+
+
+def test_approx_quantile_class_var(session):
+    from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+
+    x = np.arange(101, dtype=np.float32)
+    dom = Domain([ContinuousVariable("a")], ContinuousVariable("y"))
+    t = TpuTable.from_numpy(dom, x[:, None], 3 * x, session=session)
+    q = t.approx_quantile(["a", "y"], [0.5])
+    np.testing.assert_allclose(q[:, 0], [50.0, 150.0], atol=1.0)

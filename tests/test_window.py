@@ -98,3 +98,12 @@ def test_window_shared_view(trips):
     np.testing.assert_allclose(
         np.asarray(w.running_sum("fare"))[:5], [60.0, 20.0, 12.0, 50.0, 7.0]
     )
+
+
+def test_desc_window_nulls_last(session):
+    dom = Domain([DiscreteVariable("g", ("x",)), ContinuousVariable("t"),
+                  ContinuousVariable("v")])
+    X = np.asarray([[0, np.nan, 1.0], [0, 5.0, 2.0], [0, 9.0, 3.0]], np.float32)
+    t = TpuTable.from_numpy(dom, X, session=session)
+    rn = np.asarray(row_number(t, "g", "t", ascending=False))[:3]
+    np.testing.assert_allclose(rn, [3, 2, 1])   # NULL t ranks LAST under desc
