@@ -69,6 +69,36 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def backend_guard(timeout_s: float = 300.0) -> None:
+    """Fail FAST (honest JSON + exit 3) when the accelerator backend is
+    unreachable, instead of hanging the driver forever.
+
+    The axon TPU tunnel has been observed to wedge so hard that
+    ``jax.devices()`` blocks indefinitely; backend init runs on a daemon
+    thread here so a dead tunnel turns into a reported error line."""
+    import threading
+
+    out: dict = {}
+
+    def probe():
+        import jax
+
+        out["devices"] = [str(d) for d in jax.devices()]
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        print(json.dumps({
+            "metric": "criteo_hashed_logreg_rows_per_sec_per_chip",
+            "value": 0.0, "unit": "rows/s/chip", "vs_baseline": 0.0,
+            "error": f"backend unreachable: jax.devices() did not return "
+                     f"within {timeout_s:.0f}s (axon tunnel down?)",
+        }))
+        os._exit(3)
+    _log(f"backend: {out['devices']}")
+
+
 def gen_criteo_csv(path: str, n_rows: int, seed: int = 0) -> None:
     """Write a Criteo-shaped CSV: label + 13 skewed numerics + 26 categorical
     codes whose per-level latent effects drive the label (real CTR shape:
@@ -284,6 +314,7 @@ def main():
                     help="write a jax.profiler trace (utils.profiling."
                          "profile_trace) of the timed fit to this directory")
     args = ap.parse_args()
+    backend_guard()
     if args.profile:
         from orange3_spark_tpu.utils.profiling import profile_trace
 

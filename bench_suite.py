@@ -253,6 +253,12 @@ def main():
     ap.add_argument("--config", default="all", choices=["3", "4", "5", "all"])
     ap.add_argument("--rows-scale", type=float, default=1.0)
     args = ap.parse_args()
+    try:
+        from bench import backend_guard
+
+        backend_guard()
+    except ImportError:  # run from another cwd: skip the fast-fail probe
+        pass
     benches = {"3": bench_higgs_trees, "4": bench_movielens_als,
                "5": bench_taxi_pipeline}
     keys = ["3", "4", "5"] if args.config == "all" else [args.config]
