@@ -25,6 +25,15 @@ os.environ["XLA_FLAGS"] = (
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Tests are CPU-mesh by design and must never depend on accelerator-tunnel
+# health: out-of-tree PJRT plugin *registration* (site-injected, e.g. an
+# `.axon_site` on PYTHONPATH) can block at jax import while its transport is
+# wedged — observed in round 3 hanging `JAX_PLATFORMS=cpu jax.devices()`
+# for minutes. Drop site-injected plugin paths before jax imports.
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+sys.modules.pop("jax_plugins", None)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
