@@ -186,3 +186,57 @@ def test_hashed_checkpoint_resume_bit_identical(session, tmp_path):
     np.testing.assert_array_equal(
         np.asarray(full.theta["coef"]), np.asarray(resumed.theta["coef"])
     )
+
+
+def test_fused_replay_matches_per_step_loop(session):
+    """Epochs 2+ as one scan program (fused_replay=True + cache_device) must
+    match the per-chunk dispatch loop numerically — same ops, same order,
+    one dispatch instead of (epochs-1) x n_chunks."""
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+
+    Xall, y = _criteo_shaped(4096, seed=7)
+
+    def fit(fused: bool):
+        est = StreamingHashedLinearEstimator(
+            n_dims=1 << 12, n_dense=4, n_cat=6, epochs=4, step_size=0.05,
+            chunk_rows=1024, fused_replay=fused,
+        )
+        return est.fit_stream(
+            array_chunk_source(Xall, y, chunk_rows=1024),
+            session=session, cache_device=True,
+        )
+
+    fused, looped = fit(True), fit(False)
+    assert fused.n_steps_ == looped.n_steps_
+    np.testing.assert_allclose(
+        np.asarray(fused.theta["emb"]), np.asarray(looped.theta["emb"]),
+        rtol=2e-5, atol=2e-7,
+    )
+    np.testing.assert_allclose(
+        np.asarray(fused.theta["coef"]), np.asarray(looped.theta["coef"]),
+        rtol=2e-5, atol=2e-7,
+    )
+    pred_f, pred_l = fused.predict(Xall), looped.predict(Xall)
+    assert np.mean(pred_f == pred_l) > 0.999
+
+
+def test_fused_replay_respects_holdout(session):
+    """Holdout chunks must stay out of the fused replay scan too."""
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+
+    Xall, y = _criteo_shaped(4096, seed=8)
+    est = StreamingHashedLinearEstimator(
+        n_dims=1 << 12, n_dense=4, n_cat=6, epochs=3, step_size=0.05,
+        chunk_rows=1024, fused_replay=True,
+    )
+    st: dict = {}
+    model = est.fit_stream(
+        array_chunk_source(Xall, y, chunk_rows=1024), session=session,
+        cache_device=True, holdout_chunks=1, stage_times=st,
+    )
+    # 4 chunks, 1 held out -> 3 train chunks x 3 epochs
+    assert model.n_steps_ == 9
+    assert len(model.holdout_chunks_) == 1
+    assert "replay_fused_s" in st
+    ev = model.evaluate_device(model.holdout_chunks_)
+    assert 0.0 < ev["logloss"] < 2.0
