@@ -28,7 +28,7 @@ import numpy as np
 from orange3_spark_tpu.models._linear import lbfgs_minimize
 from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
 from orange3_spark_tpu.core.table import TpuTable
-from orange3_spark_tpu.models.base import Estimator, Model, Params
+from orange3_spark_tpu.models.base import concrete_or_none, Estimator, Model, Params
 
 
 @dataclasses.dataclass(frozen=True)
@@ -128,5 +128,5 @@ class AFTSurvivalRegression(Estimator):
             p, theta["beta"], theta["b0"], jnp.exp(theta["log_sigma"]),
             feature_indices=keep,
         )
-        model.n_iter_ = int(n_iter)
+        model.n_iter_ = concrete_or_none(n_iter, int)
         return model

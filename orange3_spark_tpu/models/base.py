@@ -65,6 +65,19 @@ class HasParams:
         self.params = params
 
 
+def concrete_or_none(x, cast=float):
+    """``cast(x)`` for concrete device scalars, ``None`` under a jit trace.
+
+    Fit methods record host-side convenience scalars (``n_iter_``,
+    ``training_cost_``) — pure diagnostics, not model state. When a fit runs
+    INSIDE a trace (staged refit, workflow/staging.py ``refit=True``), those
+    reads would force a concretization error; the honest value there is
+    "not available", not a crash."""
+    if isinstance(x, jax.core.Tracer):
+        return None
+    return cast(x)
+
+
 class Transformer(HasParams):
     """transform(table) -> table. Stateless or carrying fitted state.
 

@@ -30,7 +30,7 @@ import numpy as np
 
 from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
 from orange3_spark_tpu.core.table import TpuTable
-from orange3_spark_tpu.models.base import Estimator, Model, Params
+from orange3_spark_tpu.models.base import concrete_or_none, Estimator, Model, Params
 
 _LOG2PI = float(np.log(2.0 * np.pi))
 
@@ -207,6 +207,6 @@ class GaussianMixture(Estimator):
             k=p.k, max_iter=p.max_iter,
         )
         model = GaussianMixtureModel(p, weights, means, covs)
-        model.n_iter_ = int(n_iter)
+        model.n_iter_ = concrete_or_none(n_iter, int)
         model.log_likelihood_ = float(ll)
         return model

@@ -29,7 +29,7 @@ import numpy as np
 
 from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
 from orange3_spark_tpu.core.table import TpuTable
-from orange3_spark_tpu.models.base import Estimator, Model, Params
+from orange3_spark_tpu.models.base import concrete_or_none, Estimator, Model, Params
 
 _CANONICAL_LINK = {
     "gaussian": "identity",
@@ -289,7 +289,7 @@ class GeneralizedLinearRegression(Estimator):
         coef = beta[:d]
         intercept = beta[d] if p.fit_intercept else jnp.float32(0.0)
         model = GeneralizedLinearRegressionModel(p, coef, intercept, link, link_power)
-        model.n_iter_ = int(n_iter)
+        model.n_iter_ = concrete_or_none(n_iter, int)
         model.deviance_ = float(dev)
         model.null_deviance_ = float(null_dev)
         # dispersion (MLlib): fixed at 1 for binomial/poisson, else the

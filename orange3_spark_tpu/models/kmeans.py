@@ -28,7 +28,7 @@ import numpy as np
 
 from orange3_spark_tpu.core.domain import DiscreteVariable, Domain
 from orange3_spark_tpu.core.table import TpuTable
-from orange3_spark_tpu.models.base import Estimator, Model, Params
+from orange3_spark_tpu.models.base import concrete_or_none, Estimator, Model, Params
 
 
 @dataclasses.dataclass(frozen=True)
@@ -159,8 +159,72 @@ class KMeans(Estimator):
     ParamsCls = KMeansParams
     params: KMeansParams
 
+    def _device_init_centers(self, X, W) -> jnp.ndarray:
+        """Device-pure center init — used when the fit itself is being
+        TRACED (staged refit, workflow/staging.py): the host-sample init
+        below cannot run on tracers. Also the right shape for this
+        hardware — the eager init ships a sample device→host, the slowest
+        link on the tunneled bench host. Honors ``init_mode``: 'random' is
+        a gumbel-max uniform draw of k live rows; 'k-means||' is
+        categorical D²-sampling (kmeans++) in a fori_loop. Seeded and
+        deterministic, but a different random stream than the host init
+        (documented)."""
+        p = self.params
+        N, d = X.shape
+        live = W > 0
+        key = jax.random.PRNGKey(p.seed)
+        k0, k1 = jax.random.split(key)
+        if p.init_mode == "random":
+            # k distinct uniform live rows via gumbel-max top-k. Picks past
+            # the live count (gumbel -inf) would land on DEAD rows — the
+            # exact stranded-center failure the eager path guards against —
+            # so they are replaced by jittered duplicates of the first
+            # (live) pick, mirroring the eager path's live-center padding.
+            g = jnp.where(live, jax.random.gumbel(k0, (N,)), -jnp.inf)
+            gv, idx = jax.lax.top_k(g, p.k)
+            centers = X[idx]
+            dead = ~jnp.isfinite(gv)
+            base = X[idx[0]]                      # live whenever any row is
+            jit_ = (1e-3 * (1.0 + jnp.abs(base))
+                    * jax.random.normal(k1, centers.shape, X.dtype))
+            return jnp.where(dead[:, None], base[None, :] + jit_, centers)
+        if p.init_mode != "k-means||":
+            raise ValueError(f"unknown init_mode {p.init_mode!r}")
+        # first center: uniform over live rows via gumbel-max
+        g = jax.random.gumbel(k0, (N,))
+        i0 = jnp.argmax(jnp.where(live, g, -jnp.inf))
+        centers = jnp.zeros((p.k, d), X.dtype).at[0].set(X[i0])
+        d2 = jnp.where(live, jnp.sum((X - X[i0]) ** 2, axis=1), 0.0)
+
+        def body(c, carry):
+            centers, d2, key = carry
+            key, kc, ku = jax.random.split(key, 3)
+            mask = live & (d2 > 0)
+            logits = jnp.where(mask, jnp.log(jnp.maximum(d2, 1e-30)), -jnp.inf)
+            cat = jax.random.categorical(kc, logits)
+            # all remaining live points coincide with a seed: uniform pick
+            gu = jax.random.gumbel(ku, (N,))
+            uni = jnp.argmax(jnp.where(live, gu, -jnp.inf))
+            idx = jnp.where(jnp.any(mask), cat, uni)
+            # duplicate centers get per-coordinate jitter scaled to
+            # magnitude (same dead-center guard as kmeanspp_seed)
+            newc = X[idx] + jnp.where(
+                jnp.any(mask), 0.0,
+                1e-3 * (1.0 + jnp.abs(X[idx]))
+                * jax.random.normal(ku, (d,), X.dtype),
+            )
+            centers = centers.at[c].set(newc)
+            d2 = jnp.minimum(d2, jnp.sum((X - newc) ** 2, axis=1))
+            d2 = jnp.where(live, d2, 0.0)
+            return centers, d2, key
+
+        centers, _, _ = jax.lax.fori_loop(1, p.k, body, (centers, d2, k1))
+        return centers
+
     def _init_centers(self, table: TpuTable) -> jnp.ndarray:
         p = self.params
+        if isinstance(table.X, jax.core.Tracer):
+            return self._device_init_centers(table.X, table.W)
         rng = np.random.default_rng(p.seed)
         # sample only live rows — filtered (w=0) rows must not seed centers,
         # or a center stranded on a dead outlier never receives points and
@@ -210,8 +274,8 @@ class KMeans(Estimator):
             best = jnp.argmin(cost_v)
             centers, cost, n_iter = centers_v[best], cost_v[best], iter_v[best]
         model = KMeansModel(p, centers)
-        model.n_iter_ = int(n_iter)
-        model.training_cost_ = float(cost)
+        model.n_iter_ = concrete_or_none(n_iter, int)
+        model.training_cost_ = concrete_or_none(cost)
         return model
 
     def replace_seed(self, seed: int) -> "KMeans":

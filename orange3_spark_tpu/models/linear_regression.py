@@ -17,7 +17,7 @@ import numpy as np
 from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
 from orange3_spark_tpu.core.table import TpuTable
 from orange3_spark_tpu.models._linear import fit_linear
-from orange3_spark_tpu.models.base import Estimator, Model, Params
+from orange3_spark_tpu.models.base import concrete_or_none, Estimator, Model, Params
 from orange3_spark_tpu.ops.stats import EPS_TOTAL_WEIGHT
 
 
@@ -120,5 +120,5 @@ class LinearRegression(Estimator):
             compute_dtype=jnp.dtype(p.compute_dtype),
         )
         model = LinearRegressionModel(p, result.coef[:, 0], result.intercept[0])
-        model.n_iter_ = int(result.n_iter)
+        model.n_iter_ = concrete_or_none(result.n_iter, int)
         return model

@@ -19,7 +19,7 @@ import numpy as np
 from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
 from orange3_spark_tpu.core.table import TpuTable
 from orange3_spark_tpu.models._linear import column_inv_std, fit_linear
-from orange3_spark_tpu.models.base import Estimator, Model, Params
+from orange3_spark_tpu.models.base import concrete_or_none, Estimator, Model, Params
 
 
 @dataclasses.dataclass(frozen=True)
@@ -118,5 +118,5 @@ class LinearSVC(Estimator):
         if inv_std is not None:
             coef = coef * inv_std[:, None]
         model = LinearSVCModel(p, coef, result.intercept, class_values)
-        model.n_iter_ = int(result.n_iter)
+        model.n_iter_ = concrete_or_none(result.n_iter, int)
         return model

@@ -23,7 +23,7 @@ import numpy as np
 from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
 from orange3_spark_tpu.core.table import TpuTable
 from orange3_spark_tpu.models._linear import column_inv_std, fit_linear
-from orange3_spark_tpu.models.base import Estimator, Model, Params, infer_class_values
+from orange3_spark_tpu.models.base import concrete_or_none, Estimator, Model, Params, infer_class_values
 
 
 @dataclasses.dataclass(frozen=True)
@@ -125,5 +125,5 @@ class LogisticRegression(Estimator):
         if inv_std is not None:
             coef = coef * inv_std[:, None]  # back to original feature space
         model = LogisticRegressionModel(p, coef, result.intercept, class_values)
-        model.n_iter_ = int(result.n_iter)
+        model.n_iter_ = concrete_or_none(result.n_iter, int)
         return model

@@ -29,7 +29,7 @@ import optax
 from orange3_spark_tpu.models._linear import lbfgs_minimize
 from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
 from orange3_spark_tpu.core.table import TpuTable
-from orange3_spark_tpu.models.base import Estimator, Model, Params, infer_class_values
+from orange3_spark_tpu.models.base import concrete_or_none, Estimator, Model, Params, infer_class_values
 
 
 @dataclasses.dataclass(frozen=True)
@@ -145,6 +145,6 @@ class MultilayerPerceptronClassifier(Estimator):
             layers=layers, solver=p.solver, max_iter=p.max_iter, seed=p.seed,
         )
         model = MultilayerPerceptronClassifierModel(p, net, class_values)
-        model.n_iter_ = int(n_iter)
+        model.n_iter_ = concrete_or_none(n_iter, int)
         model.final_loss_ = float(loss)
         return model

@@ -30,24 +30,19 @@ from orange3_spark_tpu.ops.stats import EPS_TOTAL_WEIGHT
 AGG_FNS = ("sum", "mean", "count", "min", "max")
 
 
-def group_by(table: TpuTable, key, aggs: dict[str, str]) -> TpuTable:
-    """df.groupBy(keys).agg({col: fn}) with discrete key(s) → fixed-row table.
-
-    ``key``: one column name or a sequence of them (multi-key groupBy — the
-    composite key is the cross product of the categories, so the result is
-    a FIXED ∏kᵢ-row table; Spark's data-dependent group count has no
-    static-shape analogue). Output columns: each key (as its category index)
-    + one column per (col, fn) named ``fn_col``; rows ordered by composite
-    index. Groups with no live rows get count 0 and NaN for mean/min/max
-    (Spark: such groups are absent; here they stay with null-like stats).
-    """
-    keys = [key] if isinstance(key, str) else list(key)
+def _grouped_stats(table: TpuTable, keys, pairs):
+    """Shared groupBy prologue: validate discrete keys + agg columns, build
+    the row-major composite key index, and run ONE ``_group_kernel`` pass.
+    Returns (kvars, sizes, k, ucols, counts, sums, mins, maxs). Used by
+    ``group_by`` and ``rollup``/``cube`` (which fold coarser levels from
+    this finest-level pass)."""
     kvars = []
     for kname in keys:
         kvar = table.domain[kname]
         if not isinstance(kvar, DiscreteVariable) or not kvar.values:
             raise ValueError(
-                f"group_by key {kname!r} must be a DiscreteVariable with known values"
+                f"group key {kname!r} must be a DiscreteVariable "
+                f"with known values"
             )
         kvars.append(kvar)
     sizes = [len(v.values) for v in kvars]
@@ -56,18 +51,57 @@ def group_by(table: TpuTable, key, aggs: dict[str, str]) -> TpuTable:
     key_idx = jnp.zeros((table.n_pad,), jnp.int32)
     for kname, sz in zip(keys, sizes):
         key_idx = key_idx * sz + table.column(kname).astype(jnp.int32)
-    for col, fn in aggs.items():
-        if fn not in AGG_FNS:
-            raise ValueError(f"unknown agg {fn!r}; supported: {AGG_FNS}")
+    for col, _ in pairs:
         table.domain[col]  # raises KeyError on unknown column
-
-    cols = {col: table.column(col) for col in aggs}
-    out = _group_kernel(
+    ucols = list(dict.fromkeys(col for col, _ in pairs))
+    counts, sums, mins, maxs = _group_kernel(
         key_idx, table.W,
-        jnp.stack(list(cols.values()), 1) if cols else jnp.zeros((table.n_pad, 0)),
+        jnp.stack([table.column(c) for c in ucols], 1)
+        if ucols else jnp.zeros((table.n_pad, 0)),
         k,
     )
-    counts, sums, mins, maxs = out
+    return kvars, sizes, k, ucols, counts, sums, mins, maxs
+
+
+def _agg_pairs(aggs) -> list[tuple[str, str]]:
+    """Normalize an aggs spec — {col: fn} dict or ordered ((col, fn), ...)
+    pairs — into a pair list. The pair form allows MULTIPLE aggs on one
+    column (Spark's agg(sum(x), mean(x))); the dict form cannot express
+    that, which is why both are accepted."""
+    pairs = list(aggs.items()) if isinstance(aggs, dict) else [
+        (c, f) for c, f in aggs
+    ]
+    for col, fn in pairs:
+        if fn not in AGG_FNS:
+            raise ValueError(f"unknown agg {fn!r}; supported: {AGG_FNS}")
+    return pairs
+
+
+def group_by(table: TpuTable, key, aggs) -> TpuTable:
+    """df.groupBy(keys).agg(...) with discrete key(s) → fixed-row table.
+
+    ``key``: one column name or a sequence of them (multi-key groupBy — the
+    composite key is the cross product of the categories, so the result is
+    a FIXED ∏kᵢ-row table; Spark's data-dependent group count has no
+    static-shape analogue). ``key=None`` or ``[]`` is the global (no-group)
+    aggregation — one row, agg columns only (df.agg(...)). ``aggs``:
+    ``{col: fn}`` or ordered ``((col, fn), ...)`` pairs — the pair form
+    supports several aggs of the same column. Output columns: each key (as
+    its category index) + one column per (col, fn) pair named ``fn_col``;
+    rows ordered by composite index. Groups with no live rows get count 0
+    and NaN for mean/min/max (Spark: such groups are absent; here they stay
+    with null-like stats).
+    """
+    if key is None:
+        keys = []
+    else:
+        keys = [key] if isinstance(key, str) else list(key)
+    pairs = _agg_pairs(aggs)
+    if not keys and not pairs:
+        raise ValueError("group_by with no keys needs at least one agg")
+    kvars, sizes, k, ucols, counts, sums, mins, maxs = _grouped_stats(
+        table, keys, pairs
+    )
     counts_np = np.asarray(counts)
 
     # the keys keep their discrete identity (values included) so the result
@@ -78,7 +112,8 @@ def group_by(table: TpuTable, key, aggs: dict[str, str]) -> TpuTable:
     for i in range(len(keys) - 1, -1, -1):  # decompose row-major index
         data.insert(0, (composite % sizes[i]).astype(np.float32))
         composite = composite // sizes[i]
-    for j, (col, fn) in enumerate(aggs.items()):
+    for col, fn in pairs:
+        j = ucols.index(col)
         new_attrs.append(ContinuousVariable(f"{fn}_{col}"))
         if fn == "count":
             data.append(counts_np)
@@ -121,6 +156,155 @@ def _group_kernel(key_idx, W, V, k: int):
         jnp.where(live, V, -big), key_idx, num_segments=k
     )
     return counts, sums, mins, maxs
+
+
+def pivot(table: TpuTable, key, pivot_col: str, aggs: dict[str, str],
+          values=None) -> TpuTable:
+    """df.groupBy(key).pivot(pivot_col[, values]).agg({col: fn}).
+
+    One row per key group, one output column per (pivot value, agg).
+    Both the key(s) and ``pivot_col`` must be discrete: the composite
+    (key × pivot) groupBy is the SAME one-pass segment-matmul as
+    ``group_by`` — Spark's two-phase pivot query (distinct-scan to find the
+    values, then a shuffled agg) collapses to one pass because the category
+    set is already in the Domain. ``values``: optional subset of pivot
+    values to keep (Spark's explicit-values form — there it skips the
+    distinct scan, here it just selects output columns). Column naming
+    follows Spark: ``<value>`` for a single agg, ``<value>_<fn>_<col>``
+    otherwise. Key-combination rows with no live data keep count 0 /
+    NaN stats (see group_by).
+    """
+    keys = [key] if isinstance(key, str) else list(key)
+    pairs = _agg_pairs(aggs)
+    if not keys:
+        raise ValueError("pivot needs at least one group key")
+    if not pairs:
+        raise ValueError("pivot needs at least one agg")
+    pvar = table.domain[pivot_col]
+    if not isinstance(pvar, DiscreteVariable) or not pvar.values:
+        raise ValueError(
+            f"pivot column {pivot_col!r} must be a DiscreteVariable "
+            f"with known values"
+        )
+    pvals = list(pvar.values)
+    if values is not None:
+        missing = [v for v in values if v not in pvals]
+        if missing:
+            raise ValueError(
+                f"pivot values {missing} not in {pivot_col!r}'s "
+                f"categories {pvals}"
+            )
+        sel = [pvals.index(v) for v in values]
+    else:
+        sel = list(range(len(pvals)))
+
+    g = group_by(table, keys + [pivot_col], pairs)
+    gX, _, _ = g.to_numpy()
+    k_piv = len(pvals)
+    n_groups = gX.shape[0] // k_piv
+
+    # group_by rows are row-major over (keys..., pivot): row = g*k_piv + p
+    attrs: list = [
+        DiscreteVariable(kn, table.domain[kn].values) for kn in keys
+    ]
+    data = [gX[::k_piv, i] for i in range(len(keys))]
+    single = len(pairs) == 1
+    for j, (col, fn) in enumerate(pairs):
+        M = gX[:, len(keys) + 1 + j].reshape(n_groups, k_piv)
+        for pi in sel:
+            name = str(pvals[pi]) if single else f"{pvals[pi]}_{fn}_{col}"
+            attrs.append(ContinuousVariable(name))
+            data.append(M[:, pi])
+    X = np.stack(data, axis=1).astype(np.float32)
+    return TpuTable.from_numpy(Domain(attrs), X, session=table.session)
+
+
+def _grouping_levels(table: TpuTable, levels, keys, pairs) -> TpuTable:
+    """Shared rollup/cube assembly from ONE finest-level kernel pass.
+
+    Every coarser level folds out of the finest (all-keys) per-cell stats —
+    counts/sums ADD and mins/maxs fold across an aggregated-out key axis,
+    means recompute from the folded sums/counts — so the device does one
+    ``_group_kernel`` pass over the table instead of one per level (2^n for
+    cube). Key columns come back CONTINUOUS (category index, or NaN —
+    Spark's null — where a key is aggregated out)."""
+    _, sizes, _, ucols, counts, sums, mins, maxs = _grouped_stats(
+        table, keys, pairs
+    )
+    nc = len(ucols)
+    C = np.asarray(counts).reshape(sizes)
+    S = np.asarray(sums).reshape(sizes + [nc])
+    Mn = np.asarray(mins).reshape(sizes + [nc])   # empty cells hold +big
+    Mx = np.asarray(maxs).reshape(sizes + [nc])   # empty cells hold -big
+
+    parts = []
+    for level in levels:
+        axes = tuple(i for i, kn in enumerate(keys) if kn not in level)
+        c = C.sum(axis=axes)
+        s = S.sum(axis=axes)
+        mn = Mn.min(axis=axes) if axes else Mn
+        mx = Mx.max(axis=axes) if axes else Mx
+        cf, sf = c.reshape(-1), s.reshape(-1, nc)
+        mnf, mxf = mn.reshape(-1, nc), mx.reshape(-1, nc)
+        n_rows = cf.shape[0]
+        out = np.full((n_rows, len(keys) + len(pairs)), np.nan, np.float32)
+        # decompose the level's row-major composite back into key columns
+        lvl_sizes = [sizes[keys.index(kn)] for kn in level]
+        composite = np.arange(n_rows)
+        for i in range(len(level) - 1, -1, -1):
+            out[:, keys.index(level[i])] = composite % lvl_sizes[i]
+            composite = composite // lvl_sizes[i]
+        for j, (col, fn) in enumerate(pairs):
+            u = ucols.index(col)
+            if fn == "count":
+                v = cf
+            elif fn == "sum":
+                v = sf[:, u]
+            elif fn == "mean":
+                v = np.where(cf > 0,
+                             sf[:, u] / np.maximum(cf, EPS_TOTAL_WEIGHT),
+                             np.nan)
+            elif fn == "min":
+                v = np.where(cf > 0, mnf[:, u], np.nan)
+            else:
+                v = np.where(cf > 0, mxf[:, u], np.nan)
+            out[:, len(keys) + j] = v
+        parts.append(out)
+    X = np.concatenate(parts, axis=0)
+    attrs = [ContinuousVariable(kn) for kn in keys] + [
+        ContinuousVariable(f"{fn}_{col}") for col, fn in pairs
+    ]
+    return TpuTable.from_numpy(Domain(attrs), X, session=table.session)
+
+
+def rollup(table: TpuTable, keys, aggs: dict[str, str]) -> TpuTable:
+    """df.rollup(keys).agg(...): hierarchical subtotals — one block per key
+    PREFIX (all keys, then all-but-last, ..., then the grand total), key
+    columns NaN where aggregated out. Unlike Spark, empty key combinations
+    stay as count-0 rows (static shapes — see group_by)."""
+    keys = [keys] if isinstance(keys, str) else list(keys)
+    pairs = _agg_pairs(aggs)
+    if not keys or not pairs:
+        raise ValueError("rollup needs keys and at least one agg")
+    levels = [tuple(keys[:i]) for i in range(len(keys), -1, -1)]
+    return _grouping_levels(table, levels, keys, pairs)
+
+
+def cube(table: TpuTable, keys, aggs: dict[str, str]) -> TpuTable:
+    """df.cube(keys).agg(...): subtotals for EVERY key subset (2^n blocks),
+    key columns NaN where aggregated out; same empty-group semantics as
+    rollup."""
+    from itertools import combinations
+
+    keys = [keys] if isinstance(keys, str) else list(keys)
+    pairs = _agg_pairs(aggs)
+    if not keys or not pairs:
+        raise ValueError("cube needs keys and at least one agg")
+    levels = [
+        lv for r in range(len(keys), -1, -1)
+        for lv in combinations(keys, r)
+    ]
+    return _grouping_levels(table, levels, keys, pairs)
 
 
 def join(left: TpuTable, right: TpuTable, on: str, how: str = "left") -> TpuTable:

@@ -137,7 +137,7 @@ class StagedGraph:
     """
 
     def __init__(self, fn, input_keys, templates, out_domain, out_meta,
-                 session, frontier):
+                 session, frontier, refit_fallbacks=()):
         self._jitted = jax.jit(fn)
         self.input_keys = input_keys            # [(nid, port), ...] arg order
         self.templates = templates              # {(nid, port): TpuTable}
@@ -145,6 +145,9 @@ class StagedGraph:
         self._out_meta = out_meta               # (metas, n_rows) of eager sink
         self.session = session
         self.frontier = frontier                # [{node, widget, reason}]
+        # estimator nodes that stayed on closed-over fitted state under
+        # refit=True because their fit would not trace
+        self.refit_fallbacks = list(refit_fallbacks)
 
     def _flat_args(self, replacements=None):
         args = []
@@ -231,8 +234,39 @@ def _node_stage_fn(graph: WorkflowGraph, nid: int, outputs):
     return None, f"{w.name}: host-side widget (leaves the device)"
 
 
+def _refit_fn(widget):
+    """Staged fn for an estimator widget that re-FITS inside the trace."""
+    def fn(ins, w=widget):
+        est = w.estimator_cls(w.params)
+        m = est.fit(ins["data"])
+        try:
+            return m.transform(ins["data"])
+        except NotImplementedError:
+            return ins["data"]
+    return fn
+
+
+def _fit_traces(widget, template: TpuTable) -> bool:
+    """True when the widget's estimator fit+transform traces abstractly
+    (jax.eval_shape — no compile, no execution)."""
+    fn = _refit_fn(widget)
+    session = template.session
+    domain, n_rows = template.domain, template.n_rows
+
+    def probe(X, Y, W):
+        t = TpuTable(domain, X, Y, W, None, n_rows, session)
+        return fn({"data": t}).X
+
+    try:
+        jax.eval_shape(probe, template.X, template.Y, template.W)
+        return True
+    except Exception:
+        return False
+
+
 def stage_graph(
-    graph: WorkflowGraph, sink: int, sink_port: str = "data"
+    graph: WorkflowGraph, sink: int, sink_port: str = "data",
+    refit: bool = False,
 ) -> StagedGraph:
     """Fuse the whole stageable DAG feeding ``sink`` into one jitted program.
 
@@ -243,6 +277,17 @@ def stage_graph(
     every other upstream node becomes either a boundary INPUT (its cached
     table is an argument of the fused function) and is reported on the
     ``frontier`` with its reason.
+
+    ``refit=True`` is fit-IN-trace: estimator widgets whose fit traces
+    (verified per node with ``jax.eval_shape``) re-run ``fit`` on the data
+    flowing THROUGH the staged program instead of closing over the eager
+    state — so ``staged(replacements={src: new_table})`` re-fits and
+    re-scores the entire pipeline on new data in ONE dispatch (Spark's
+    Pipeline.fit + transform, one XLA computation). Estimators whose fit
+    cannot trace keep the closed-over state and are listed in
+    ``refit_fallbacks``. OWApplyModel always applies its eagerly-fitted
+    upstream model (models do not flow through the staged region as
+    signals).
     """
     outputs = graph.run()
     sink_fn, reason = _node_stage_fn(graph, sink, outputs)
@@ -290,6 +335,39 @@ def stage_graph(
 
     visit(sink)
 
+    refit_fallbacks: list = []
+    if refit:
+        for nid in list(staged):
+            node = graph.nodes[nid]
+            w = node.widget
+            if not (hasattr(w, "estimator_cls")
+                    and "model" in (node.outputs or {})):
+                continue
+            if getattr(w, "fitted_model", None) is not None:
+                # checkpoint-restored widget: its contract is serve-don't-
+                # refit (catalog.EstimatorWidget) — honoring refit here
+                # would silently replace the restored model
+                refit_fallbacks.append({
+                    "node": nid, "widget": w.name,
+                    "reason": "serving a restored fitted_model; not refit",
+                })
+                continue
+            data_edges = [
+                e for e in graph.edges
+                if e.dst == nid and e.dst_port == "data"
+            ]
+            if not data_edges:
+                continue
+            e = data_edges[0]
+            template = outputs[e.src][e.src_port]
+            if _fit_traces(w, template):
+                staged[nid] = _refit_fn(w)
+            else:
+                refit_fallbacks.append({
+                    "node": nid, "widget": w.name,
+                    "reason": "fit not traceable; kept eager fitted state",
+                })
+
     input_keys = sorted(inputs.keys())
     session = outputs[sink][sink_port].session
     topo = [n for n in graph.topo_order() if n in staged]
@@ -319,6 +397,7 @@ def stage_graph(
     return StagedGraph(
         fused, input_keys, in_templates, sink_table.domain,
         (sink_table.metas, sink_table.n_rows), session, frontier,
+        refit_fallbacks,
     )
 
 

@@ -279,3 +279,127 @@ def test_with_column_callable_and_expr(session):
     np.testing.assert_allclose(t2.to_numpy()[0][:, 2], [3.0, 5.0])
     t3 = with_column(t, "double_a", lambda tt: tt.column("a") * 2)
     np.testing.assert_allclose(t3.to_numpy()[0][:, 2], [2.0, 4.0])
+
+
+def _sales_with_quarter(session, n=200, seed=3):
+    rng = np.random.default_rng(seed)
+    region = rng.integers(0, 3, n).astype(np.float32)
+    quarter = rng.integers(0, 4, n).astype(np.float32)
+    amount = rng.gamma(2.0, 10.0, n).astype(np.float32)
+    dom = Domain([
+        DiscreteVariable("region", ("east", "west", "north")),
+        DiscreteVariable("quarter", ("q1", "q2", "q3", "q4")),
+        ContinuousVariable("amount"),
+    ])
+    X = np.stack([region, quarter, amount], 1)
+    return TpuTable.from_numpy(dom, X, session=session), region, quarter, amount
+
+
+def test_pivot_matches_pandas(session):
+    from orange3_spark_tpu.ops.relational import pivot
+
+    t, region, quarter, amount = _sales_with_quarter(session)
+    out = pivot(t, "region", "quarter", {"amount": "sum"})
+    X, _, _ = out.to_numpy()
+    assert X.shape == (3, 1 + 4)
+    names = [v.name for v in out.domain.attributes]
+    assert names == ["region", "q1", "q2", "q3", "q4"]
+    for r in range(3):
+        for q in range(4):
+            expect = amount[(region == r) & (quarter == q)].sum()
+            np.testing.assert_allclose(X[r, 1 + q], expect, rtol=1e-4)
+
+
+def test_pivot_values_subset_and_multi_agg(session):
+    from orange3_spark_tpu.ops.relational import pivot
+
+    t, region, quarter, amount = _sales_with_quarter(session)
+    out = pivot(t, "region", "quarter", {"amount": "mean"},
+                values=("q2", "q4"))
+    names = [v.name for v in out.domain.attributes]
+    assert names == ["region", "q2", "q4"]
+    X, _, _ = out.to_numpy()
+    m = amount[(region == 1) & (quarter == 3)].mean()
+    np.testing.assert_allclose(X[1, 2], m, rtol=1e-4)
+    with pytest.raises(ValueError, match="not in"):
+        pivot(t, "region", "quarter", {"amount": "sum"}, values=("q9",))
+
+
+def test_group_by_no_key_global_agg(session):
+    t, region, amount, qty = _sales_table(session)
+    out = group_by(t, None, {"amount": "sum", "qty": "count"})
+    X, _, _ = out.to_numpy()
+    assert X.shape == (1, 2)
+    np.testing.assert_allclose(X[0, 0], amount.sum(), rtol=1e-4)
+    assert X[0, 1] == len(qty)
+
+
+def test_rollup_levels_and_grand_total(session):
+    from orange3_spark_tpu.ops.relational import rollup
+
+    t, region, quarter, amount = _sales_with_quarter(session)
+    out = rollup(t, ["region", "quarter"], {"amount": "sum"})
+    X, _, _ = out.to_numpy()
+    # blocks: 12 (region x quarter) + 3 (region) + 1 (grand total)
+    assert X.shape == (12 + 3 + 1, 3)
+    grand = X[-1]
+    assert np.isnan(grand[0]) and np.isnan(grand[1])
+    np.testing.assert_allclose(grand[2], amount.sum(), rtol=1e-4)
+    # region-level block has NaN quarter and per-region sums
+    blk = X[12:15]
+    assert np.all(np.isnan(blk[:, 1]))
+    for r in range(3):
+        np.testing.assert_allclose(
+            blk[r, 2], amount[region == r].sum(), rtol=1e-4
+        )
+
+
+def test_cube_has_all_subsets(session):
+    from orange3_spark_tpu.ops.relational import cube
+
+    t, region, quarter, amount = _sales_with_quarter(session)
+    out = cube(t, ["region", "quarter"], {"amount": "count"})
+    X, _, _ = out.to_numpy()
+    # 12 + 3 (region) + 4 (quarter) + 1
+    assert X.shape == (12 + 3 + 4 + 1, 3)
+    # the quarter-only block: NaN region, real quarter
+    qblk = X[15:19]
+    assert np.all(np.isnan(qblk[:, 0]))
+    for q in range(4):
+        assert qblk[q, 2] == (quarter == q).sum()
+    assert X[-1, 2] == len(region)
+
+
+def test_group_by_multiple_aggs_same_column(session):
+    """Pair-form aggs: Spark's agg(sum(x), mean(x), count(x)) on one col."""
+    t, region, amount, qty = _sales_table(session)
+    out = group_by(
+        t, "region",
+        (("amount", "sum"), ("amount", "mean"), ("amount", "count")),
+    )
+    names = [v.name for v in out.domain.attributes]
+    assert names == ["region", "sum_amount", "mean_amount", "count_amount"]
+    X, _, _ = out.to_numpy()
+    for r in range(3):
+        sel = amount[region == r]
+        np.testing.assert_allclose(X[r, 1], sel.sum(), rtol=1e-4)
+        np.testing.assert_allclose(X[r, 2], sel.mean(), rtol=1e-4)
+        assert X[r, 3] == len(sel)
+
+
+def test_rollup_multi_agg_and_min_fold(session):
+    """min/max fold correctly across aggregated-out levels (the one-pass
+    rollup derives coarse levels from the finest cells)."""
+    from orange3_spark_tpu.ops.relational import rollup
+
+    t, region, quarter, amount = _sales_with_quarter(session)
+    out = rollup(t, ["region", "quarter"],
+                 (("amount", "min"), ("amount", "max")))
+    X, _, _ = out.to_numpy()
+    grand = X[-1]
+    np.testing.assert_allclose(grand[2], amount.min(), rtol=1e-5)
+    np.testing.assert_allclose(grand[3], amount.max(), rtol=1e-5)
+    blk = X[12:15]  # region level
+    for r in range(3):
+        np.testing.assert_allclose(blk[r, 2], amount[region == r].min(),
+                                   rtol=1e-5)
