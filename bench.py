@@ -208,6 +208,20 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
     holdout_chunks = max(min(HOLDOUT_CHUNKS, n_chunks - 1), 0)
     make_est(epochs).warm_replay(n_chunks - holdout_chunks, session=session)
 
+    # the many-epoch config is priced on FUSED replay (~30 ms/epoch device
+    # time); if the chunk cache cannot hold the dataset (plus the transient
+    # stack copy fusion needs), every extra epoch would instead re-stream
+    # or re-dispatch — fall back to the 16-epoch config LOUDLY rather than
+    # silently running a multi-hour bench
+    cache_budget = 8 << 30   # fit_stream's cache_device_bytes default
+    est_cache_bytes = (n_chunks * session.pad_rows(CHUNK_ROWS)
+                       * (1 + N_DENSE + N_CAT) * 4)
+    if epochs > 16 and 2 * est_cache_bytes > cache_budget:
+        _log(f"WARN: dataset cache ~{est_cache_bytes/1e9:.1f} GB cannot "
+             f"fuse replay within the {cache_budget/1e9:.0f} GB budget; "
+             f"reducing epochs {epochs} -> 16 for this run")
+        epochs = 16
+
     _log(f"timed fit: {epochs} epochs ...")
     stage_times: dict = {}
     est = make_est(epochs)

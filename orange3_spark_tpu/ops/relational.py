@@ -449,6 +449,54 @@ def sample(table: TpuTable, fraction: float, seed: int = 0) -> TpuTable:
     return table.with_weights(jnp.where(keep, table.W, 0.0))
 
 
+def sample_by(table: TpuTable, col: str, fractions: dict, seed: int = 0
+              ) -> TpuTable:
+    """df.stat.sampleBy(col, fractions): stratified bernoulli sample — each
+    row keeps with the probability given for ITS category of ``col``
+    (unlisted categories drop, Spark semantics). Device-pure: the per-row
+    fraction is a gather from a k-vector, folded into the weight mask like
+    ``sample``."""
+    var = table.domain[col]
+    if not isinstance(var, DiscreteVariable) or not var.values:
+        raise ValueError(f"sampleBy column {col!r} must be discrete")
+    fr = np.zeros((len(var.values),), np.float32)
+    for v, f in fractions.items():
+        if v not in var.values:
+            raise ValueError(f"fraction key {v!r} not in {col!r}'s "
+                             f"categories {list(var.values)}")
+        if not 0.0 <= f <= 1.0:
+            raise ValueError(f"fraction for {v!r} must be in [0, 1], got {f}")
+        fr[var.values.index(v)] = f
+    code = table.column(col)
+    # NaN category codes = missing values: Spark drops null-category rows,
+    # and a NaN->int cast is backend-defined — mask explicitly
+    valid = ~jnp.isnan(code)
+    idx = jnp.clip(jnp.where(valid, code, 0.0).astype(jnp.int32),
+                   0, len(fr) - 1)
+    row_frac = jnp.where(valid, jnp.take(jnp.asarray(fr), idx), 0.0)
+    u = jax.random.uniform(jax.random.PRNGKey(seed), (table.n_pad,))
+    return table.with_weights(jnp.where(u < row_frac, table.W, 0.0))
+
+
+def freq_items(table: TpuTable, cols, support: float = 0.01) -> dict:
+    """df.stat.freqItems(cols, support): per column, the categories whose
+    weighted frequency is >= support * total live weight. Spark approximates
+    with the KPS streaming sketch; discrete columns carry their full
+    category set in the Domain here, so ONE segment-sum pass per column is
+    exact."""
+    if not 1e-4 <= support <= 1.0:
+        raise ValueError(f"support must be in [1e-4, 1], got {support}")
+    cols = [cols] if isinstance(cols, str) else list(cols)
+    total = float(jnp.sum(table.W))
+    out = {}
+    for col in cols:
+        counts = value_counts(table, col)
+        out[f"{col}_freqItems"] = [
+            v for v, c in counts.items() if c >= support * total
+        ]
+    return out
+
+
 def union(a: TpuTable, b: TpuTable) -> TpuTable:
     """df.union: host re-concat (a repartition boundary, like Spark's)."""
     if a.domain != b.domain:

@@ -403,3 +403,31 @@ def test_rollup_multi_agg_and_min_fold(session):
     for r in range(3):
         np.testing.assert_allclose(blk[r, 2], amount[region == r].min(),
                                    rtol=1e-5)
+
+
+def test_sample_by_stratified_fractions(session):
+    from orange3_spark_tpu.ops.relational import sample_by
+
+    t, region, amount, qty = _sales_table(session, n=6000, seed=9)
+    out = sample_by(t, "region", {"east": 0.8, "west": 0.2}, seed=3)
+    X, _, W = out.to_numpy()
+    w = W[: len(region)]
+    for r, name, frac in ((0, "east", 0.8), (1, "west", 0.2), (2, "north", 0.0)):
+        kept = (w[region == r] > 0).mean()
+        assert abs(kept - frac) < 0.06, f"{name}: kept {kept} want {frac}"
+    with pytest.raises(ValueError, match="not in"):
+        sample_by(t, "region", {"south": 0.5})
+
+
+def test_freq_items(session):
+    from orange3_spark_tpu.ops.relational import freq_items
+
+    t, region, amount, qty = _sales_table(session, n=300, seed=10)
+    out = freq_items(t, ["region"], support=0.25)
+    counts = {r: (region == r).sum() for r in range(3)}
+    names = ("east", "west", "north")
+    expect = [names[r] for r in range(3) if counts[r] >= 0.25 * len(region)]
+    assert out["region_freqItems"] == expect
+    # every category clears a tiny support
+    assert set(freq_items(t, "region", support=1e-3)["region_freqItems"]) \
+        == set(names)
