@@ -538,7 +538,10 @@ def value_counts(table: TpuTable, col: str) -> dict[str, float]:
     if not isinstance(var, DiscreteVariable):
         raise ValueError(f"{col!r} is not discrete")
     k = len(var.values)
-    idx = table.column(col).astype(jnp.int32)
+    code = table.column(col)
+    # NaN codes = missing values: a NaN->int cast is backend-defined, so
+    # route them to -1, which one_hot zeroes (null rows count nowhere)
+    idx = jnp.where(jnp.isnan(code), -1.0, code).astype(jnp.int32)
     onehot = jax.nn.one_hot(idx, k, dtype=jnp.float32) * table.W[:, None]
     counts = np.asarray(jnp.sum(onehot, axis=0))
     return {v: float(c) for v, c in zip(var.values, counts)}

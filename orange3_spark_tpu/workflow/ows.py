@@ -47,6 +47,11 @@ _NAME_MAP = {
     "owpredictions": "OWApplyModel",
     "applymodel": "OWApplyModel",
     "testandscore": "OWMulticlassEvaluator",
+    "selectcolumns": "OWSelectColumns",
+    "owselectattributes": "OWSelectColumns",
+    "selectattributes": "OWSelectColumns",
+    "selectrows": "OWSelectRows",
+    "owselectrows": "OWSelectRows",
 }
 
 _CHANNEL_MAP = {

@@ -446,3 +446,67 @@ def test_staged_refit_logreg_and_kmeans_trace(session):
     labels = np.asarray(out.X[:, -1])[: len(X)]
     assert set(np.unique(labels)) <= set(range(4))
     assert len(np.unique(labels)) >= 2
+
+
+def test_select_widgets_and_staging(session):
+    """OWSelectColumns / OWSelectRows are device-pure transformers: they
+    run in the eager graph AND join a staged program."""
+    import numpy as np
+
+    from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.widgets.catalog import WIDGET_REGISTRY, OWTable
+    from orange3_spark_tpu.workflow.graph import WorkflowGraph
+    from orange3_spark_tpu.workflow.staging import stage_graph
+
+    rng = np.random.default_rng(4)
+    X = rng.standard_normal((300, 4)).astype(np.float32)
+    dom = Domain([ContinuousVariable(c) for c in ("a", "b", "c", "d")])
+    t = TpuTable.from_numpy(dom, X, session=session)
+
+    g = WorkflowGraph()
+    src = g.add(OWTable(t))
+    rows = g.add(WIDGET_REGISTRY["OWSelectRows"](
+        conditions=(("a", ">", 0.0), ("b", "<=", 1.0))
+    ))
+    cols = g.add(WIDGET_REGISTRY["OWSelectColumns"](columns=("a", "c")))
+    g.connect(src, "data", rows, "data")
+    g.connect(rows, "data", cols, "data")
+    out = g.run()[cols]["data"]
+    assert [v.name for v in out.domain.attributes] == ["a", "c"]
+    _, _, W = out.to_numpy()
+    live = W[:300] > 0
+    np.testing.assert_array_equal(live, (X[:, 0] > 0) & (X[:, 1] <= 1.0))
+
+    staged = stage_graph(g, cols)
+    assert staged.frontier[-1]["reason"].startswith("source")
+    out2 = staged()
+    np.testing.assert_allclose(
+        np.asarray(out.X), np.asarray(out2.X), atol=1e-6
+    )
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="unknown op"):
+        WIDGET_REGISTRY["OWSelectRows"](
+            conditions=(("a", "~", 1.0),)
+        ).process(t)
+
+
+def test_select_rows_null_semantics(session):
+    """A NaN in the compared column fails every condition, including '!='."""
+    import numpy as np
+
+    from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.widgets.catalog import SelectRows, SelectColumns
+
+    X = np.array([[1.0], [np.nan], [-1.0]], np.float32)
+    t = TpuTable.from_numpy(Domain([ContinuousVariable("a")]), X,
+                            session=session)
+    out = SelectRows(conditions=(("a", "!=", 0.0),)).transform(t)
+    _, _, W = out.to_numpy()
+    np.testing.assert_array_equal(W[:3] > 0, [True, False, True])
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="no columns"):
+        SelectColumns().transform(t)
