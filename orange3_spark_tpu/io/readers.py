@@ -58,7 +58,12 @@ def _table_from_columns(
     for name in names:
         col = columns[name]
         is_target = name == class_col
-        if np.issubdtype(col.dtype, np.number) or col.dtype == bool:
+        if isinstance(col, tuple) and col[0] == "categorical":
+            # pre-typed categorical (parquet dictionary column): the value
+            # set and code order are authoritative — no re-inference
+            _, cat_values, vals = col
+            var = DiscreteVariable(name, tuple(cat_values))
+        elif np.issubdtype(col.dtype, np.number) or col.dtype == bool:
             var = ContinuousVariable(name)
             vals = col.astype(np.float32)
         else:
@@ -114,11 +119,29 @@ def read_csv(
 
 def read_parquet(path: str, class_col: str = "", *, session=None) -> TpuTable:
     """Parquet → sharded TpuTable (spark.read.parquet role)."""
+    import pyarrow as pa
     import pyarrow.parquet as pq
 
     table = pq.read_table(path)
     names = table.column_names
-    columns = {n: table.column(n).to_numpy(zero_copy_only=False) for n in names}
+    columns = {}
+    for n in names:
+        col = table.column(n)
+        if pa.types.is_dictionary(col.type):
+            # adopt the parquet dictionary AS the category set (order
+            # preserved) instead of re-inferring from observed strings:
+            # codes round-trip exactly, absent categories survive. (Also
+            # sidesteps a pyarrow hazard: ChunkedArray.to_numpy on a
+            # dictionary column fills nulls with a neighboring value —
+            # to_pylist keeps None, to_numpy does not.)
+            c = col.combine_chunks()
+            values = tuple(str(s) for s in c.dictionary.to_pylist())
+            idx = c.indices.fill_null(-1).to_numpy(
+                zero_copy_only=False).astype(np.float32)
+            idx[idx < 0] = np.nan
+            columns[n] = ("categorical", values, idx)
+        else:
+            columns[n] = col.to_numpy(zero_copy_only=False)
     return _table_from_columns(names, columns, class_col, session)
 
 
@@ -149,6 +172,46 @@ def read_sql(query: str, database: str, class_col: str = "", *,
                 dtype=np.float32,
             )
     return _table_from_columns(names, columns, class_col, session)
+
+
+def write_parquet(table: TpuTable, path: str, *,
+                  drop_filtered: bool = True) -> None:
+    """Collect + write Parquet (df.write.parquet role; host boundary by
+    design). Discrete columns round-trip as their CATEGORY STRINGS (a
+    dictionary-encoded pyarrow column) so ``read_parquet`` reconstructs the
+    same Domain — writing raw category indices would lose the value names.
+    ``drop_filtered``: rows with zero weight (filtered out) are omitted,
+    matching what df.write after a filter produces in Spark; pass False to
+    keep them (weights are not persisted either way)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from orange3_spark_tpu.core.domain import DiscreteVariable
+
+    X, Y, W = table.to_numpy()
+    data = X if Y is None else np.concatenate([X, Y], axis=1)
+    variables = list(table.domain.attributes) + list(table.domain.class_vars)
+    if drop_filtered and W is not None:
+        data = data[W[: len(data)] > 0]
+    cols = []
+    for j, var in enumerate(variables):
+        v = data[:, j]
+        if isinstance(var, DiscreteVariable) and var.values:
+            # dictionary = the FULL category tuple in Domain order (not just
+            # the observed values): read_parquet adopts the dictionary
+            # as-is, so codes round-trip exactly even for absent categories
+            nan = ~np.isfinite(v)
+            idx = np.clip(np.where(nan, 0, v), 0, len(var.values) - 1
+                          ).astype(np.int32)
+            cols.append(pa.DictionaryArray.from_arrays(
+                pa.array(np.ma.masked_array(idx, mask=nan)),
+                pa.array(list(var.values)),
+            ))
+        else:
+            cols.append(pa.array(v))
+    pq.write_table(
+        pa.table(cols, names=[var.name for var in variables]), path
+    )
 
 
 def write_csv(table: TpuTable, path: str) -> None:

@@ -249,3 +249,60 @@ def test_native_writer_inf_roundtrip(tmp_path):
     with NativeCsvReader(p) as r:
         back = r.read_all()
     np.testing.assert_array_equal(back, data)
+
+
+def test_write_parquet_roundtrip_domain(tmp_path, session):
+    """write_parquet -> read_parquet reconstructs continuous AND discrete
+    columns (category strings round-trip through dictionary encoding),
+    drops filtered rows, and NaN-codes missing categoricals."""
+    import numpy as np
+
+    from orange3_spark_tpu.core.domain import (
+        ContinuousVariable, DiscreteVariable, Domain,
+    )
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.io.readers import read_parquet, write_parquet
+
+    rng = np.random.default_rng(0)
+    n = 257
+    region = rng.integers(0, 3, n).astype(np.float32)
+    region[5] = np.nan
+    amount = rng.gamma(2, 5, n).astype(np.float32)
+    y = rng.integers(0, 2, n).astype(np.float32)
+    dom = Domain(
+        [DiscreteVariable("region", ("east", "west", "north")),
+         ContinuousVariable("amount")],
+        DiscreteVariable("click", ("no", "yes")),
+    )
+    t = TpuTable.from_numpy(
+        dom, np.stack([region, amount], 1), y, session=session
+    )
+    t = t.filter(t.column("amount") > 1.0)
+
+    path = str(tmp_path / "t.parquet")
+    write_parquet(t, path)
+    back = read_parquet(path, class_col="click", session=session)
+
+    keep = np.asarray(t.W[:n] > 0)
+    assert back.n_rows == int(keep.sum())
+    bvars = {v.name: v for v in back.domain.attributes}
+    # full dictionary round-trip: category set AND order preserved exactly
+    assert bvars["region"].values == ("east", "west", "north")
+    Xb, Yb, _ = back.to_numpy()
+    # amounts round-trip exactly (f32 values through parquet float)
+    np.testing.assert_allclose(
+        np.sort(Xb[:, [v.name for v in back.domain.attributes].index("amount")]),
+        np.sort(amount[keep]), rtol=1e-6,
+    )
+    # the NaN categorical survives as a missing value if its row is live
+    if keep[5]:
+        ridx = [v.name for v in back.domain.attributes].index("region")
+        assert np.isnan(Xb[:, ridx]).sum() >= 1
+    # class values preserved in order
+    assert back.domain.class_vars[0].values == ("no", "yes")
+    # codes round-trip identically for live rows (no index remapping)
+    live_region = region[keep]
+    ridx = [v.name for v in back.domain.attributes].index("region")
+    got = np.sort(Xb[:, ridx][~np.isnan(Xb[:, ridx])])
+    want = np.sort(live_region[~np.isnan(live_region)])
+    np.testing.assert_array_equal(got, want)

@@ -510,3 +510,28 @@ def test_select_rows_null_semantics(session):
     import pytest as _pytest
     with _pytest.raises(ValueError, match="no columns"):
         SelectColumns().transform(t)
+
+
+def test_select_rows_by_category_name(session):
+    import numpy as np
+
+    from orange3_spark_tpu.core.domain import (
+        ContinuousVariable, DiscreteVariable, Domain,
+    )
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.widgets.catalog import SelectRows
+
+    region = np.array([0, 1, 2, 1, 0], np.float32)
+    t = TpuTable.from_numpy(
+        Domain([DiscreteVariable("region", ("east", "west", "north")),
+                ContinuousVariable("x")]),
+        np.stack([region, np.arange(5, dtype=np.float32)], 1),
+        session=session,
+    )
+    out = SelectRows(conditions=(("region", "==", "west"),)).transform(t)
+    _, _, W = out.to_numpy()
+    np.testing.assert_array_equal(W[:5] > 0, region == 1)
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="neither numeric nor a category"):
+        SelectRows(conditions=(("region", "==", "south"),)).transform(t)
