@@ -30,8 +30,11 @@ TPU-native redesign — fixed-nnz-per-row, not CSR:
 * ``cache_device=True`` retains each device-put chunk in HBM and replays it
   for epochs 2+, exactly Spark's ``dataset.persist()`` before an iterative
   fit (MLlib LogisticRegression caches its input RDD): later epochs run at
-  pure step speed with ZERO host involvement. 1B-row configs that exceed
-  ``cache_device_bytes`` keep streaming the uncached tail from the source.
+  pure step speed with ZERO host involvement. Configs that exceed
+  ``cache_device_bytes`` (the 1B-row regime) degrade to pure streaming for
+  EVERY epoch — a partial replay would reorder/double-count chunks, and a
+  CSV source cannot seek past its cached prefix, so the host parse (the
+  actual bottleneck) would be paid anyway.
 * data parallelism: rows sharded P('data'); the embedding table is
   replicated (4 MB at 2^20 x 1) and its gradient all-reduces over ICI by
   GSPMD — treeAggregate without the shuffle. A 'model'-axis sharded table
@@ -564,10 +567,11 @@ class StreamingHashedLinearEstimator(Estimator):
         """Fit over a re-iterable chunk source.
 
         cache_device: retain device-put chunks in HBM and replay them for
-          epochs 2+ (Spark's ``persist()`` before MLlib's iterative fit);
-          chunks past ``cache_device_bytes`` keep streaming from the source
-          every epoch. The cached chunk list is exposed on the returned
-          model as ``model.device_chunks_``.
+          epochs 2+ (Spark's ``persist()`` before MLlib's iterative fit).
+          If the stream outgrows ``cache_device_bytes`` the fit degrades to
+          pure streaming for every epoch (no partial replay — see the
+          module docstring). The cached chunk list is exposed on the
+          returned model as ``model.device_chunks_``.
         holdout_chunks: exclude the LAST n device batches of each epoch from
           training; with cache_device they are retained (and exposed as
           ``model.holdout_chunks_``) for ``evaluate_device``.
