@@ -381,11 +381,19 @@ class StreamingLinearEstimator(Estimator):
 
     def fit_stream(self, source: Callable[[], Iterator[Chunk]], *,
                    n_features: int, session: TpuSession | None = None,
-                   class_values: tuple | None = None, checkpointer=None):
+                   class_values: tuple | None = None, checkpointer=None,
+                   cache_device: bool = False,
+                   cache_device_bytes: int = 8 << 30):
         """checkpointer: optional utils.fault.StreamCheckpointer — snapshots
         (theta, opt_state) every N steps and, if a snapshot exists at start,
         resumes from it (skipping already-consumed batches), so a killed fit
-        restarted with the same source/params lands on identical numbers."""
+        restarted with the same source/params lands on identical numbers.
+
+        cache_device: retain device-put batches in HBM during epoch 1 and
+        replay them for epochs 2+ — skips the host re-parse/re-DMA of every
+        later epoch (the hashed estimator's ``cache_device``, per-chunk
+        replay form). Degrades to pure streaming if the stream outgrows
+        ``cache_device_bytes``."""
         p = self.params
         session = session or TpuSession.active()
         if p.loss == "logistic":
@@ -425,14 +433,43 @@ class StreamingLinearEstimator(Estimator):
         lr = jnp.float32(p.step_size)
         n_steps = 0
         last_loss = None
-        for _ in range(p.epochs):
+        cached: list = []
+        use_cache = cache_device
+        cached_bytes = 0
+
+        def run_step(Xd, yd, wd):
+            nonlocal theta, opt_state, n_steps, last_loss
+            theta, opt_state, loss = _stream_step(
+                theta, opt_state, Xd, yd, wd, reg, lr,
+                loss_kind=p.loss,
+            )
+            n_steps += 1
+            last_loss = loss
+            bound_dispatch(n_steps, loss)  # utils/dispatch.py: queue cap
+            if checkpointer is not None:
+                checkpointer.maybe_save(
+                    n_steps, {"theta": theta, "opt_state": opt_state},
+                    meta=ckpt_meta,
+                )
+
+        for epoch in range(p.epochs):
+            if epoch > 0 and use_cache:
+                # pure-HBM epoch: replay cached batches, zero host work
+                for Xd, yd, wd in cached:
+                    if n_steps < resume_from:
+                        n_steps += 1
+                        continue
+                    run_step(Xd, yd, wd)
+                continue
             for X_np, y_np, w_np in _rechunk(source(), pad_rows):
-                if n_steps < resume_from:
-                    n_steps += 1  # fast-forward past checkpointed batches
+                if n_steps < resume_from and not (epoch == 0 and use_cache):
+                    # checkpoint fast-forward BEFORE any pad/DMA work —
+                    # except while building the cache, whose batches must
+                    # land in HBM even when their step is skipped
+                    n_steps += 1
                     continue
                 # every device batch is EXACTLY pad_rows tall (last one padded
                 # with w=0): one compiled _stream_step serves the whole stream
-                n = X_np.shape[0]
                 if p.loss == "logistic" and y_np is not None and len(y_np):
                     y_max = int(y_np.max())
                     if y_max >= k:
@@ -445,18 +482,21 @@ class StreamingLinearEstimator(Estimator):
                 Xd = put_sharded(Xp, row_sh)
                 yd = put_sharded(yp, vec_sh)
                 wd = put_sharded(wp, vec_sh)
-                theta, opt_state, loss = _stream_step(
-                    theta, opt_state, Xd, yd, wd, reg, lr,
-                    loss_kind=p.loss,
-                )
-                n_steps += 1
-                last_loss = loss
-                bound_dispatch(n_steps, loss)  # utils/dispatch.py: queue cap
-                if checkpointer is not None:
-                    checkpointer.maybe_save(
-                        n_steps, {"theta": theta, "opt_state": opt_state},
-                        meta=ckpt_meta,
-                    )
+                if epoch == 0 and use_cache:
+                    sz = Xd.nbytes + yd.nbytes + wd.nbytes
+                    if cached_bytes + sz <= cache_device_bytes:
+                        cached.append((Xd, yd, wd))
+                        cached_bytes += sz
+                    else:
+                        # budget blown: partial replay would reorder —
+                        # degrade to pure streaming (same rule as the
+                        # hashed estimator)
+                        use_cache = False
+                        cached = []
+                if n_steps < resume_from:
+                    n_steps += 1  # fast-forward past checkpointed batches
+                    continue
+                run_step(Xd, yd, wd)
         model = self._wrap_model(theta, k, class_values)
         model.n_steps_ = n_steps
         model.final_loss_ = float(last_loss) if last_loss is not None else None
