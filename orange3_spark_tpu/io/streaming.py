@@ -174,6 +174,31 @@ class StreamingLinearParams(Params):
     seed: int = 0
 
 
+class _DeviceCache:
+    """Epoch-1 HBM batch cache shared by the streaming estimators — one
+    place for the budget/degrade rule: batches accumulate until ``budget``
+    bytes, after which the WHOLE cache drops and the fit degrades to pure
+    streaming (a partial replay would reorder/double-count batches).
+    ``batches`` is a plain list the owner may filter (holdout exclusion)."""
+
+    def __init__(self, enabled: bool, budget: int):
+        self.enabled = enabled
+        self.budget = budget
+        self.batches: list = []
+        self.nbytes = 0
+
+    def offer(self, batch: tuple) -> None:
+        if not self.enabled:
+            return
+        sz = sum(b.nbytes for b in batch if hasattr(b, "nbytes"))
+        if self.nbytes + sz <= self.budget:
+            self.batches.append(batch)
+            self.nbytes += sz
+        else:
+            self.enabled = False
+            self.batches = []
+
+
 def _rechunk(stream: Iterator[Chunk], rows: int) -> Iterator[tuple]:
     """Normalize a stream of (X, y[, w]) chunks of arbitrary sizes into
     batches of EXACTLY ``rows`` rows (the final one may be short) — source
@@ -305,7 +330,13 @@ class StreamingKMeans(Estimator):
         )
 
     def fit_stream(self, source: Callable[[], Iterator[Chunk]], *,
-                   n_features: int, session: TpuSession | None = None):
+                   n_features: int, session: TpuSession | None = None,
+                   cache_device: bool = False,
+                   cache_device_bytes: int = 8 << 30):
+        """cache_device: retain epoch-1 device batches in HBM and replay
+        them for epochs 2+ (skips host re-parse/re-DMA; degrades to pure
+        streaming past ``cache_device_bytes`` — same contract as the other
+        streaming estimators)."""
         from orange3_spark_tpu.models.kmeans import KMeansModel, KMeansParams
 
         p = self.params
@@ -318,10 +349,25 @@ class StreamingKMeans(Estimator):
         counts = jnp.zeros((p.k,), jnp.float32)
         decay = jnp.float32(p.decay)
         n_steps = 0
-        last_cost = None
-        for _ in range(p.epochs):
+        cache = _DeviceCache(cache_device and p.epochs > 1,
+                             cache_device_bytes)
+        for epoch in range(p.epochs):
+            if epoch > 0 and cache.enabled:
+                if centers is None:
+                    raise ValueError("stream produced no live rows")
+                # pre_seed batches were SKIPPED in epoch 1 (streamed before
+                # seeding) but streaming epochs 2+ step them (centers exist
+                # by then) — replay must step them too for exact parity
+                for Xd, wd, _pre_seed in cache.batches:
+                    centers, counts, cost = _kmeans_stream_step(
+                        centers, counts, Xd, wd, decay, k=p.k
+                    )
+                    n_steps += 1
+                    bound_dispatch(n_steps, cost)
+                continue
             for X_np, _, w_np in _rechunk(source(), pad_rows):
                 n = X_np.shape[0]
+                pre_seed = False
                 if centers is None:
                     # kmeans++ seeding on (a capped sample of) the first chunk
                     from orange3_spark_tpu.models.kmeans import kmeanspp_seed
@@ -329,17 +375,25 @@ class StreamingKMeans(Estimator):
                     live = (np.arange(n) if w_np is None
                             else np.flatnonzero(np.asarray(w_np) > 0))
                     if len(live) < 1:
-                        continue
-                    if len(live) > 8192:
-                        live = rng.choice(live, 8192, replace=False)
-                    centers = jax.device_put(
-                        kmeanspp_seed(np.asarray(X_np, np.float32)[live],
-                                      p.k, rng),
-                        session.replicated,
-                    )
+                        # no live rows to seed from: the batch is skipped
+                        # THIS epoch but must still enter the cache —
+                        # streaming epochs 2+ would step it
+                        pre_seed = True
+                    else:
+                        if len(live) > 8192:
+                            live = rng.choice(live, 8192, replace=False)
+                        centers = jax.device_put(
+                            kmeanspp_seed(np.asarray(X_np, np.float32)[live],
+                                          p.k, rng),
+                            session.replicated,
+                        )
                 Xp, _, wp = _pad_chunk(X_np, None, w_np, pad_rows, n_features)
                 Xd = put_sharded(Xp, row_sh)
                 wd = put_sharded(wp, vec_sh)
+                if epoch == 0:
+                    cache.offer((Xd, wd, pre_seed))
+                if pre_seed:
+                    continue
                 centers, counts, cost = _kmeans_stream_step(
                     centers, counts, Xd, wd, decay, k=p.k
                 )
@@ -433,9 +487,8 @@ class StreamingLinearEstimator(Estimator):
         lr = jnp.float32(p.step_size)
         n_steps = 0
         last_loss = None
-        cached: list = []
-        use_cache = cache_device
-        cached_bytes = 0
+        cache = _DeviceCache(cache_device and p.epochs > 1,
+                             cache_device_bytes)
 
         def run_step(Xd, yd, wd):
             nonlocal theta, opt_state, n_steps, last_loss
@@ -453,16 +506,16 @@ class StreamingLinearEstimator(Estimator):
                 )
 
         for epoch in range(p.epochs):
-            if epoch > 0 and use_cache:
+            if epoch > 0 and cache.enabled:
                 # pure-HBM epoch: replay cached batches, zero host work
-                for Xd, yd, wd in cached:
+                for Xd, yd, wd in cache.batches:
                     if n_steps < resume_from:
                         n_steps += 1
                         continue
                     run_step(Xd, yd, wd)
                 continue
             for X_np, y_np, w_np in _rechunk(source(), pad_rows):
-                if n_steps < resume_from and not (epoch == 0 and use_cache):
+                if n_steps < resume_from and not (epoch == 0 and cache.enabled):
                     # checkpoint fast-forward BEFORE any pad/DMA work —
                     # except while building the cache, whose batches must
                     # land in HBM even when their step is skipped
@@ -482,17 +535,8 @@ class StreamingLinearEstimator(Estimator):
                 Xd = put_sharded(Xp, row_sh)
                 yd = put_sharded(yp, vec_sh)
                 wd = put_sharded(wp, vec_sh)
-                if epoch == 0 and use_cache:
-                    sz = Xd.nbytes + yd.nbytes + wd.nbytes
-                    if cached_bytes + sz <= cache_device_bytes:
-                        cached.append((Xd, yd, wd))
-                        cached_bytes += sz
-                    else:
-                        # budget blown: partial replay would reorder —
-                        # degrade to pure streaming (same rule as the
-                        # hashed estimator)
-                        use_cache = False
-                        cached = []
+                if epoch == 0:
+                    cache.offer((Xd, yd, wd))
                 if n_steps < resume_from:
                     n_steps += 1  # fast-forward past checkpointed batches
                     continue

@@ -676,10 +676,13 @@ class StreamingHashedLinearEstimator(Estimator):
                 for c in host_chunks():
                     yield to_device(c)
 
-        cached: list = []          # device-resident training chunks
+        from orange3_spark_tpu.io.streaming import _DeviceCache
+
+        # device-resident training chunks; shared budget/degrade rule with
+        # the other streaming estimators. Enabled even at epochs=1 because
+        # the cache doubles as the model's exposed device_chunks_
+        cache = _DeviceCache(cache_device, cache_device_bytes)
         holdout: list = []         # device-resident holdout chunks
-        use_cache = cache_device   # drops to False if the budget overflows
-        cached_bytes = 0
         n_steps = 0
         last_loss = None
 
@@ -713,21 +716,13 @@ class StreamingHashedLinearEstimator(Estimator):
         )
         for epoch in range(p.epochs):
             t_epoch = time.perf_counter()
-            if epoch == 0 or not use_cache:
+            if epoch == 0 or not cache.enabled:
                 # stream from the source; a look-ahead window keeps the LAST
                 # holdout_chunks device batches out of training
                 window: list = []
                 for dev_chunk in device_chunk_iter():
-                    if epoch == 0 and use_cache:
-                        sz = dev_chunk[0].nbytes
-                        if cached_bytes + sz <= cache_device_bytes:
-                            cached.append(dev_chunk)
-                            cached_bytes += sz
-                        else:
-                            # budget blown: a partial replay would reorder /
-                            # double-count chunks — degrade to pure streaming
-                            use_cache = False
-                            cached = []
+                    if epoch == 0:
+                        cache.offer(dev_chunk)
                     if holdout_chunks > 0:
                         window.append(dev_chunk)
                         if len(window) <= holdout_chunks:
@@ -739,14 +734,17 @@ class StreamingHashedLinearEstimator(Estimator):
                     run_step(dev_chunk)
                 if epoch == 0 and holdout_chunks > 0:
                     holdout = window[-holdout_chunks:]
-                    if use_cache:
+                    if cache.enabled:
                         # the tail chunks live in the cache too — they must
                         # never be trained on in replay epochs
                         hold_ids = {id(c[0]) for c in holdout}
-                        cached = [c for c in cached if id(c[0]) not in hold_ids]
+                        cache.batches = [
+                            c for c in cache.batches
+                            if id(c[0]) not in hold_ids
+                        ]
             else:
                 # pure-HBM epoch: replay the cached chunks, no host at all
-                for dev_chunk in cached:
+                for dev_chunk in cache.batches:
                     if n_steps < resume_from:
                         n_steps += 1
                         continue
@@ -755,21 +753,23 @@ class StreamingHashedLinearEstimator(Estimator):
                 if last_loss is not None:
                     jax.block_until_ready(last_loss)  # honest epoch wall
                 epoch_walls.append(time.perf_counter() - t_epoch)
-            if (epoch == 0 and fuse_replay and use_cache and cached
-                    and 2 * cached_bytes <= cache_device_bytes):
+            if (epoch == 0 and fuse_replay and cache.enabled
+                    and cache.batches
+                    and 2 * cache.nbytes <= cache_device_bytes):
                 # remaining epochs in one program: stack the cache (HBM->
                 # HBM copy; the per-chunk list stays live for evaluate_device
                 # / bench probes) and scan
                 t_rep = time.perf_counter()
                 stacks = tuple(
-                    jnp.stack([c[i] for c in cached]) for i in range(4)
+                    jnp.stack([c[i] for c in cache.batches])
+                    for i in range(4)
                 )
                 theta, opt_state, chunk_losses = _hashed_replay_epochs(
                     theta, opt_state, *stacks, salts, reg, lr,
                     n_epochs=p.epochs - 1, **static_kw,
                 )
                 del stacks
-                n_steps += (p.epochs - 1) * len(cached)
+                n_steps += (p.epochs - 1) * len(cache.batches)
                 last_loss = chunk_losses[-1, -1]
                 jax.block_until_ready(last_loss)
                 replay_fused_s = time.perf_counter() - t_rep
@@ -790,7 +790,7 @@ class StreamingHashedLinearEstimator(Estimator):
         )
         model.n_steps_ = n_steps
         model.final_loss_ = float(last_loss) if last_loss is not None else None
-        model.device_chunks_ = cached if cache_device else None
+        model.device_chunks_ = cache.batches if cache_device else None
         model.holdout_chunks_ = holdout if holdout_chunks > 0 else None
         if checkpointer is not None:
             checkpointer.delete()

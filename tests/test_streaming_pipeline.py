@@ -324,3 +324,32 @@ def test_dense_streaming_cache_budget_overflow_degrades(session):
     np.testing.assert_array_equal(
         np.asarray(m_over.coef), np.asarray(m_plain.coef)
     )
+
+
+def test_streaming_kmeans_cache_device_matches_streaming(session):
+    import numpy as np
+
+    from orange3_spark_tpu.io.streaming import (
+        StreamingKMeans, array_chunk_source,
+    )
+
+    rng = np.random.default_rng(8)
+    centers_true = rng.normal(0, 6, (3, 4)).astype(np.float32)
+    X = np.concatenate([
+        centers_true[i] + rng.standard_normal((500, 4)).astype(np.float32)
+        for i in range(3)
+    ])
+    rng.shuffle(X)
+    src = array_chunk_source(X, None, chunk_rows=256)
+
+    def fit(cache):
+        return StreamingKMeans(k=3, epochs=3, chunk_rows=256, seed=1
+                               ).fit_stream(src, n_features=4,
+                                            session=session,
+                                            cache_device=cache)
+
+    m_c, m_s = fit(True), fit(False)
+    assert m_c.n_iter_ == m_s.n_iter_
+    np.testing.assert_array_equal(
+        np.asarray(m_c.centers), np.asarray(m_s.centers)
+    )
