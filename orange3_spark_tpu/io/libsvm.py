@@ -1,0 +1,154 @@
+"""libsvm / svmlight reader — MLlib's canonical sparse format.
+
+``spark.read.format("libsvm")`` is the standard MLlib data entry point for
+sparse features (SURVEY.md §2b "Data ingest"; reconstructed, mount empty).
+Lines look like ``label idx:val idx:val ...`` with 1-BASED ascending
+indices (MLlib convention; ``zero_based=True`` accepts 0-based files).
+
+TPU-native mapping — two shapes, both static:
+
+* ``read_libsvm`` densifies to a ``TpuTable`` — right for the moderate
+  widths the dense estimators take (HIGGS, taxi). Feature count comes from
+  the file header scan or an explicit ``n_features``.
+* ``libsvm_chunk_source`` yields FIXED-NNZ rows for the hashed-sparse
+  streaming path: each row's (index, value) pairs are truncated/padded to
+  ``nnz_per_row`` slots, emitted as ``[n, 1 + 2*nnz]`` f32 chunks
+  (label, idx..., val...). Fixed nnz is this framework's sparse
+  representation (models/hashed_linear.py — Criteo's fixed 26 slots is the
+  same idea), so ragged libsvm rows become one compiled step instead of
+  CSR's data-dependent shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator
+
+import numpy as np
+
+
+def _parse_lines(lines, zero_based: bool):
+    """(labels, list-of-(idx array, val array)) for one batch of lines."""
+    labels: list = []
+    rows: list = []
+    off = 0 if zero_based else 1
+    for ln in lines:
+        # svmlight allows trailing '# info' comments; '#' cannot occur in
+        # label or idx:val tokens, so truncating at the first '#' is safe
+        ln = ln.split("#", 1)[0].strip()
+        if not ln:
+            continue
+        parts = ln.split()
+        labels.append(float(parts[0]))
+        idx = np.empty(len(parts) - 1, np.int64)
+        val = np.empty(len(parts) - 1, np.float32)
+        for j, tok in enumerate(parts[1:]):
+            i, _, v = tok.partition(":")
+            idx[j] = int(i) - off
+            val[j] = float(v)
+        if np.any(idx < 0):
+            raise ValueError(
+                f"libsvm index < {off} in line {ln[:60]!r} — "
+                f"pass zero_based=True for 0-based files"
+            )
+        rows.append((idx, val))
+    return labels, rows
+
+
+def read_libsvm(path: str, *, n_features: int | None = None,
+                zero_based: bool = False, class_col: str = "label",
+                session=None):
+    """Whole-file libsvm → dense ``TpuTable`` (labels as the class var)."""
+    from orange3_spark_tpu.core.domain import (
+        ContinuousVariable, Domain,
+    )
+    from orange3_spark_tpu.core.table import TpuTable
+
+    with open(path) as f:
+        labels, rows = _parse_lines(f, zero_based)
+    if not rows:
+        raise ValueError(f"{path!r} contains no libsvm rows")
+    d = n_features or int(max(
+        (int(idx.max()) + 1 if len(idx) else 0) for idx, _ in rows
+    ))
+    X = np.zeros((len(rows), d), np.float32)
+    for r, (idx, val) in enumerate(rows):
+        if len(idx) and idx.max() >= d:
+            raise ValueError(
+                f"libsvm index {int(idx.max()) + (0 if zero_based else 1)} "
+                f"exceeds n_features={d} (row {r})"
+            )
+        X[r, idx] = val
+    y = np.asarray(labels, np.float32)
+    domain = Domain(
+        [ContinuousVariable(f"f{i}") for i in range(d)],
+        ContinuousVariable(class_col),
+    )
+    return TpuTable.from_numpy(domain, X, y, session=session)
+
+
+def write_libsvm(table, path: str, *, zero_based: bool = False) -> None:
+    """Dense ``TpuTable`` → libsvm file (MLUtils.saveAsLibSVMFile role):
+    one line per LIVE row, nonzero features only, 1-based indices unless
+    ``zero_based``. Label column = the table's class var (0.0 if absent)."""
+    X, Y, W = table.to_numpy()
+    off = 0 if zero_based else 1
+    n = table.n_rows
+    with open(path, "w") as f:
+        for r in range(n):
+            if W is not None and W[r] <= 0:
+                continue
+            lab = float(Y[r, 0]) if Y is not None else 0.0
+            nz = np.flatnonzero(X[r])
+            pairs = " ".join(f"{i + off}:{X[r, i]:.9g}" for i in nz)
+            f.write(f"{lab:.9g} {pairs}\n".rstrip() + "\n")
+
+
+def libsvm_chunk_source(
+    path: str, *, nnz_per_row: int, chunk_rows: int = 1 << 18,
+    zero_based: bool = False,
+) -> Callable[[], Iterator[np.ndarray]]:
+    """Re-iterable source of fixed-nnz ``[n, 1 + 2*nnz_per_row]`` f32
+    chunks: column 0 = label, then nnz index slots, then nnz value slots.
+    Rows with fewer than ``nnz_per_row`` pairs pad with index -1 / value 0
+    (hash-path consumers route -1 to a dead bucket or mask on value==0);
+    longer rows truncate (highest-index pairs drop last). Pairs with
+    ``label_in_chunk``-style estimators the way ``csv_raw_chunk_source``
+    does for fixed-width CSV."""
+    if nnz_per_row < 1:
+        raise ValueError(f"nnz_per_row must be >= 1, got {nnz_per_row}")
+
+    def open_stream() -> Iterator[np.ndarray]:
+        with open(path) as f:
+            buf: list = []
+            while True:
+                lines = f.readlines(1 << 22)
+                if not lines and not buf:
+                    return
+                labels, rows = _parse_lines(lines, zero_based) if lines \
+                    else ([], [])
+                for lab, (idx, val) in zip(labels, rows):
+                    if len(idx) and idx.max() >= 1 << 24:
+                        # indices travel as f32 in the chunk; 2^24 is the
+                        # last exactly-representable integer — beyond it
+                        # distinct features would silently merge
+                        raise ValueError(
+                            f"libsvm index {int(idx.max())} >= 2^24 cannot "
+                            f"travel exactly in a float32 chunk — use "
+                            f"read_libsvm or pre-hash the indices"
+                        )
+                    row = np.zeros((1 + 2 * nnz_per_row,), np.float32)
+                    row[0] = lab
+                    row[1:1 + nnz_per_row] = -1.0
+                    m = min(len(idx), nnz_per_row)
+                    row[1:1 + m] = idx[:m].astype(np.float32)
+                    row[1 + nnz_per_row:1 + nnz_per_row + m] = val[:m]
+                    buf.append(row)
+                    if len(buf) == chunk_rows:
+                        yield np.stack(buf)
+                        buf = []
+                if not lines:
+                    if buf:
+                        yield np.stack(buf)
+                    return
+
+    return open_stream

@@ -190,13 +190,29 @@ class _DeviceCache:
     def offer(self, batch: tuple) -> None:
         if not self.enabled:
             return
-        sz = sum(b.nbytes for b in batch if hasattr(b, "nbytes"))
+        sz = self._size(batch)
         if self.nbytes + sz <= self.budget:
             self.batches.append(batch)
             self.nbytes += sz
         else:
             self.enabled = False
             self.batches = []
+
+    @staticmethod
+    def _size(batch: tuple) -> int:
+        return sum(b.nbytes for b in batch if hasattr(b, "nbytes"))
+
+    def exclude(self, drop_ids: set) -> None:
+        """Remove batches whose FIRST element's id() is in ``drop_ids``,
+        keeping ``nbytes`` accurate (holdout exclusion must not leave the
+        budget accounting stale — downstream gates read nbytes)."""
+        kept = []
+        for b in self.batches:
+            if id(b[0]) in drop_ids:
+                self.nbytes -= self._size(b)
+            else:
+                kept.append(b)
+        self.batches = kept
 
 
 def _rechunk(stream: Iterator[Chunk], rows: int) -> Iterator[tuple]:
@@ -379,6 +395,8 @@ class StreamingKMeans(Estimator):
                         # THIS epoch but must still enter the cache —
                         # streaming epochs 2+ would step it
                         pre_seed = True
+                        if not cache.enabled:
+                            continue  # pure streaming: skip pad/DMA too
                     else:
                         if len(live) > 8192:
                             live = rng.choice(live, 8192, replace=False)

@@ -736,12 +736,9 @@ class StreamingHashedLinearEstimator(Estimator):
                     holdout = window[-holdout_chunks:]
                     if cache.enabled:
                         # the tail chunks live in the cache too — they must
-                        # never be trained on in replay epochs
-                        hold_ids = {id(c[0]) for c in holdout}
-                        cache.batches = [
-                            c for c in cache.batches
-                            if id(c[0]) not in hold_ids
-                        ]
+                        # never be trained on in replay epochs (exclude()
+                        # keeps nbytes honest for the fuse_replay gate)
+                        cache.exclude({id(c[0]) for c in holdout})
             else:
                 # pure-HBM epoch: replay the cached chunks, no host at all
                 for dev_chunk in cache.batches:

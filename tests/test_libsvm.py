@@ -1,0 +1,76 @@
+"""libsvm reader/writer/chunk-source (spark.read.format('libsvm') role)."""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.io.libsvm import (
+    libsvm_chunk_source,
+    read_libsvm,
+    write_libsvm,
+)
+
+
+def _write(path, lines):
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def test_read_libsvm_dense(tmp_path, session):
+    p = _write(tmp_path / "a.svm", [
+        "1 1:0.5 3:2.0",
+        "0 2:1.5",
+        "# comment",
+        "1 1:1.0 2:1.0 3:1.0",
+    ])
+    t = read_libsvm(p, session=session)
+    X, Y, _ = t.to_numpy()
+    np.testing.assert_allclose(
+        X, [[0.5, 0.0, 2.0], [0.0, 1.5, 0.0], [1.0, 1.0, 1.0]]
+    )
+    np.testing.assert_allclose(Y[:, 0], [1, 0, 1])
+
+
+def test_read_libsvm_zero_based_and_errors(tmp_path, session):
+    p = _write(tmp_path / "z.svm", ["1 0:2.0 2:3.0"])
+    t = read_libsvm(p, zero_based=True, session=session)
+    X, _, _ = t.to_numpy()
+    np.testing.assert_allclose(X, [[2.0, 0.0, 3.0]])
+    with pytest.raises(ValueError, match="zero_based"):
+        read_libsvm(p, session=session)  # 1-based parse of a 0-based file
+
+
+def test_write_read_roundtrip(tmp_path, session):
+    from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+    from orange3_spark_tpu.core.table import TpuTable
+
+    rng = np.random.default_rng(0)
+    X = (rng.standard_normal((40, 6)) * (rng.random((40, 6)) > 0.6)
+         ).astype(np.float32)
+    y = rng.integers(0, 2, 40).astype(np.float32)
+    dom = Domain([ContinuousVariable(f"f{i}") for i in range(6)],
+                 ContinuousVariable("label"))
+    t = TpuTable.from_numpy(dom, X, y, session=session)
+    t = t.filter(t.column("f0") <= 10.0)  # all live; exercise the mask path
+    p = str(tmp_path / "rt.svm")
+    write_libsvm(t, p)
+    back = read_libsvm(p, n_features=6, session=session)
+    Xb, Yb, _ = back.to_numpy()
+    np.testing.assert_allclose(Xb, X, rtol=1e-6)
+    np.testing.assert_allclose(Yb[:, 0], y)
+
+
+def test_libsvm_chunk_source_fixed_nnz(tmp_path, session):
+    p = _write(tmp_path / "c.svm", [
+        "1 1:10 2:20 3:30",
+        "0 5:50",
+        "1 1:1 2:2 3:3 4:4",     # truncates to nnz=3
+    ])
+    src = libsvm_chunk_source(p, nnz_per_row=3, chunk_rows=2)
+    chunks = list(src())
+    assert [c.shape for c in chunks] == [(2, 7), (1, 7)]
+    c0 = chunks[0]
+    np.testing.assert_allclose(c0[0], [1, 0, 1, 2, 10, 20, 30])
+    np.testing.assert_allclose(c0[1], [0, 4, -1, -1, 50, 0, 0])
+    np.testing.assert_allclose(chunks[1][0], [1, 0, 1, 2, 1, 2, 3])
+    # re-iterable
+    assert len(list(src())) == 2

@@ -353,3 +353,42 @@ def test_streaming_kmeans_cache_device_matches_streaming(session):
     np.testing.assert_array_equal(
         np.asarray(m_c.centers), np.asarray(m_s.centers)
     )
+
+
+def test_streaming_kmeans_cache_preseed_and_overflow(session):
+    """The subtle cache paths: (a) a leading all-dead batch is skipped in
+    epoch 1 but stepped by later epochs — cached and streamed fits must
+    agree; (b) a budget below one batch degrades to pure streaming."""
+    import numpy as np
+
+    from orange3_spark_tpu.io.streaming import (
+        StreamingKMeans, array_chunk_source,
+    )
+
+    rng = np.random.default_rng(9)
+    X = np.concatenate([
+        rng.normal(i * 8, 1, (300, 3)).astype(np.float32) for i in range(2)
+    ])
+    rng.shuffle(X)
+    w = np.ones(len(X), np.float32)
+    w[:128] = 0.0   # first rechunked batch is entirely dead
+
+    src = array_chunk_source(X, None, w, chunk_rows=128)
+
+    def fit(cache, budget=8 << 30):
+        return StreamingKMeans(k=2, epochs=3, chunk_rows=128, seed=2
+                               ).fit_stream(src, n_features=3,
+                                            session=session,
+                                            cache_device=cache,
+                                            cache_device_bytes=budget)
+
+    m_c, m_s = fit(True), fit(False)
+    assert m_c.n_iter_ == m_s.n_iter_
+    np.testing.assert_array_equal(
+        np.asarray(m_c.centers), np.asarray(m_s.centers)
+    )
+    m_o = fit(True, budget=64)   # smaller than one batch: degrade
+    assert m_o.n_iter_ == m_s.n_iter_
+    np.testing.assert_array_equal(
+        np.asarray(m_o.centers), np.asarray(m_s.centers)
+    )
