@@ -110,10 +110,11 @@ def libsvm_chunk_source(
     """Re-iterable source of fixed-nnz ``[n, 1 + 2*nnz_per_row]`` f32
     chunks: column 0 = label, then nnz index slots, then nnz value slots.
     Rows with fewer than ``nnz_per_row`` pairs pad with index -1 / value 0
-    (hash-path consumers route -1 to a dead bucket or mask on value==0);
-    longer rows truncate (highest-index pairs drop last). Pairs with
-    ``label_in_chunk``-style estimators the way ``csv_raw_chunk_source``
-    does for fixed-width CSV."""
+    (inert under value weighting: value 0 contributes nothing forward or
+    backward); longer rows truncate. The consumer is
+    ``StreamingHashedLinearEstimator(value_weighted=True, n_dense=0,
+    n_cat=nnz_per_row, label_in_chunk=True)`` — MLlib SparseVector
+    semantics, forward = sum(emb[hash(idx)] * val)."""
     if nnz_per_row < 1:
         raise ValueError(f"nnz_per_row must be >= 1, got {nnz_per_row}")
 

@@ -83,6 +83,12 @@ class HashedLinearParams(Params):
     prefetch_depth: int = 2       # host->device pipeline depth (0 disables)
     emb_update: str = "fused"    # 'fused' | 'per_column' | 'sorted' scatter
     fused_replay: bool = True    # cache replay epochs as ONE scan program
+    # value-weighted sparse rows (MLlib SparseVector semantics): chunks
+    # carry n_cat (index, value) PAIRS — [label?, idx..., val...] — and the
+    # forward is sum(emb[hash(idx)] * val), io/libsvm.py's fixed-nnz
+    # layout. Requires n_dense == 0; -1 index padding is inert because its
+    # value is 0 (zero forward contribution, zero gradient).
+    value_weighted: bool = False
 
 
 def _effective_k(p: HashedLinearParams) -> int:
@@ -132,21 +138,61 @@ def _emb_sum_sorted_bwd(res, g):
 _emb_sum_sorted_grad.defvjp(_emb_sum_sorted_fwd, _emb_sum_sorted_bwd)
 
 
-def _hashed_logits(theta, dense, idx, compute_dtype, emb_update: str = "fused"):
+@jax.custom_vjp
+def _emb_wsum_sorted_grad(emb, idx, vals):
+    """Value-weighted twin of ``_emb_sum_sorted_grad``: forward
+    sum(emb[idx] * vals), backward sorts (index, g*val) pairs into a
+    conflict-free scatter. vals gets no gradient (data, not parameters)."""
+    return jnp.sum(
+        jnp.take(emb, idx, axis=0) * vals[:, :, None], axis=1,
+        dtype=jnp.float32,
+    )
+
+
+def _emb_wsum_sorted_fwd(emb, idx, vals):
+    proto = jnp.zeros((0,), emb.dtype)
+    return _emb_wsum_sorted_grad(emb, idx, vals), (idx, vals, emb.shape, proto)
+
+
+def _emb_wsum_sorted_bwd(res, g):
+    idx, vals, (D, k), proto = res
+    dtype = proto.dtype
+    N, C = idx.shape
+    flat_idx = idx.reshape(-1)
+    flat_g = (g[:, None, :] * vals[:, :, None]).reshape(N * C, k)
+    order = jnp.argsort(flat_idx)
+    grad = jnp.zeros((D, k), dtype).at[flat_idx[order]].add(
+        flat_g[order].astype(dtype),
+        indices_are_sorted=True, unique_indices=False,
+    )
+    return grad, None, None
+
+
+_emb_wsum_sorted_grad.defvjp(_emb_wsum_sorted_fwd, _emb_wsum_sorted_bwd)
+
+
+def _hashed_logits(theta, dense, idx, compute_dtype, emb_update: str = "fused",
+                   vals=None):
     """emb_update selects the gather/scatter formulation — all numerically
     identical, different XLA lowerings (the step is scatter-bound; see
     tools/step_ab.py for the on-hardware A/B):
       'fused'      one [N, C] gather; autodiff emits one fused scatter
       'per_column' C independent [N] gathers/scatters
       'sorted'     custom-vjp backward: sort pairs, conflict-free scatter
+    ``vals`` (value-weighted sparse mode): per-pair multipliers — the
+    forward becomes sum(emb[idx] * val), MLlib SparseVector semantics.
     """
     emb = theta["emb"].astype(compute_dtype)
     if emb_update == "per_column":
         logits = jnp.zeros((idx.shape[0], emb.shape[1]), jnp.float32)
         for c in range(idx.shape[1]):
-            logits = logits + jnp.take(emb, idx[:, c], axis=0)
+            col = jnp.take(emb, idx[:, c], axis=0)
+            if vals is not None:
+                col = col * vals[:, c, None]
+            logits = logits + col
     elif emb_update == "sorted":
-        logits = _emb_sum_sorted_grad(emb, idx)
+        logits = (_emb_sum_sorted_grad(emb, idx) if vals is None
+                  else _emb_wsum_sorted_grad(emb, idx, vals))
     elif emb_update != "fused":
         raise ValueError(
             f"emb_update must be 'fused' | 'per_column' | 'sorted', "
@@ -154,6 +200,8 @@ def _hashed_logits(theta, dense, idx, compute_dtype, emb_update: str = "fused"):
         )
     else:
         emb_rows = jnp.take(emb, idx, axis=0)
+        if vals is not None:
+            emb_rows = emb_rows * vals[:, :, None]
         logits = jnp.sum(emb_rows, axis=1, dtype=jnp.float32)    # [N, k]
     if theta["coef"].shape[0]:
         logits = logits + jnp.dot(
@@ -164,37 +212,44 @@ def _hashed_logits(theta, dense, idx, compute_dtype, emb_update: str = "fused"):
     return logits + theta["intercept"]
 
 
-def _split_chunk(Xall, n_valid, y, w, *, label_in_chunk: bool, n_dense: int):
+def _split_chunk(Xall, n_valid, y, w, *, label_in_chunk: bool, n_dense: int,
+                 value_weighted: bool = False):
     """In-jit chunk anatomy. label_in_chunk: column 0 is the label and the
-    row mask is iota < n_valid (no y/w host vectors shipped at all)."""
+    row mask is iota < n_valid (no y/w host vectors shipped at all).
+    value_weighted: the feature block is C (index, value) PAIRS —
+    [idx..., val...] — instead of dense+categorical columns."""
     if label_in_chunk:
         yv = Xall[:, 0]
-        dense = Xall[:, 1:1 + n_dense]
-        cats = Xall[:, 1 + n_dense:]
+        feat = Xall[:, 1:]
         wv = (jnp.arange(Xall.shape[0], dtype=jnp.int32)
               < n_valid).astype(jnp.float32)
     else:
         yv = y
-        dense = Xall[:, :n_dense]
-        cats = Xall[:, n_dense:]
+        feat = Xall
         wv = w
-    return yv, dense, cats, wv
+    if value_weighted:
+        C = feat.shape[1] // 2
+        return yv, feat[:, :0], feat[:, :C], wv, feat[:, C:]
+    return yv, feat[:, :n_dense], feat[:, n_dense:], wv, None
 
 
 def _step_core(
     theta, opt_state, Xall, n_valid, y, w, salts, reg, lr,
     *, loss_kind: str, n_dims: int, n_dense: int, compute_dtype=jnp.float32,
     label_in_chunk: bool = False, emb_update: str = "fused",
+    value_weighted: bool = False,
 ):
     """One adam step on one chunk — traced by both the per-chunk jit
     (`_hashed_step`) and the fused replay scan (`_hashed_replay_epochs`)."""
-    yv, dense, cats, wv = _split_chunk(
-        Xall, n_valid, y, w, label_in_chunk=label_in_chunk, n_dense=n_dense
+    yv, dense, cats, wv, vals = _split_chunk(
+        Xall, n_valid, y, w, label_in_chunk=label_in_chunk, n_dense=n_dense,
+        value_weighted=value_weighted,
     )
     idx = hash_columns(cats, salts, n_dims)
 
     def loss_fn(theta):
-        logits = _hashed_logits(theta, dense, idx, compute_dtype, emb_update)
+        logits = _hashed_logits(theta, dense, idx, compute_dtype, emb_update,
+                                vals)
         row = per_row_loss(loss_kind, logits, yv)
         sw = jnp.maximum(jnp.sum(wv), EPS_TOTAL_WEIGHT)
         data = jnp.sum(row * wv) / sw
@@ -212,7 +267,7 @@ def _step_core(
     jax.jit,
     static_argnames=(
         "loss_kind", "n_dims", "n_dense", "compute_dtype", "label_in_chunk",
-        "emb_update",
+        "emb_update", "value_weighted",
     ),
     donate_argnums=(0, 1),
 )
@@ -220,12 +275,13 @@ def _hashed_step(
     theta, opt_state, Xall, n_valid, y, w, salts, reg, lr,
     *, loss_kind: str, n_dims: int, n_dense: int, compute_dtype=jnp.float32,
     label_in_chunk: bool = False, emb_update: str = "fused",
+    value_weighted: bool = False,
 ):
     return _step_core(
         theta, opt_state, Xall, n_valid, y, w, salts, reg, lr,
         loss_kind=loss_kind, n_dims=n_dims, n_dense=n_dense,
         compute_dtype=compute_dtype, label_in_chunk=label_in_chunk,
-        emb_update=emb_update,
+        emb_update=emb_update, value_weighted=value_weighted,
     )
 
 
@@ -233,14 +289,15 @@ def _hashed_step(
     jax.jit,
     static_argnames=(
         "loss_kind", "n_dims", "n_dense", "compute_dtype", "label_in_chunk",
-        "emb_update", "n_epochs",
+        "emb_update", "value_weighted", "n_epochs",
     ),
     donate_argnums=(0, 1),
 )
 def _hashed_replay_epochs(
     theta, opt_state, Xstack, n_valid_vec, ystack, wstack, salts, reg, lr,
     *, loss_kind: str, n_dims: int, n_dense: int, compute_dtype=jnp.float32,
-    label_in_chunk: bool = False, emb_update: str = "fused", n_epochs: int,
+    label_in_chunk: bool = False, emb_update: str = "fused",
+    value_weighted: bool = False, n_epochs: int,
 ):
     """Epochs 2+ of a cached fit as ONE XLA program: an epoch-level scan
     around a chunk-level scan over the HBM-resident chunk stack.
@@ -255,7 +312,7 @@ def _hashed_replay_epochs(
     """
     kw = dict(loss_kind=loss_kind, n_dims=n_dims, n_dense=n_dense,
               compute_dtype=compute_dtype, label_in_chunk=label_in_chunk,
-              emb_update=emb_update)
+              emb_update=emb_update, value_weighted=value_weighted)
 
     def chunk_body(carry, xs):
         theta, opt = carry
@@ -279,8 +336,14 @@ def _hashed_replay_epochs(
     return theta, opt_state, chunk_losses
 
 
-@partial(jax.jit, static_argnames=("n_dims", "n_dense"))
-def _hashed_predict(theta, Xall, salts, *, n_dims: int, n_dense: int):
+@partial(jax.jit, static_argnames=("n_dims", "n_dense", "value_weighted"))
+def _hashed_predict(theta, Xall, salts, *, n_dims: int, n_dense: int,
+                    value_weighted: bool = False):
+    if value_weighted:
+        C = Xall.shape[1] // 2
+        idx = hash_columns(Xall[:, :C], salts, n_dims)
+        return _hashed_logits(theta, Xall[:, :0], idx, jnp.float32,
+                              vals=Xall[:, C:])
     dense = Xall[:, :n_dense]
     idx = hash_columns(Xall[:, n_dense:], salts, n_dims)
     return _hashed_logits(theta, dense, idx, jnp.float32)
@@ -288,21 +351,24 @@ def _hashed_predict(theta, Xall, salts, *, n_dims: int, n_dense: int):
 
 @partial(
     jax.jit,
-    static_argnames=("loss_kind", "n_dims", "n_dense", "label_in_chunk"),
+    static_argnames=("loss_kind", "n_dims", "n_dense", "label_in_chunk",
+                     "value_weighted"),
 )
 def _hashed_eval_chunk(
     theta, Xall, n_valid, y, w, salts,
     *, loss_kind: str, n_dims: int, n_dense: int, label_in_chunk: bool,
+    value_weighted: bool = False,
 ):
     """Device-side eval accumulators for one chunk: (weighted logloss sum,
     weighted correct sum, weight sum, pos/neg score histograms for AUC).
     Nothing but these small arrays ever crosses back to the host — device->
     host bandwidth is the scarcest resource in the whole pipeline."""
-    yv, dense, cats, wv = _split_chunk(
-        Xall, n_valid, y, w, label_in_chunk=label_in_chunk, n_dense=n_dense
+    yv, dense, cats, wv, vals = _split_chunk(
+        Xall, n_valid, y, w, label_in_chunk=label_in_chunk, n_dense=n_dense,
+        value_weighted=value_weighted,
     )
     idx = hash_columns(cats, salts, n_dims)
-    logits = _hashed_logits(theta, dense, idx, jnp.float32)
+    logits = _hashed_logits(theta, dense, idx, jnp.float32, vals=vals)
     row = per_row_loss(loss_kind, logits, yv)
     loss_sum = jnp.sum(row * wv)
     if loss_kind == "binary_logistic":
@@ -355,6 +421,7 @@ class HashedLinearModel(Model):
         out = _hashed_predict(
             self.theta, jnp.asarray(Xall, jnp.float32),
             jnp.asarray(self.salts), n_dims=p.n_dims, n_dense=p.n_dense,
+            value_weighted=p.value_weighted,
         )
         return np.asarray(out)
 
@@ -428,6 +495,7 @@ class HashedLinearModel(Model):
                 self.theta, Xd, n_valid, yd, wd, salts,
                 loss_kind=kind, n_dims=p.n_dims, n_dense=p.n_dense,
                 label_in_chunk=p.label_in_chunk,
+                value_weighted=p.value_weighted,
             )
             tot = out if tot is None else tuple(
                 a + b for a, b in zip(tot, out)
@@ -447,6 +515,14 @@ class HashedLinearModel(Model):
             if auc is not None:
                 out["auc"] = auc
         return out
+
+
+def _chunk_cols(p: HashedLinearParams) -> int:
+    """Expected chunk width — THE one place that knows the layout:
+    [label?] + (idx..., val...) pairs in value-weighted mode, or
+    [label?] + dense + categorical columns otherwise."""
+    return ((2 if p.value_weighted else 1) * p.n_cat + p.n_dense
+            + (1 if p.label_in_chunk else 0))
 
 
 def _init_fit_state(p: HashedLinearParams, session: TpuSession):
@@ -472,12 +548,24 @@ def _init_fit_state(p: HashedLinearParams, session: TpuSession):
             theta["emb"], session.sharding(session.model_axis, None)
         )
     opt_state = _ADAM_UNIT.init(theta)
-    salts_np = column_salts(p.n_cat, p.seed)
+    if p.value_weighted:
+        # position-INDEPENDENT hashing: libsvm-style sources pack
+        # (idx, val) pairs positionally, so every slot must share ONE salt
+        # or a single feature fragments across slot-dependent buckets
+        salts_np = np.repeat(column_salts(1, p.seed), p.n_cat)
+    else:
+        salts_np = column_salts(p.n_cat, p.seed)
     salts = jax.device_put(salts_np, session.replicated)
+    if p.value_weighted and p.n_dense:
+        raise ValueError(
+            "value_weighted mode carries (index, value) pairs only — "
+            f"n_dense must be 0, got {p.n_dense}"
+        )
     static_kw = dict(
         loss_kind=_row_loss_kind(p), n_dims=p.n_dims, n_dense=p.n_dense,
         compute_dtype=jnp.dtype(p.compute_dtype),
         label_in_chunk=p.label_in_chunk, emb_update=p.emb_update,
+        value_weighted=p.value_weighted,
     )
     return theta, opt_state, salts_np, salts, static_kw
 
@@ -523,7 +611,7 @@ class StreamingHashedLinearEstimator(Estimator):
         session = session or TpuSession.active()
         if not (p.fused_replay and p.epochs > 1 and n_chunks > 0):
             return
-        n_cols = p.n_dense + p.n_cat + (1 if p.label_in_chunk else 0)
+        n_cols = _chunk_cols(p)
         pad_rows = session.pad_rows(p.chunk_rows)
         theta, opt, _, salts, kw = _init_fit_state(p, session)
         # one zero chunk through the SAME device-put path as the real fit,
@@ -589,7 +677,7 @@ class StreamingHashedLinearEstimator(Estimator):
         p = self.params
         session = session or TpuSession.active()
         k = _effective_k(p)
-        n_cols = p.n_dense + p.n_cat + (1 if p.label_in_chunk else 0)
+        n_cols = _chunk_cols(p)
         theta, opt_state, salts_np, salts, static_kw = _init_fit_state(
             p, session
         )

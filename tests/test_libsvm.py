@@ -74,3 +74,122 @@ def test_libsvm_chunk_source_fixed_nnz(tmp_path, session):
     np.testing.assert_allclose(chunks[1][0], [1, 0, 1, 2, 1, 2, 3])
     # re-iterable
     assert len(list(src())) == 2
+
+
+def test_value_weighted_hashed_fit_learns_from_libsvm(tmp_path, session):
+    """End-to-end: libsvm file -> fixed-nnz chunks -> value-weighted hashed
+    fit (MLlib SparseVector semantics: forward = sum(emb[hash(idx)]*val))."""
+    import numpy as np
+
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+
+    rng = np.random.default_rng(0)
+    n, d, nnz = 3000, 200, 6
+    w_true = rng.normal(0, 1.5, d).astype(np.float32)
+    lines = []
+    X_dense = np.zeros((n, d), np.float32)
+    for r in range(n):
+        idx = np.sort(rng.choice(d, nnz, replace=False))
+        val = rng.normal(1.0, 0.5, nnz).astype(np.float32)
+        X_dense[r, idx] = val
+        z = float(X_dense[r] @ w_true)
+        y = int(z + 0.3 * rng.standard_normal() > 0)
+        lines.append(
+            f"{y} " + " ".join(f"{i+1}:{v:.6g}" for i, v in zip(idx, val))
+        )
+    p = tmp_path / "vw.svm"
+    p.write_text("\n".join(lines) + "\n")
+
+    src = libsvm_chunk_source(str(p), nnz_per_row=nnz, chunk_rows=512)
+    est = StreamingHashedLinearEstimator(
+        n_dims=1 << 12, n_dense=0, n_cat=nnz, epochs=12, step_size=0.1,
+        chunk_rows=512, label_in_chunk=True, value_weighted=True,
+    )
+    model = est.fit_stream(src, session=session, cache_device=True)
+    ev = model.evaluate_device(model.device_chunks_)
+    assert ev["accuracy"] > 0.85, ev
+    assert ev["auc"] > 0.9, ev
+
+
+def test_value_weighted_variants_agree(session):
+    """fused / per_column / sorted lowerings of the value-weighted step
+    produce the same loss and gradients."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from orange3_spark_tpu.models.hashed_linear import _hashed_logits
+    from orange3_spark_tpu.ops.hashing import column_salts, hash_columns
+
+    rng = np.random.default_rng(1)
+    N, C, D, k = 64, 5, 256, 1
+    emb = jnp.asarray(rng.standard_normal((D, k)), jnp.float32)
+    theta = {"emb": emb, "coef": jnp.zeros((0, k), jnp.float32),
+             "intercept": jnp.zeros((k,), jnp.float32)}
+    cats = jnp.asarray(rng.integers(0, 999, (N, C)), jnp.float32)
+    vals = jnp.asarray(rng.standard_normal((N, C)), jnp.float32)
+    idx = hash_columns(cats, jnp.asarray(column_salts(C, 0)), D)
+    dense = jnp.zeros((N, 0), jnp.float32)
+
+    def loss(theta, variant):
+        z = _hashed_logits(theta, dense, idx, jnp.float32, variant, vals)
+        return jnp.sum(jnp.tanh(z))
+
+    outs, grads = {}, {}
+    for v in ("fused", "per_column", "sorted"):
+        outs[v], grads[v] = jax.value_and_grad(loss)(theta, v)
+    for v in ("per_column", "sorted"):
+        np.testing.assert_allclose(outs[v], outs["fused"], rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(grads[v]["emb"]), np.asarray(grads["fused"]["emb"]),
+            rtol=1e-4, atol=1e-6,
+        )
+
+
+def test_value_weighted_rejects_dense_block(session):
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+
+    est = StreamingHashedLinearEstimator(
+        n_dims=1 << 10, n_dense=3, n_cat=4, value_weighted=True,
+    )
+    with pytest.raises(ValueError, match="n_dense must be 0"):
+        est.fit_stream(
+            array_chunk_source(np.zeros((8, 11), np.float32),
+                               np.zeros(8, np.float32), chunk_rows=8),
+            session=session,
+        )
+
+
+def test_value_weighted_hash_is_position_independent(session):
+    """The same (index, value) pair must produce the same logit whichever
+    SLOT it occupies — libsvm packs pairs positionally, so value-weighted
+    fits share one salt across slots."""
+    import numpy as np
+
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+
+    est = StreamingHashedLinearEstimator(
+        n_dims=1 << 10, n_dense=0, n_cat=3, epochs=2, step_size=0.1,
+        value_weighted=True, chunk_rows=8,
+    )
+    rng = np.random.default_rng(2)
+    Xall = np.concatenate([
+        rng.integers(0, 50, (64, 3)).astype(np.float32),
+        rng.normal(1, 0.3, (64, 3)).astype(np.float32),
+    ], axis=1)
+    y = rng.integers(0, 2, 64).astype(np.float32)
+    model = est.fit_stream(
+        array_chunk_source(Xall, y, chunk_rows=8), session=session
+    )
+    # feature 7 with value 2.0 in slot 0 vs slot 2 (others padded out)
+    a = np.array([[7, -1, -1, 2.0, 0.0, 0.0]], np.float32)
+    b = np.array([[-1, -1, 7, 0.0, 0.0, 2.0]], np.float32)
+    np.testing.assert_allclose(model._logits(a), model._logits(b), rtol=1e-6)
