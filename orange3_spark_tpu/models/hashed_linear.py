@@ -120,19 +120,21 @@ def _emb_sum_sorted_fwd(emb, idx):
     return _emb_sum_sorted_grad(emb, idx), (idx, emb.shape, proto)
 
 
+def _sorted_scatter(flat_idx, flat_g, D: int, k: int, dtype):
+    """Sort (index, grad) pairs, then a conflict-free ordered scatter-add —
+    the shared backward of both sorted lowerings."""
+    order = jnp.argsort(flat_idx)
+    return jnp.zeros((D, k), dtype).at[flat_idx[order]].add(
+        flat_g[order].astype(dtype),
+        indices_are_sorted=True, unique_indices=False,
+    )
+
+
 def _emb_sum_sorted_bwd(res, g):
     idx, (D, k), proto = res
-    dtype = proto.dtype
     N, C = idx.shape
-    flat_idx = idx.reshape(-1)
     flat_g = jnp.broadcast_to(g[:, None, :], (N, C, k)).reshape(N * C, k)
-    order = jnp.argsort(flat_idx)
-    sidx = flat_idx[order]
-    sg = flat_g[order]
-    grad = jnp.zeros((D, k), dtype).at[sidx].add(
-        sg.astype(dtype), indices_are_sorted=True, unique_indices=False
-    )
-    return grad, None
+    return _sorted_scatter(idx.reshape(-1), flat_g, D, k, proto.dtype), None
 
 
 _emb_sum_sorted_grad.defvjp(_emb_sum_sorted_fwd, _emb_sum_sorted_bwd)
@@ -156,16 +158,10 @@ def _emb_wsum_sorted_fwd(emb, idx, vals):
 
 def _emb_wsum_sorted_bwd(res, g):
     idx, vals, (D, k), proto = res
-    dtype = proto.dtype
     N, C = idx.shape
-    flat_idx = idx.reshape(-1)
     flat_g = (g[:, None, :] * vals[:, :, None]).reshape(N * C, k)
-    order = jnp.argsort(flat_idx)
-    grad = jnp.zeros((D, k), dtype).at[flat_idx[order]].add(
-        flat_g[order].astype(dtype),
-        indices_are_sorted=True, unique_indices=False,
-    )
-    return grad, None, None
+    return (_sorted_scatter(idx.reshape(-1), flat_g, D, k, proto.dtype),
+            None, None)
 
 
 _emb_wsum_sorted_grad.defvjp(_emb_wsum_sorted_fwd, _emb_wsum_sorted_bwd)
@@ -339,14 +335,13 @@ def _hashed_replay_epochs(
 @partial(jax.jit, static_argnames=("n_dims", "n_dense", "value_weighted"))
 def _hashed_predict(theta, Xall, salts, *, n_dims: int, n_dense: int,
                     value_weighted: bool = False):
-    if value_weighted:
-        C = Xall.shape[1] // 2
-        idx = hash_columns(Xall[:, :C], salts, n_dims)
-        return _hashed_logits(theta, Xall[:, :0], idx, jnp.float32,
-                              vals=Xall[:, C:])
-    dense = Xall[:, :n_dense]
-    idx = hash_columns(Xall[:, n_dense:], salts, n_dims)
-    return _hashed_logits(theta, dense, idx, jnp.float32)
+    # one layout authority: the same _split_chunk the training step uses
+    _, dense, cats, _, vals = _split_chunk(
+        Xall, 0, None, None, label_in_chunk=False, n_dense=n_dense,
+        value_weighted=value_weighted,
+    )
+    idx = hash_columns(cats, salts, n_dims)
+    return _hashed_logits(theta, dense, idx, jnp.float32, vals=vals)
 
 
 @partial(
@@ -587,6 +582,15 @@ class StreamingHashedLinearEstimator(Estimator):
         from orange3_spark_tpu.io.streaming import array_chunk_source
         from orange3_spark_tpu.models.base import infer_class_values
 
+        if self.params.value_weighted:
+            # a TpuTable's feature matrix is DENSE columns, never the
+            # (idx..., val...) pair layout — feeding it through would hash
+            # feature VALUES as indices and train a nonsense model
+            raise ValueError(
+                "value_weighted fits consume (index, value) pair chunks "
+                "(io.libsvm.libsvm_chunk_source) via fit_stream, not "
+                "dense tables"
+            )
         X, Y, W = table.to_numpy()
         y = Y[:, 0] if Y is not None else None
         class_values = (

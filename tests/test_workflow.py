@@ -535,3 +535,18 @@ def test_select_rows_by_category_name(session):
     import pytest as _pytest
     with _pytest.raises(ValueError, match="neither numeric nor a category"):
         SelectRows(conditions=(("region", "==", "south"),)).transform(t)
+
+
+def test_libsvm_reader_widget(tmp_path, session):
+    from orange3_spark_tpu.widgets.catalog import WIDGET_REGISTRY
+    from orange3_spark_tpu.workflow.graph import WorkflowGraph
+
+    p = tmp_path / "w.svm"
+    p.write_text("1 1:2.0 3:1.0\n0 2:5.0\n")
+    g = WorkflowGraph()
+    nid = g.add(WIDGET_REGISTRY["OWLibsvmReader"](path=str(p)))
+    out = g.run()[nid]["data"]
+    import numpy as np
+    X, Y, _ = out.to_numpy()
+    np.testing.assert_allclose(X, [[2.0, 0.0, 1.0], [0.0, 5.0, 0.0]])
+    np.testing.assert_allclose(Y[:, 0], [1, 0])
