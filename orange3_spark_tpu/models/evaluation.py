@@ -232,3 +232,158 @@ def _silhouette_centroid(X, pred, w, k: int):
     s = (other - own) / jnp.maximum(jnp.maximum(own, other), EPS_TOTAL_WEIGHT)
     tot = jnp.maximum(jnp.sum(w), EPS_TOTAL_WEIGHT)
     return jnp.sum(s * w) / tot
+
+
+# --------------------------------------------------------------------------
+# Set-valued evaluators (pyspark.ml.evaluation RankingEvaluator /
+# MultilabelClassificationEvaluator, Spark 3.0). Spark evaluates DataFrames
+# with ARRAY columns; this table model has no ragged arrays, so both take
+# fixed-width padded id matrices — pred [n, P] and truth [n, T] integer ids
+# with -1 padding — the same static-shape convention as the rest of the
+# framework (and exactly what ALSModel.recommend_for_all_users emits).
+# --------------------------------------------------------------------------
+
+def _pair_hits(pred, truth):
+    """[n, P] bool: is pred slot j a member of the row's truth set.
+    -1 pads never match (-1 == -1 is masked explicitly)."""
+    pred = jnp.asarray(pred, jnp.int32)
+    truth = jnp.asarray(truth, jnp.int32)
+    eq = pred[:, :, None] == truth[:, None, :]
+    eq = eq & (truth[:, None, :] >= 0)
+    return jnp.any(eq, axis=2) & (pred >= 0)
+
+
+@dataclasses.dataclass(frozen=True)
+class RankingEvaluatorParams(Params):
+    metric_name: str = "meanAveragePrecision"
+    k: int = 10
+
+
+class RankingEvaluator:
+    """pyspark.ml.evaluation.RankingEvaluator parity (RankingMetrics):
+    meanAveragePrecision, meanAveragePrecisionAtK, precisionAtK, recallAtK,
+    ndcgAtK — binary relevance, predictions ordered best-first.
+
+    evaluate(pred_ids [n, P], true_ids [n, T]) -> float; -1 pads ignored.
+    """
+
+    ParamsCls = RankingEvaluatorParams
+    METRICS = ("meanAveragePrecision", "meanAveragePrecisionAtK",
+               "precisionAtK", "recallAtK", "ndcgAtK")
+
+    def __init__(self, params: RankingEvaluatorParams | None = None, **kw):
+        self.params = params or RankingEvaluatorParams(**kw)
+
+    def evaluate(self, pred_ids, true_ids) -> float:
+        p = self.params
+        m = p.metric_name
+        if m not in self.METRICS:
+            raise ValueError(f"unknown metric {m!r}; one of {self.METRICS}")
+        return float(_ranking_metric(
+            jnp.asarray(pred_ids, jnp.int32), jnp.asarray(true_ids, jnp.int32),
+            metric=m, k=p.k,
+        ))
+
+
+@partial(jax.jit, static_argnames=("metric", "k"))
+def _ranking_metric(pred, truth, *, metric: str, k: int):
+    n, P = pred.shape
+    hits = _pair_hits(pred, truth).astype(jnp.float32)         # [n, P]
+    n_rel = jnp.sum((truth >= 0).astype(jnp.float32), axis=1)  # [n]
+    ranks = jnp.arange(1, P + 1, dtype=jnp.float32)
+    topk = (ranks <= k).astype(jnp.float32)
+    safe_rel = jnp.maximum(n_rel, 1.0)
+    if metric == "precisionAtK":
+        # MLlib divides by k even when fewer than k predictions exist
+        row = jnp.sum(hits * topk, axis=1) / k
+    elif metric == "recallAtK":
+        row = jnp.sum(hits * topk, axis=1) / safe_rel
+    elif metric == "meanAveragePrecision":
+        prec_at = jnp.cumsum(hits, axis=1) / ranks
+        row = jnp.sum(prec_at * hits, axis=1) / safe_rel
+    elif metric == "meanAveragePrecisionAtK":
+        prec_at = jnp.cumsum(hits, axis=1) / ranks
+        row = (jnp.sum(prec_at * hits * topk, axis=1)
+               / jnp.maximum(jnp.minimum(n_rel, float(k)), 1.0))
+    else:  # ndcgAtK, binary relevance
+        disc = 1.0 / jnp.log2(ranks + 1.0)
+        dcg = jnp.sum(hits * disc * topk, axis=1)
+        ideal_n = jnp.minimum(n_rel, float(k))
+        idisc = jnp.where(ranks[None, :] <= ideal_n[:, None],
+                          disc[None, :], 0.0)
+        idcg = jnp.maximum(jnp.sum(idisc, axis=1), 1e-12)
+        row = dcg / idcg
+    # rows with an empty truth set contribute 0 (MLlib logs-and-zeros them)
+    row = jnp.where(n_rel > 0, row, 0.0)
+    return jnp.mean(row)
+
+
+@dataclasses.dataclass(frozen=True)
+class MultilabelEvaluatorParams(Params):
+    metric_name: str = "f1Measure"
+
+
+class MultilabelClassificationEvaluator:
+    """pyspark.ml.evaluation.MultilabelClassificationEvaluator parity
+    (MultilabelMetrics): subsetAccuracy, accuracy, hammingLoss, precision,
+    recall, f1Measure, microPrecision, microRecall, microF1Measure.
+
+    evaluate(pred_ids [n, P], true_ids [n, T]) -> float; -1 pads ignored;
+    ids within a row are treated as SETS (duplicates undefined, like
+    Spark). hammingLoss normalizes by the distinct label count across both
+    matrices (MLlib's numLabels).
+    """
+
+    ParamsCls = MultilabelEvaluatorParams
+    METRICS = ("subsetAccuracy", "accuracy", "hammingLoss", "precision",
+               "recall", "f1Measure", "microPrecision", "microRecall",
+               "microF1Measure")
+
+    def __init__(self, params: MultilabelEvaluatorParams | None = None, **kw):
+        self.params = params or MultilabelEvaluatorParams(**kw)
+
+    def evaluate(self, pred_ids, true_ids) -> float:
+        m = self.params.metric_name
+        if m not in self.METRICS:
+            raise ValueError(f"unknown metric {m!r}; one of {self.METRICS}")
+        pred = jnp.asarray(pred_ids, jnp.int32)
+        truth = jnp.asarray(true_ids, jnp.int32)
+        if m == "hammingLoss":
+            # MLlib's numLabels = distinct count of TRUE labels only —
+            # predicted ids absent from every truth row must not deflate it
+            ids = np.asarray(truth).ravel()
+            n_labels = len(np.unique(ids[ids >= 0]))
+            return float(_multilabel_metric(pred, truth, metric=m)
+                         / max(n_labels, 1))
+        return float(_multilabel_metric(pred, truth, metric=m))
+
+
+@partial(jax.jit, static_argnames=("metric",))
+def _multilabel_metric(pred, truth, *, metric: str):
+    hit_p = _pair_hits(pred, truth).astype(jnp.float32)   # pred slot in truth
+    np_ = jnp.sum((pred >= 0).astype(jnp.float32), axis=1)
+    nt = jnp.sum((truth >= 0).astype(jnp.float32), axis=1)
+    inter = jnp.sum(hit_p, axis=1)
+    union = np_ + nt - inter
+    if metric == "subsetAccuracy":
+        return jnp.mean(((inter == np_) & (inter == nt)).astype(jnp.float32))
+    if metric == "accuracy":
+        return jnp.mean(jnp.where(union > 0, inter / jnp.maximum(union, 1.0),
+                                  1.0))
+    if metric == "hammingLoss":
+        # symmetric difference summed over rows; caller divides by
+        # n * numLabels (numLabels needs a host-side distinct count)
+        return jnp.sum(union - inter) / pred.shape[0]
+    if metric == "precision":
+        return jnp.mean(jnp.where(np_ > 0, inter / jnp.maximum(np_, 1.0), 0.0))
+    if metric == "recall":
+        return jnp.mean(jnp.where(nt > 0, inter / jnp.maximum(nt, 1.0), 0.0))
+    if metric == "f1Measure":
+        return jnp.mean(jnp.where(
+            np_ + nt > 0, 2.0 * inter / jnp.maximum(np_ + nt, 1.0), 0.0))
+    tot_i, tot_p, tot_t = jnp.sum(inter), jnp.sum(np_), jnp.sum(nt)
+    if metric == "microPrecision":
+        return tot_i / jnp.maximum(tot_p, 1e-12)
+    if metric == "microRecall":
+        return tot_i / jnp.maximum(tot_t, 1e-12)
+    return 2.0 * tot_i / jnp.maximum(tot_p + tot_t, 1e-12)  # microF1Measure

@@ -1,0 +1,112 @@
+"""RankingEvaluator / MultilabelClassificationEvaluator vs hand-computed
+RankingMetrics/MultilabelMetrics values (pyspark.ml.evaluation 3.0)."""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.models.evaluation import (
+    MultilabelClassificationEvaluator,
+    RankingEvaluator,
+)
+
+# two rows: preds best-first, -1 = padding
+PRED = np.array([[1, 6, 2, 7, 8, 3, 9, 10, 4, 5],
+                 [4, 1, 5, 6, 2, 7, 3, 8, 9, 10]])
+TRUE = np.array([[1, 2, 3, 4, 5, -1],
+                 [1, 2, 3, -1, -1, -1]])
+
+
+def test_precision_at_k():
+    # row0 top-5 = {1,6,2,7,8} -> 2 relevant; row1 top-5 = {4,1,5,6,2} -> 2
+    ev = RankingEvaluator(metric_name="precisionAtK", k=5)
+    assert ev.evaluate(PRED, TRUE) == pytest.approx((2 / 5 + 2 / 5) / 2)
+
+
+def test_recall_at_k():
+    ev = RankingEvaluator(metric_name="recallAtK", k=5)
+    assert ev.evaluate(PRED, TRUE) == pytest.approx((2 / 5 + 2 / 3) / 2)
+
+
+def test_mean_average_precision():
+    # row0 hits at ranks 1,3,6,9,10 -> (1/1+2/3+3/6+4/9+5/10)/5
+    r0 = (1 + 2 / 3 + 3 / 6 + 4 / 9 + 5 / 10) / 5
+    # row1 hits at ranks 2,5,7 -> (1/2+2/5+3/7)/3
+    r1 = (1 / 2 + 2 / 5 + 3 / 7) / 3
+    ev = RankingEvaluator(metric_name="meanAveragePrecision")
+    assert ev.evaluate(PRED, TRUE) == pytest.approx((r0 + r1) / 2, rel=1e-6)
+
+
+def test_ndcg_at_k():
+    d = [1 / np.log2(i + 2) for i in range(10)]
+    r0 = (d[0] + d[2] + d[5]) / sum(d[:5])      # hits at ranks 1,3,6 within k=6? no, k=6
+    # recompute precisely for k=6: hits at ranks 1,3,6 -> dcg d0+d2+d5; idcg = sum d[:min(5,6)]
+    ev = RankingEvaluator(metric_name="ndcgAtK", k=6)
+    r1 = (d[1] + d[4]) / sum(d[:3])             # row1 hits at 2,5 in top6; |rel|=3
+    assert ev.evaluate(PRED, TRUE) == pytest.approx((r0 + r1) / 2, rel=1e-6)
+
+
+def test_empty_truth_contributes_zero():
+    ev = RankingEvaluator(metric_name="meanAveragePrecision")
+    t = np.array([[1, 2, -1], [-1, -1, -1]])
+    p = np.array([[1, 2, 3], [1, 2, 3]])
+    assert ev.evaluate(p, t) == pytest.approx(0.5 * 1.0)  # row1 zero
+
+
+PRED_ML = np.array([[0, 1, -1], [0, 2, -1], [2, -1, -1]])
+TRUE_ML = np.array([[0, 1, -1], [0, 1, -1], [2, 0, -1]])
+
+
+def test_multilabel_metrics():
+    # rows: inter=2,|P|=2,|T|=2 / inter=1,2,2 / inter=1,1,2
+    ev = lambda m: MultilabelClassificationEvaluator(
+        metric_name=m).evaluate(PRED_ML, TRUE_ML)
+    assert ev("subsetAccuracy") == pytest.approx(1 / 3)
+    assert ev("accuracy") == pytest.approx((1.0 + 1 / 3 + 1 / 2) / 3)
+    assert ev("precision") == pytest.approx((1.0 + 0.5 + 1.0) / 3)
+    assert ev("recall") == pytest.approx((1.0 + 0.5 + 0.5) / 3)
+    assert ev("f1Measure") == pytest.approx(
+        (2 * 2 / 4 + 2 * 1 / 4 + 2 * 1 / 3) / 3)
+    assert ev("microPrecision") == pytest.approx(4 / 5)
+    assert ev("microRecall") == pytest.approx(4 / 6)
+    assert ev("microF1Measure") == pytest.approx(2 * 4 / 11)
+    # hammingLoss: sym-diff sizes 0,2,1 over n=3 rows, 3 distinct TRUE labels
+    assert ev("hammingLoss") == pytest.approx((0 + 2 + 1) / (3 * 3))
+
+
+def test_hamming_loss_counts_true_labels_only():
+    # a predicted id absent from every truth row must not change numLabels
+    pred = np.array([[0, 5, -1]])
+    true = np.array([[0, 1, -1]])
+    ev = MultilabelClassificationEvaluator(metric_name="hammingLoss")
+    assert ev.evaluate(pred, true) == pytest.approx(2 / (1 * 2))
+
+
+def test_unknown_metric_raises():
+    with pytest.raises(ValueError, match="unknown metric"):
+        RankingEvaluator(metric_name="nope").evaluate(PRED, TRUE)
+    with pytest.raises(ValueError, match="unknown metric"):
+        MultilabelClassificationEvaluator(metric_name="nope").evaluate(
+            PRED_ML, TRUE_ML)
+
+
+def test_ranking_with_als_recommendations(session):
+    """End-to-end: ALS top-k recommendations scored by RankingEvaluator."""
+    from orange3_spark_tpu.models.als import ALS, ratings_table
+
+    rng = np.random.default_rng(0)
+    n_u, n_i, rank = 30, 40, 4
+    U = rng.normal(0, 1, (n_u, rank)).astype(np.float32)
+    V = rng.normal(0, 1, (n_i, rank)).astype(np.float32)
+    full = U @ V.T
+    uu, ii = np.nonzero(rng.random((n_u, n_i)) < 0.5)
+    r = full[uu, ii] + 0.01 * rng.standard_normal(len(uu)).astype(np.float32)
+    t = ratings_table(
+        np.stack([uu, ii, r], 1).astype(np.float32), session
+    )
+    model = ALS(rank=rank, max_iter=12, reg_param=0.05,
+                n_users=n_u, n_items=n_i, seed=1).fit(t)
+    recs = model.recommend_for_all_users(10).astype(np.int64)
+    # ground truth: each user's top-10 items by TRUE score
+    truth = np.argsort(-full, axis=1)[:, :10]
+    score = RankingEvaluator(metric_name="ndcgAtK", k=10).evaluate(recs, truth)
+    assert score > 0.6, score
