@@ -308,9 +308,13 @@ def _ranking_metric(pred, truth, *, metric: str, k: int):
     else:  # ndcgAtK, binary relevance
         disc = 1.0 / jnp.log2(ranks + 1.0)
         dcg = jnp.sum(hits * disc * topk, axis=1)
+        # ideal DCG sums min(|rel|, k) discount terms INDEPENDENT of the
+        # prediction width P (Spark ndcgAt) — a too-short prediction list
+        # must lower the score, not the ideal
         ideal_n = jnp.minimum(n_rel, float(k))
-        idisc = jnp.where(ranks[None, :] <= ideal_n[:, None],
-                          disc[None, :], 0.0)
+        iranks = jnp.arange(1, k + 1, dtype=jnp.float32)
+        idisc = jnp.where(iranks[None, :] <= ideal_n[:, None],
+                          1.0 / jnp.log2(iranks[None, :] + 1.0), 0.0)
         idcg = jnp.maximum(jnp.sum(idisc, axis=1), 1e-12)
         row = dcg / idcg
     # rows with an empty truth set contribute 0 (MLlib logs-and-zeros them)

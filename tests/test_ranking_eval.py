@@ -38,11 +38,22 @@ def test_mean_average_precision():
 
 def test_ndcg_at_k():
     d = [1 / np.log2(i + 2) for i in range(10)]
-    r0 = (d[0] + d[2] + d[5]) / sum(d[:5])      # hits at ranks 1,3,6 within k=6? no, k=6
-    # recompute precisely for k=6: hits at ranks 1,3,6 -> dcg d0+d2+d5; idcg = sum d[:min(5,6)]
+    # row0: hits at ranks 1,3,6 within top-6 -> dcg = d0+d2+d5;
+    # idcg = sum of min(|rel|=5, k=6) = 5 discount terms
+    r0 = (d[0] + d[2] + d[5]) / sum(d[:5])
+    # row1: hits at ranks 2,5 within top-6; |rel| = 3
+    r1 = (d[1] + d[4]) / sum(d[:3])
     ev = RankingEvaluator(metric_name="ndcgAtK", k=6)
-    r1 = (d[1] + d[4]) / sum(d[:3])             # row1 hits at 2,5 in top6; |rel|=3
     assert ev.evaluate(PRED, TRUE) == pytest.approx((r0 + r1) / 2, rel=1e-6)
+
+
+def test_ndcg_ideal_independent_of_prediction_width():
+    # prediction list SHORTER than min(|rel|, k): the ideal DCG still sums
+    # min(|rel|, k) terms, so 2 perfect hits out of 5 relevant score ~0.553
+    d = [1 / np.log2(i + 2) for i in range(10)]
+    ev = RankingEvaluator(metric_name="ndcgAtK", k=10)
+    got = ev.evaluate(np.array([[1, 2]]), np.array([[1, 2, 3, 4, 5]]))
+    assert got == pytest.approx((d[0] + d[1]) / sum(d[:5]), rel=1e-6)
 
 
 def test_empty_truth_contributes_zero():

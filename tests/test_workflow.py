@@ -550,3 +550,47 @@ def test_libsvm_reader_widget(tmp_path, session):
     X, Y, _ = out.to_numpy()
     np.testing.assert_allclose(X, [[2.0, 0.0, 1.0], [0.0, 5.0, 0.0]])
     np.testing.assert_allclose(Y[:, 0], [1, 0])
+
+
+def test_groupby_pivot_json_roundtrip(session):
+    """Tuple params (keys/aggs/conditions) survive the JSON round trip —
+    json decodes tuples as LISTS, so the widgets must accept both."""
+    import numpy as np
+
+    from orange3_spark_tpu.core.domain import (
+        ContinuousVariable, DiscreteVariable, Domain,
+    )
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.widgets.catalog import WIDGET_REGISTRY
+    from orange3_spark_tpu.workflow.graph import WorkflowGraph
+
+    g = WorkflowGraph()
+    gb = g.add(WIDGET_REGISTRY["OWGroupBy"](
+        keys=("region",), aggs=(("amt", "sum"), ("amt", "mean"))
+    ))
+    pv = g.add(WIDGET_REGISTRY["OWPivot"](
+        keys=("region",), pivot_col="q", aggs=(("amt", "count"),)
+    ))
+    sr = g.add(WIDGET_REGISTRY["OWSelectRows"](
+        conditions=(("amt", ">", 1.0),)
+    ))
+    g2 = WorkflowGraph.from_json(g.to_json())
+
+    rng = np.random.default_rng(3)
+    dom = Domain([
+        DiscreteVariable("region", ("e", "w")),
+        DiscreteVariable("q", ("q1", "q2")),
+        ContinuousVariable("amt"),
+    ])
+    t = TpuTable.from_numpy(
+        dom, np.stack([rng.integers(0, 2, 100), rng.integers(0, 2, 100),
+                       rng.gamma(2, 3, 100)], 1).astype(np.float32),
+        session=session,
+    )
+    # process each restored widget directly (graph has no source/edges)
+    X, _, _ = g2.nodes[gb].widget.process(t)["data"].to_numpy()
+    assert X.shape == (2, 3)    # 2 regions x (key + 2 aggs)
+    Xp, _, _ = g2.nodes[pv].widget.process(t)["data"].to_numpy()
+    assert Xp.shape == (2, 3)   # key + 2 quarters
+    _, _, W = g2.nodes[sr].widget.process(t)["data"].to_numpy()
+    assert 0 < (W[:100] > 0).sum() < 100
