@@ -311,6 +311,33 @@ class StreamingKMeansParams(Params):
     seed: int = 0
 
 
+@partial(jax.jit, static_argnames=("loss_kind", "n_epochs"),
+         donate_argnums=(0, 1))
+def _stream_replay_epochs(theta, opt_state, Xs, ys, ws, reg, lr, *,
+                          loss_kind: str, n_epochs: int):
+    """Epochs 2+ over the HBM batch cache as ONE XLA program — an
+    epoch-level scan around a batch-level scan, the dense twin of
+    models/hashed_linear.py's fused replay (same rationale: replay cost
+    becomes pure device time regardless of per-dispatch latency).
+    Returns per-(epoch, batch) losses; [-1, -1] matches the loop path's
+    final loss."""
+    def body(carry, xs):
+        theta, opt = carry
+        X, y, w = xs
+        theta, opt, loss = _stream_step(theta, opt, X, y, w, reg, lr,
+                                        loss_kind=loss_kind)
+        return (theta, opt), loss
+
+    def epoch(carry, _):
+        carry, losses = jax.lax.scan(body, carry, (Xs, ys, ws))
+        return carry, losses
+
+    (theta, opt_state), losses = jax.lax.scan(
+        epoch, (theta, opt_state), None, length=n_epochs
+    )
+    return theta, opt_state, losses
+
+
 @partial(jax.jit, static_argnames=("k",), donate_argnums=(0, 1))
 def _kmeans_stream_step(centers, counts, X, w, decay, *, k: int):
     """One aggregated mini-batch update (Sculley 2010 / MLlib StreamingKMeans):
@@ -559,6 +586,25 @@ class StreamingLinearEstimator(Estimator):
                     n_steps += 1  # fast-forward past checkpointed batches
                     continue
                 run_step(Xd, yd, wd)
+            if (epoch == 0 and p.epochs > 1 and cache.enabled
+                    and cache.batches and checkpointer is None
+                    and 2 * cache.nbytes <= cache_device_bytes):
+                # remaining epochs in ONE dispatch (the transient batch
+                # stack is a second device copy — same half-budget rule as
+                # the hashed estimator); checkpointed fits keep the
+                # per-batch loop for step-granular snapshots
+                stacks = tuple(
+                    jnp.stack([b[i] for b in cache.batches])
+                    for i in range(3)
+                )
+                theta, opt_state, losses = _stream_replay_epochs(
+                    theta, opt_state, *stacks, reg, lr,
+                    loss_kind=p.loss, n_epochs=p.epochs - 1,
+                )
+                del stacks
+                n_steps += (p.epochs - 1) * len(cache.batches)
+                last_loss = losses[-1, -1]
+                break
         model = self._wrap_model(theta, k, class_values)
         model.n_steps_ = n_steps
         model.final_loss_ = float(last_loss) if last_loss is not None else None
