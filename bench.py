@@ -251,7 +251,7 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
     pure_step_ms = h2d_blocked_gbps = None
     if model.device_chunks_:
         from orange3_spark_tpu.models.hashed_linear import (
-            _ADAM_UNIT, _hashed_step,
+            _ADAM_UNIT, _hashed_step, resolve_emb_update,
         )
         import jax.numpy as jnp
         import numpy as np
@@ -262,7 +262,7 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
         salts = jnp.asarray(model.salts)
         kw = dict(loss_kind="binary_logistic", n_dims=dims, n_dense=N_DENSE,
                   compute_dtype=jnp.dtype("float32"),  # match the fit's
-                  label_in_chunk=True, emb_update=est.params.emb_update)
+                  label_in_chunk=True, emb_update=resolve_emb_update(est.params))
         args = lambda c: (c[0], c[1], c[2], c[3], salts,
                           jnp.float32(REG_PARAM), jnp.float32(STEP_SIZE))
         theta, opt, loss = _hashed_step(theta, opt, *args(chunks[0]), **kw)

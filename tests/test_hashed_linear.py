@@ -240,3 +240,23 @@ def test_fused_replay_respects_holdout(session):
     assert "replay_fused_s" in st
     ev = model.evaluate_device(model.holdout_chunks_)
     assert 0.0 < ev["logloss"] < 2.0
+
+
+def test_emb_update_auto_resolves_per_backend(session):
+    """'auto' picks the measured-best lowering for the current backend at
+    fit time ('sorted' on TPU per the on-chip A/B, 'fused' elsewhere) and
+    never reaches the jitted step unresolved."""
+    import jax
+
+    from orange3_spark_tpu.models.hashed_linear import (
+        HashedLinearParams, _init_fit_state,
+    )
+
+    p = HashedLinearParams()
+    assert p.emb_update == "auto"
+    *_, kw = _init_fit_state(p, session)
+    expect = "sorted" if jax.default_backend() == "tpu" else "fused"
+    assert kw["emb_update"] == expect
+    # explicit values pass through untouched
+    *_, kw = _init_fit_state(p.replace(emb_update="per_column"), session)
+    assert kw["emb_update"] == "per_column"
