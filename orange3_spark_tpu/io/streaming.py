@@ -27,8 +27,11 @@ difference.
 from __future__ import annotations
 
 import dataclasses
+import os
 import queue
 import threading
+import uuid
+import warnings
 from functools import partial
 from typing import Callable, Iterable, Iterator
 
@@ -179,13 +182,15 @@ class _DeviceCache:
     place for the budget/degrade rule: batches accumulate until ``budget``
     bytes, after which the WHOLE cache drops and the fit degrades to pure
     streaming (a partial replay would reorder/double-count batches).
-    ``batches`` is a plain list the owner may filter (holdout exclusion)."""
+    ``batches`` is a plain list the owner may filter (holdout exclusion);
+    ``degraded`` stays True after an overflow so owners can warn/spill."""
 
     def __init__(self, enabled: bool, budget: int):
         self.enabled = enabled
         self.budget = budget
         self.batches: list = []
         self.nbytes = 0
+        self.degraded = False
 
     def offer(self, batch: tuple) -> None:
         if not self.enabled:
@@ -196,7 +201,9 @@ class _DeviceCache:
             self.nbytes += sz
         else:
             self.enabled = False
+            self.degraded = True
             self.batches = []
+            self.nbytes = 0  # honest accounting for any downstream gate
 
     @staticmethod
     def _size(batch: tuple) -> int:
@@ -213,6 +220,86 @@ class _DeviceCache:
             else:
                 kept.append(b)
         self.batches = kept
+
+
+class DiskChunkCache:
+    """Epoch-1 on-disk spill of PADDED f32 chunks — the 1B-row overflow
+    path. When a many-epoch streaming fit outgrows the HBM chunk cache,
+    every later epoch would otherwise re-run the source, i.e. re-PARSE the
+    CSV (at 1B rows x 100 epochs: hours of single-core parse per fit).
+    This cache writes each already-padded chunk once, sequentially, on the
+    prefetch thread during epoch 1 (overlapping device steps), and replays
+    epochs 2+ at disk/page-cache bandwidth — the fixed-shape records need
+    zero parsing, just a read + DMA.
+
+    Layout: one flat little-endian f32 file; record i = the chunk's arrays
+    concatenated in declaration order (shapes fixed at construction), plus
+    a host-side list of live-row counts. Single writer (the prefetch
+    thread), then ``finalize()`` flips it to a read-only memmap. The file
+    is unlinked the moment it is opened (POSIX anonymous-file idiom): the
+    fd and memmap stay valid, and a crashed fit can never leak a
+    dataset-sized spill on disk."""
+
+    def __init__(self, dir_path: str, shapes: tuple):
+        self.shapes = [tuple(s) for s in shapes]
+        self.sizes = [int(np.prod(s)) for s in self.shapes]
+        self.record_floats = sum(self.sizes)
+        os.makedirs(dir_path, exist_ok=True)
+        self.path = os.path.join(dir_path, f"spill_{uuid.uuid4().hex}.f32")
+        self._f: object | None = open(self.path, "w+b")
+        os.unlink(self.path)
+        self.n_valid: list[int] = []
+        self._mm: np.memmap | None = None
+
+    def append(self, arrays: tuple, n_valid: int) -> None:
+        for a, shape in zip(arrays, self.shapes):
+            a = np.ascontiguousarray(a, dtype=np.float32)
+            if a.shape != shape:
+                raise ValueError(f"spill record shape {a.shape} != {shape}")
+            a.tofile(self._f)
+        self.n_valid.append(int(n_valid))
+
+    @property
+    def n_records(self) -> int:
+        return len(self.n_valid)
+
+    def finalize(self) -> None:
+        if self._mm is None and self._f is not None and self.n_valid:
+            self._f.flush()
+            self._mm = np.memmap(self._f, dtype=np.float32, mode="r",
+                                 shape=(self.n_records, self.record_floats))
+
+    def read(self, i: int) -> tuple[tuple, int]:
+        """Record i as array views into the memmap (the device_put reads
+        pages straight out of it — no intermediate host copy)."""
+        rec = self._mm[i]
+        out, ofs = [], 0
+        for shape, size in zip(self.shapes, self.sizes):
+            out.append(rec[ofs:ofs + size].reshape(shape))
+            ofs += size
+        return tuple(out), self.n_valid[i]
+
+    def delete(self) -> None:
+        """Release the backing storage (the unlinked inode frees itself
+        once the fd and memmap close)."""
+        self._mm = None
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+def warn_cache_overflow(cache_device_bytes: int, epochs_left: int,
+                        detail: str = "") -> None:
+    """THE cache-overflow warning — one wording for every streaming
+    estimator (a silent 100x parse multiplier is the failure mode; a
+    drifting copy-pasted message is how the warning itself rots)."""
+    warnings.warn(
+        f"device chunk cache overflowed cache_device_bytes="
+        f"{cache_device_bytes}: each of the remaining {epochs_left} "
+        f"epochs will re-run the source end to end (for a CSV source, a "
+        f"full re-parse per epoch). {detail}".rstrip(),
+        RuntimeWarning, stacklevel=3,
+    )
 
 
 def _rechunk(stream: Iterator[Chunk], rows: int) -> Iterator[tuple]:
@@ -444,6 +531,8 @@ class StreamingKMeans(Estimator):
                 )
                 n_steps += 1
                 bound_dispatch(n_steps, cost)  # utils/dispatch.py: queue cap
+            if epoch == 0 and cache.degraded and p.epochs > 1:
+                warn_cache_overflow(cache_device_bytes, p.epochs - 1)
         if centers is None:
             raise ValueError("stream produced no live rows")
         model = KMeansModel(KMeansParams(k=p.k), centers)
@@ -586,6 +675,8 @@ class StreamingLinearEstimator(Estimator):
                     n_steps += 1  # fast-forward past checkpointed batches
                     continue
                 run_step(Xd, yd, wd)
+            if epoch == 0 and cache.degraded and p.epochs > 1:
+                warn_cache_overflow(cache_device_bytes, p.epochs - 1)
             if (epoch == 0 and p.epochs > 1 and cache.enabled
                     and cache.batches and checkpointer is None
                     and 2 * cache.nbytes <= cache_device_bytes):

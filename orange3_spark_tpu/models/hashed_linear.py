@@ -666,6 +666,7 @@ class StreamingHashedLinearEstimator(Estimator):
         checkpointer=None,
         cache_device: bool = False,
         cache_device_bytes: int = 8 << 30,
+        cache_spill_dir: str | None = None,
         holdout_chunks: int = 0,
         stage_times: dict | None = None,
     ) -> HashedLinearModel:
@@ -673,10 +674,23 @@ class StreamingHashedLinearEstimator(Estimator):
 
         cache_device: retain device-put chunks in HBM and replay them for
           epochs 2+ (Spark's ``persist()`` before MLlib's iterative fit).
-          If the stream outgrows ``cache_device_bytes`` the fit degrades to
-          pure streaming for every epoch (no partial replay — see the
-          module docstring). The cached chunk list is exposed on the
-          returned model as ``model.device_chunks_``.
+          If the stream outgrows ``cache_device_bytes`` the fit degrades
+          (no partial replay — see the module docstring): with
+          ``cache_spill_dir`` set, epochs 2+ replay PADDED f32 records
+          from an on-disk cache written during epoch 1 (read + DMA, no
+          re-parse — the 1B-row regime); without it, every epoch re-runs
+          the source, which for a CSV source means re-PARSING the file
+          per epoch — a loud ``warnings.warn`` says so once. The cached
+          chunk list is exposed on the returned model as
+          ``model.device_chunks_``.
+        cache_spill_dir: directory for the epoch-1 disk spill (written on
+          the prefetch thread, sequential f32, released when the fit
+          returns). The write happens during epoch 1 WHETHER OR NOT the
+          cache ends up overflowing (the overflow point is unknowable
+          mid-stream, and device->host readback to recover dropped
+          chunks is the slowest path on tunneled hosts) — arm it when
+          the dataset is expected to exceed ``cache_device_bytes``, as
+          bench.py does from its known row count.
         holdout_chunks: exclude the LAST n device batches of each epoch from
           training; with cache_device they are retained (and exposed as
           ``model.holdout_chunks_``) for ``evaluate_device``.
@@ -689,7 +703,9 @@ class StreamingHashedLinearEstimator(Estimator):
           dispatch, so 'epoch_s' is ``[epoch1_wall, whole_replay_wall]``
           and 'replay_fused_s' carries that second number explicitly.
         """
-        from orange3_spark_tpu.io.streaming import _pad_chunk, _rechunk
+        from orange3_spark_tpu.io.streaming import (
+            DiskChunkCache, _pad_chunk, _rechunk, warn_cache_overflow,
+        )
 
         p = self.params
         session = session or TpuSession.active()
@@ -731,19 +747,31 @@ class StreamingHashedLinearEstimator(Estimator):
                     f"chunk has {X_np.shape[1]} columns, expected {n_cols}"
                 )
             n = X_np.shape[0]
-            t0 = time.perf_counter() if times is not None else 0.0
             if p.label_in_chunk:
                 if n == pad_rows:
                     Xp = np.ascontiguousarray(X_np, dtype=np.float32)
                 else:
                     Xp = np.zeros((pad_rows, n_cols), np.float32)
                     Xp[:n] = X_np
-                Xd = put_sharded(Xp, row_sh)
-                yd = wd = _ZERO
+                yp = wp = None
             else:
                 Xp, yp, wp = _pad_chunk(X_np, y_np, w_np, pad_rows,
                                         n_cols)
-                Xd = put_sharded(Xp, row_sh)
+            if spill_active[0]:
+                # sequential f32 write of the already-padded chunk — still
+                # on the prefetch thread, overlapping device steps
+                t_sp = time.perf_counter() if times is not None else 0.0
+                spill.append(
+                    (Xp,) if p.label_in_chunk else (Xp, yp, wp), n
+                )
+                if times is not None:
+                    times["spill_s"] = (times.get("spill_s", 0.0)
+                                        + time.perf_counter() - t_sp)
+            t0 = time.perf_counter() if times is not None else 0.0
+            Xd = put_sharded(Xp, row_sh)
+            if p.label_in_chunk:
+                yd = wd = _ZERO
+            else:
                 yd = put_sharded(yp, vec_sh)
                 wd = put_sharded(wp, vec_sh)
             if times is not None:
@@ -787,6 +815,15 @@ class StreamingHashedLinearEstimator(Estimator):
         # the other streaming estimators. Enabled even at epochs=1 because
         # the cache doubles as the model's exposed device_chunks_
         cache = _DeviceCache(cache_device, cache_device_bytes)
+        spill: DiskChunkCache | None = None
+        spill_active = [False]      # toggled by the epoch loop; read by
+        #                             to_device on the prefetch thread
+        if cache_device and cache_spill_dir is not None and p.epochs > 1:
+            shapes = (((pad_rows, n_cols),) if p.label_in_chunk
+                      else ((pad_rows, n_cols), (pad_rows,), (pad_rows,)))
+            spill = DiskChunkCache(cache_spill_dir, shapes)
+            spill_active[0] = True
+        use_disk = False
         holdout: list = []         # device-resident holdout chunks
         n_steps = 0
         last_loss = None
@@ -819,9 +856,37 @@ class StreamingHashedLinearEstimator(Estimator):
             p.fused_replay and cache_device and p.epochs > 1
             and checkpointer is None and resume_from == 0
         )
+        def disk_chunk_iter():
+            """Device feed for an overflow replay epoch: padded records
+            straight off the spill memmap (no parsing), prefetch-overlapped
+            like the live stream. Skips the holdout tail — those records
+            were never trained in epoch 1 either."""
+            from orange3_spark_tpu.io.streaming import prefetch_map
+
+            def rec_to_device(i):
+                arrays, n = spill.read(i)
+                t0 = time.perf_counter() if times is not None else 0.0
+                Xd = put_sharded(np.asarray(arrays[0]), row_sh)
+                if p.label_in_chunk:
+                    yd = wd = _ZERO
+                else:
+                    yd = put_sharded(np.asarray(arrays[1]), vec_sh)
+                    wd = put_sharded(np.asarray(arrays[2]), vec_sh)
+                if times is not None:
+                    times["h2d_s"] += time.perf_counter() - t0
+                return Xd, jnp.int32(n), yd, wd
+
+            idxs = iter(range(spill.n_records - holdout_chunks))
+            if p.prefetch_depth > 0:
+                yield from prefetch_map(rec_to_device, idxs,
+                                        depth=p.prefetch_depth)
+            else:
+                for i in idxs:
+                    yield rec_to_device(i)
+
         for epoch in range(p.epochs):
             t_epoch = time.perf_counter()
-            if epoch == 0 or not cache.enabled:
+            if epoch == 0 or not (cache.enabled or use_disk):
                 # stream from the source; a look-ahead window keeps the LAST
                 # holdout_chunks device batches out of training
                 window: list = []
@@ -844,9 +909,36 @@ class StreamingHashedLinearEstimator(Estimator):
                         # never be trained on in replay epochs (exclude()
                         # keeps nbytes honest for the fuse_replay gate)
                         cache.exclude({id(c[0]) for c in holdout})
-            else:
+                if epoch == 0:
+                    spill_active[0] = False   # prefetch thread has exited
+                    if spill is not None:
+                        spill.finalize()
+                    if cache.degraded and p.epochs > 1:
+                        use_disk = (spill is not None
+                                    and spill.n_records > holdout_chunks)
+                        if not use_disk:
+                            warn_cache_overflow(
+                                cache_device_bytes, p.epochs - 1,
+                                detail=(
+                                    "The disk spill has no trainable "
+                                    "records (fewer chunks than the "
+                                    "holdout tail)."
+                                    if spill is not None else
+                                    "Set cache_spill_dir= to replay "
+                                    "parsed chunks at disk bandwidth "
+                                    "instead."
+                                ),
+                            )
+            elif cache.enabled:
                 # pure-HBM epoch: replay the cached chunks, no host at all
                 for dev_chunk in cache.batches:
+                    if n_steps < resume_from:
+                        n_steps += 1
+                        continue
+                    run_step(dev_chunk)
+            else:
+                # overflow epoch off the disk spill: read + DMA, no parse
+                for dev_chunk in disk_chunk_iter():
                     if n_steps < resume_from:
                         n_steps += 1
                         continue
@@ -879,12 +971,22 @@ class StreamingHashedLinearEstimator(Estimator):
                     epoch_walls.append(replay_fused_s)
                 break
 
+        if spill is not None:
+            spill.delete()
         if stage_times is not None and times is not None:
             stage_times.update(times)
             stage_times["epoch_s"] = [round(t, 3) for t in epoch_walls]
             if replay_fused_s is not None:
                 # one wall for ALL replay epochs (single fused dispatch)
                 stage_times["replay_fused_s"] = round(replay_fused_s, 3)
+            stage_times["cache_overflow"] = cache.degraded
+            stage_times["replay_source"] = (
+                None if p.epochs <= 1
+                else "fused" if replay_fused_s is not None
+                else "disk" if use_disk
+                else "hbm" if cache.enabled
+                else "stream"
+            )
         model = HashedLinearModel(
             p, theta, salts_np,
             class_values or (tuple(str(i) for i in range(p.n_classes))

@@ -89,10 +89,11 @@ def test_cache_budget_overflow_degrades_to_streaming(session):
     ref = StreamingHashedLinearEstimator(**kw).fit_stream(
         array_chunk_source(Xall, y, chunk_rows=1024), session=session,
     )
-    tiny = StreamingHashedLinearEstimator(**kw).fit_stream(
-        array_chunk_source(Xall, y, chunk_rows=1024), session=session,
-        cache_device=True, cache_device_bytes=1,  # nothing fits
-    )
+    with pytest.warns(RuntimeWarning, match="cache overflowed"):
+        tiny = StreamingHashedLinearEstimator(**kw).fit_stream(
+            array_chunk_source(Xall, y, chunk_rows=1024), session=session,
+            cache_device=True, cache_device_bytes=1,  # nothing fits
+        )
     assert tiny.device_chunks_ == []
     np.testing.assert_array_equal(
         np.asarray(ref.theta["emb"]), np.asarray(tiny.theta["emb"])
@@ -318,7 +319,8 @@ def test_dense_streaming_cache_budget_overflow_degrades(session):
                               cache_device=cache,
                               cache_device_bytes=budget)
 
-    m_over = fit(True, budget=1024)   # smaller than one batch
+    with pytest.warns(RuntimeWarning, match="cache overflowed"):
+        m_over = fit(True, budget=1024)   # smaller than one batch
     m_plain = fit(False)
     assert m_over.n_steps_ == m_plain.n_steps_ == 12
     np.testing.assert_array_equal(
@@ -387,7 +389,8 @@ def test_streaming_kmeans_cache_preseed_and_overflow(session):
     np.testing.assert_array_equal(
         np.asarray(m_c.centers), np.asarray(m_s.centers)
     )
-    m_o = fit(True, budget=64)   # smaller than one batch: degrade
+    with pytest.warns(RuntimeWarning, match="cache overflowed"):
+        m_o = fit(True, budget=64)   # smaller than one batch: degrade
     assert m_o.n_iter_ == m_s.n_iter_
     np.testing.assert_array_equal(
         np.asarray(m_o.centers), np.asarray(m_s.centers)
