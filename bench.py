@@ -538,9 +538,12 @@ def main():
         # honestly labeled, rather than record a 0.0 error line
         _force_cpu_backend()
         platform = "cpu"
-        if args.config == "criteo" and rows > cpu_rows:
-            _log(f"cpu fallback: reducing rows {rows} -> {cpu_rows}")
-            rows = cpu_rows
+    if platform == "cpu" and args.config == "criteo" and rows > cpu_rows:
+        # whether probed-as-cpu or fallen back: the full-scale config on a
+        # host CPU is a multi-hour run nobody asked for — cap it (raise
+        # OTPU_CPU_FALLBACK_ROWS to override)
+        _log(f"cpu backend: reducing rows {rows} -> {cpu_rows}")
+        rows = cpu_rows
 
     def run():
         if args.config == "criteo":

@@ -90,7 +90,15 @@ def _table_from_columns(
         else:
             attrs.append(var)
             attr_cols.append(vals)
-    X = np.stack(attr_cols, axis=1) if attr_cols else np.zeros((len(next(iter(columns.values()))), 0), np.float32)
+    if attr_cols:
+        X = np.stack(attr_cols, axis=1)
+    else:
+        # row count from an actual VALUE array: a raw column object may be
+        # a ('categorical', values, idx) tuple (parquet dictionary path)
+        # whose len() is the tuple arity, not the row count
+        col = next(iter(columns.values()))
+        n = len(col[2]) if isinstance(col, tuple) else len(col)
+        X = np.zeros((n, 0), np.float32)
     metas = np.stack(meta_cols, axis=1) if meta_cols else None
     domain = Domain(attrs, class_var, metas_vars)
     return TpuTable.from_numpy(domain, X, class_vals, metas, session=session)

@@ -334,8 +334,12 @@ class MultilabelClassificationEvaluator:
 
     evaluate(pred_ids [n, P], true_ids [n, T]) -> float; -1 pads ignored;
     ids within a row are treated as SETS (duplicates undefined, like
-    Spark). hammingLoss normalizes by the distinct label count across both
-    matrices (MLlib's numLabels).
+    Spark). hammingLoss normalizes by MLlib's numLabels = the distinct
+    count of TRUE labels only (predicted ids absent from every truth row
+    do not deflate it). Convention note: per-row 'accuracy' here returns
+    1.0 when BOTH the prediction and truth sets are empty; Spark's 0/0
+    yields NaN for such rows — we treat an exactly-matched empty set as
+    correct rather than poisoning the mean.
     """
 
     ParamsCls = MultilabelEvaluatorParams

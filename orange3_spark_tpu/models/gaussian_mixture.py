@@ -170,9 +170,33 @@ class GaussianMixture(Estimator):
     ParamsCls = GaussianMixtureParams
     params: GaussianMixtureParams
 
+    def _device_init(self, table: TpuTable):
+        """Tracer-safe init for staged refit (workflow/staging.py): means
+        by device-pure D²-categorical seeding (models/kmeans.py
+        ``device_d2_seed``), shared diagonal covariance from the weighted
+        full-data variance. Deterministic per seed, but a different random
+        stream than the host-sample init (same documented caveat as
+        KMeans)."""
+        from orange3_spark_tpu.models.kmeans import device_d2_seed
+
+        p = self.params
+        X, W = table.X, table.W
+        k0, k1 = jax.random.split(jax.random.PRNGKey(p.seed))
+        means0 = device_d2_seed(X, W, p.k, k0, k1)
+        wsum = jnp.maximum(jnp.sum(W), 1e-12)
+        mean = jnp.sum(X * W[:, None], axis=0) / wsum
+        var = jnp.maximum(
+            jnp.sum(((X - mean) ** 2) * W[:, None], axis=0) / wsum, 1e-3
+        )
+        covs0 = jnp.tile(jnp.diag(var)[None], (p.k, 1, 1))
+        weights0 = jnp.full((p.k,), 1.0 / p.k, dtype=jnp.float32)
+        return weights0, means0, covs0
+
     def _init(self, table: TpuTable):
         """kmeans++-style seeding on a host sample; shared covariance init."""
         p = self.params
+        if isinstance(table.X, jax.core.Tracer):
+            return self._device_init(table)
         rng = np.random.default_rng(p.seed)
         live = np.flatnonzero(np.asarray(jax.device_get(table.W)) > 0)
         if len(live) == 0:
@@ -208,5 +232,5 @@ class GaussianMixture(Estimator):
         )
         model = GaussianMixtureModel(p, weights, means, covs)
         model.n_iter_ = concrete_or_none(n_iter, int)
-        model.log_likelihood_ = float(ll)
+        model.log_likelihood_ = concrete_or_none(ll)
         return model

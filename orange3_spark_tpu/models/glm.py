@@ -290,19 +290,25 @@ class GeneralizedLinearRegression(Estimator):
         intercept = beta[d] if p.fit_intercept else jnp.float32(0.0)
         model = GeneralizedLinearRegressionModel(p, coef, intercept, link, link_power)
         model.n_iter_ = concrete_or_none(n_iter, int)
-        model.deviance_ = float(dev)
-        model.null_deviance_ = float(null_dev)
+        # diagnostics concretize only OUTSIDE a trace — under staged refit
+        # (workflow/staging.py refit=True) the honest value is None, and a
+        # float() here would make every GLM fit refit-in-trace INELIGIBLE
+        model.deviance_ = concrete_or_none(dev)
+        model.null_deviance_ = concrete_or_none(null_dev)
         # dispersion (MLlib): fixed at 1 for binomial/poisson, else the
         # Pearson chi-square statistic over residual degrees of freedom
-        n_eff = float(sum_w)
+        n_eff = concrete_or_none(sum_w)
         rank = d + (1 if p.fit_intercept else 0)
-        resid_dof = max(n_eff - rank, 1.0)
         if p.family in ("binomial", "poisson"):
             model.dispersion_ = 1.0
+        elif n_eff is None:
+            model.dispersion_ = None
         else:
-            model.dispersion_ = float(pearson) / resid_dof
-        model.aic_ = self._aic(
-            p.family, float(dev), n_eff, rank, table, model
+            model.dispersion_ = float(pearson) / max(n_eff - rank, 1.0)
+        model.aic_ = (
+            None if n_eff is None or model.deviance_ is None
+            else self._aic(p.family, model.deviance_, n_eff, rank, table,
+                           model)
         )
         return model
 

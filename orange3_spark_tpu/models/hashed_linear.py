@@ -92,6 +92,14 @@ class HashedLinearParams(Params):
     # layout. Requires n_dense == 0; -1 index padding is inert because its
     # value is 0 (zero forward contribution, zero gradient).
     value_weighted: bool = False
+    # Missing-value semantics (real Criteo TSV ships EMPTY cells in both
+    # dense and categorical columns; fastcsv parses empty dense -> NaN and
+    # empty marked-categorical -> crc32("")==0, the reserved code):
+    # 'zero' (default) imputes NaN dense cells to 0 and NaN categorical
+    # cells to the reserved code 0 INSIDE the jit (fused, free); 'keep'
+    # passes NaN through for an upstream imputer to handle — a NaN
+    # reaching the step then poisons the loss, visibly.
+    missing: str = "zero"        # 'zero' | 'keep'
 
 
 def _effective_k(p: HashedLinearParams) -> int:
@@ -110,6 +118,16 @@ def resolve_emb_update(p: HashedLinearParams) -> str:
     if p.emb_update == "auto":
         return "sorted" if jax.default_backend() == "tpu" else "fused"
     return p.emb_update
+
+
+def _impute_flag(p: HashedLinearParams) -> bool:
+    """Static impute flag for the jitted functions; value-weighted rows
+    carry explicit (index, value) pairs with their own -1/0 padding
+    convention, so 'zero' imputation only applies to the dense+categorical
+    layout."""
+    if p.missing not in ("zero", "keep"):
+        raise ValueError(f"missing must be 'zero' or 'keep', got {p.missing!r}")
+    return p.missing == "zero" and not p.value_weighted
 
 
 def _row_loss_kind(p: HashedLinearParams) -> str:
@@ -222,11 +240,15 @@ def _hashed_logits(theta, dense, idx, compute_dtype, emb_update: str = "fused",
 
 
 def _split_chunk(Xall, n_valid, y, w, *, label_in_chunk: bool, n_dense: int,
-                 value_weighted: bool = False):
+                 value_weighted: bool = False, impute_missing: bool = False):
     """In-jit chunk anatomy. label_in_chunk: column 0 is the label and the
     row mask is iota < n_valid (no y/w host vectors shipped at all).
     value_weighted: the feature block is C (index, value) PAIRS —
-    [idx..., val...] — instead of dense+categorical columns."""
+    [idx..., val...] — instead of dense+categorical columns.
+    impute_missing: NaN dense cells -> 0, NaN categorical cells -> the
+    reserved code 0 (== crc32 of the empty string, what fastcsv emits for
+    an empty marked-categorical cell) — Criteo-TSV missing-cell semantics,
+    fused into the step for free."""
     if label_in_chunk:
         yv = Xall[:, 0]
         feat = Xall[:, 1:]
@@ -239,20 +261,24 @@ def _split_chunk(Xall, n_valid, y, w, *, label_in_chunk: bool, n_dense: int,
     if value_weighted:
         C = feat.shape[1] // 2
         return yv, feat[:, :0], feat[:, :C], wv, feat[:, C:]
-    return yv, feat[:, :n_dense], feat[:, n_dense:], wv, None
+    dense, cats = feat[:, :n_dense], feat[:, n_dense:]
+    if impute_missing:
+        dense = jnp.where(jnp.isnan(dense), 0.0, dense)
+        cats = jnp.where(jnp.isnan(cats), 0.0, cats)
+    return yv, dense, cats, wv, None
 
 
 def _step_core(
     theta, opt_state, Xall, n_valid, y, w, salts, reg, lr,
     *, loss_kind: str, n_dims: int, n_dense: int, compute_dtype=jnp.float32,
     label_in_chunk: bool = False, emb_update: str = "fused",
-    value_weighted: bool = False,
+    value_weighted: bool = False, impute_missing: bool = False,
 ):
     """One adam step on one chunk — traced by both the per-chunk jit
     (`_hashed_step`) and the fused replay scan (`_hashed_replay_epochs`)."""
     yv, dense, cats, wv, vals = _split_chunk(
         Xall, n_valid, y, w, label_in_chunk=label_in_chunk, n_dense=n_dense,
-        value_weighted=value_weighted,
+        value_weighted=value_weighted, impute_missing=impute_missing,
     )
     idx = hash_columns(cats, salts, n_dims)
 
@@ -276,7 +302,7 @@ def _step_core(
     jax.jit,
     static_argnames=(
         "loss_kind", "n_dims", "n_dense", "compute_dtype", "label_in_chunk",
-        "emb_update", "value_weighted",
+        "emb_update", "value_weighted", "impute_missing",
     ),
     donate_argnums=(0, 1),
 )
@@ -284,13 +310,14 @@ def _hashed_step(
     theta, opt_state, Xall, n_valid, y, w, salts, reg, lr,
     *, loss_kind: str, n_dims: int, n_dense: int, compute_dtype=jnp.float32,
     label_in_chunk: bool = False, emb_update: str = "fused",
-    value_weighted: bool = False,
+    value_weighted: bool = False, impute_missing: bool = False,
 ):
     return _step_core(
         theta, opt_state, Xall, n_valid, y, w, salts, reg, lr,
         loss_kind=loss_kind, n_dims=n_dims, n_dense=n_dense,
         compute_dtype=compute_dtype, label_in_chunk=label_in_chunk,
         emb_update=emb_update, value_weighted=value_weighted,
+        impute_missing=impute_missing,
     )
 
 
@@ -298,7 +325,7 @@ def _hashed_step(
     jax.jit,
     static_argnames=(
         "loss_kind", "n_dims", "n_dense", "compute_dtype", "label_in_chunk",
-        "emb_update", "value_weighted", "n_epochs",
+        "emb_update", "value_weighted", "impute_missing", "n_epochs",
     ),
     donate_argnums=(0, 1),
 )
@@ -306,7 +333,8 @@ def _hashed_replay_epochs(
     theta, opt_state, Xstack, n_valid_vec, ystack, wstack, salts, reg, lr,
     *, loss_kind: str, n_dims: int, n_dense: int, compute_dtype=jnp.float32,
     label_in_chunk: bool = False, emb_update: str = "fused",
-    value_weighted: bool = False, n_epochs: int,
+    value_weighted: bool = False, impute_missing: bool = False,
+    n_epochs: int,
 ):
     """Epochs 2+ of a cached fit as ONE XLA program: an epoch-level scan
     around a chunk-level scan over the HBM-resident chunk stack.
@@ -321,7 +349,8 @@ def _hashed_replay_epochs(
     """
     kw = dict(loss_kind=loss_kind, n_dims=n_dims, n_dense=n_dense,
               compute_dtype=compute_dtype, label_in_chunk=label_in_chunk,
-              emb_update=emb_update, value_weighted=value_weighted)
+              emb_update=emb_update, value_weighted=value_weighted,
+              impute_missing=impute_missing)
 
     def chunk_body(carry, xs):
         theta, opt = carry
@@ -345,13 +374,15 @@ def _hashed_replay_epochs(
     return theta, opt_state, chunk_losses
 
 
-@partial(jax.jit, static_argnames=("n_dims", "n_dense", "value_weighted"))
+@partial(jax.jit, static_argnames=("n_dims", "n_dense", "value_weighted",
+                                       "impute_missing"))
 def _hashed_predict(theta, Xall, salts, *, n_dims: int, n_dense: int,
-                    value_weighted: bool = False):
+                    value_weighted: bool = False,
+                    impute_missing: bool = False):
     # one layout authority: the same _split_chunk the training step uses
     _, dense, cats, _, vals = _split_chunk(
         Xall, 0, None, None, label_in_chunk=False, n_dense=n_dense,
-        value_weighted=value_weighted,
+        value_weighted=value_weighted, impute_missing=impute_missing,
     )
     idx = hash_columns(cats, salts, n_dims)
     return _hashed_logits(theta, dense, idx, jnp.float32, vals=vals)
@@ -360,12 +391,12 @@ def _hashed_predict(theta, Xall, salts, *, n_dims: int, n_dense: int,
 @partial(
     jax.jit,
     static_argnames=("loss_kind", "n_dims", "n_dense", "label_in_chunk",
-                     "value_weighted"),
+                     "value_weighted", "impute_missing"),
 )
 def _hashed_eval_chunk(
     theta, Xall, n_valid, y, w, salts,
     *, loss_kind: str, n_dims: int, n_dense: int, label_in_chunk: bool,
-    value_weighted: bool = False,
+    value_weighted: bool = False, impute_missing: bool = False,
 ):
     """Device-side eval accumulators for one chunk: (weighted logloss sum,
     weighted correct sum, weight sum, pos/neg score histograms for AUC).
@@ -373,7 +404,7 @@ def _hashed_eval_chunk(
     host bandwidth is the scarcest resource in the whole pipeline."""
     yv, dense, cats, wv, vals = _split_chunk(
         Xall, n_valid, y, w, label_in_chunk=label_in_chunk, n_dense=n_dense,
-        value_weighted=value_weighted,
+        value_weighted=value_weighted, impute_missing=impute_missing,
     )
     idx = hash_columns(cats, salts, n_dims)
     logits = _hashed_logits(theta, dense, idx, jnp.float32, vals=vals)
@@ -429,7 +460,7 @@ class HashedLinearModel(Model):
         out = _hashed_predict(
             self.theta, jnp.asarray(Xall, jnp.float32),
             jnp.asarray(self.salts), n_dims=p.n_dims, n_dense=p.n_dense,
-            value_weighted=p.value_weighted,
+            value_weighted=p.value_weighted, impute_missing=_impute_flag(p),
         )
         return np.asarray(out)
 
@@ -504,6 +535,7 @@ class HashedLinearModel(Model):
                 loss_kind=kind, n_dims=p.n_dims, n_dense=p.n_dense,
                 label_in_chunk=p.label_in_chunk,
                 value_weighted=p.value_weighted,
+                impute_missing=_impute_flag(p),
             )
             tot = out if tot is None else tuple(
                 a + b for a, b in zip(tot, out)
@@ -573,7 +605,7 @@ def _init_fit_state(p: HashedLinearParams, session: TpuSession):
         loss_kind=_row_loss_kind(p), n_dims=p.n_dims, n_dense=p.n_dense,
         compute_dtype=jnp.dtype(p.compute_dtype),
         label_in_chunk=p.label_in_chunk, emb_update=resolve_emb_update(p),
-        value_weighted=p.value_weighted,
+        value_weighted=p.value_weighted, impute_missing=_impute_flag(p),
     )
     return theta, opt_state, salts_np, salts, static_kw
 

@@ -155,6 +155,44 @@ class KMeansModel(Model):
         return table.with_X(X, new_domain)
 
 
+def device_d2_seed(X, W, k: int, k0, k1) -> jnp.ndarray:
+    """Device-pure categorical D²-sampling (kmeans++) seeding — tracer-safe,
+    shared by KMeans (k-means|| init) and GaussianMixture (means init)
+    under staged refit, where the host-sample init cannot run."""
+    N, d = X.shape
+    live = W > 0
+    # first center: uniform over live rows via gumbel-max
+    g = jax.random.gumbel(k0, (N,))
+    i0 = jnp.argmax(jnp.where(live, g, -jnp.inf))
+    centers = jnp.zeros((k, d), X.dtype).at[0].set(X[i0])
+    d2 = jnp.where(live, jnp.sum((X - X[i0]) ** 2, axis=1), 0.0)
+
+    def body(c, carry):
+        centers, d2, key = carry
+        key, kc, ku = jax.random.split(key, 3)
+        mask = live & (d2 > 0)
+        logits = jnp.where(mask, jnp.log(jnp.maximum(d2, 1e-30)), -jnp.inf)
+        cat = jax.random.categorical(kc, logits)
+        # all remaining live points coincide with a seed: uniform pick
+        gu = jax.random.gumbel(ku, (N,))
+        uni = jnp.argmax(jnp.where(live, gu, -jnp.inf))
+        idx = jnp.where(jnp.any(mask), cat, uni)
+        # duplicate centers get per-coordinate jitter scaled to
+        # magnitude (same dead-center guard as kmeanspp_seed)
+        newc = X[idx] + jnp.where(
+            jnp.any(mask), 0.0,
+            1e-3 * (1.0 + jnp.abs(X[idx]))
+            * jax.random.normal(ku, (d,), X.dtype),
+        )
+        centers = centers.at[c].set(newc)
+        d2 = jnp.minimum(d2, jnp.sum((X - newc) ** 2, axis=1))
+        d2 = jnp.where(live, d2, 0.0)
+        return centers, d2, key
+
+    centers, _, _ = jax.lax.fori_loop(1, k, body, (centers, d2, k1))
+    return centers
+
+
 class KMeans(Estimator):
     ParamsCls = KMeansParams
     params: KMeansParams
@@ -190,36 +228,7 @@ class KMeans(Estimator):
             return jnp.where(dead[:, None], base[None, :] + jit_, centers)
         if p.init_mode != "k-means||":
             raise ValueError(f"unknown init_mode {p.init_mode!r}")
-        # first center: uniform over live rows via gumbel-max
-        g = jax.random.gumbel(k0, (N,))
-        i0 = jnp.argmax(jnp.where(live, g, -jnp.inf))
-        centers = jnp.zeros((p.k, d), X.dtype).at[0].set(X[i0])
-        d2 = jnp.where(live, jnp.sum((X - X[i0]) ** 2, axis=1), 0.0)
-
-        def body(c, carry):
-            centers, d2, key = carry
-            key, kc, ku = jax.random.split(key, 3)
-            mask = live & (d2 > 0)
-            logits = jnp.where(mask, jnp.log(jnp.maximum(d2, 1e-30)), -jnp.inf)
-            cat = jax.random.categorical(kc, logits)
-            # all remaining live points coincide with a seed: uniform pick
-            gu = jax.random.gumbel(ku, (N,))
-            uni = jnp.argmax(jnp.where(live, gu, -jnp.inf))
-            idx = jnp.where(jnp.any(mask), cat, uni)
-            # duplicate centers get per-coordinate jitter scaled to
-            # magnitude (same dead-center guard as kmeanspp_seed)
-            newc = X[idx] + jnp.where(
-                jnp.any(mask), 0.0,
-                1e-3 * (1.0 + jnp.abs(X[idx]))
-                * jax.random.normal(ku, (d,), X.dtype),
-            )
-            centers = centers.at[c].set(newc)
-            d2 = jnp.minimum(d2, jnp.sum((X - newc) ** 2, axis=1))
-            d2 = jnp.where(live, d2, 0.0)
-            return centers, d2, key
-
-        centers, _, _ = jax.lax.fori_loop(1, p.k, body, (centers, d2, k1))
-        return centers
+        return device_d2_seed(X, W, p.k, k0, k1)
 
     def _init_centers(self, table: TpuTable) -> jnp.ndarray:
         p = self.params

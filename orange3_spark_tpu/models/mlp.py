@@ -146,5 +146,5 @@ class MultilayerPerceptronClassifier(Estimator):
         )
         model = MultilayerPerceptronClassifierModel(p, net, class_values)
         model.n_iter_ = concrete_or_none(n_iter, int)
-        model.final_loss_ = float(loss)
+        model.final_loss_ = concrete_or_none(loss)
         return model

@@ -29,8 +29,14 @@ import xml.etree.ElementTree as ET
 from orange3_spark_tpu.widgets.catalog import WIDGET_REGISTRY
 from orange3_spark_tpu.workflow.graph import WorkflowGraph
 
-# explicit Orange/reference-add-on widget name -> our catalog name
+# explicit Orange/reference-add-on widget name -> our catalog name.
+# Catalog widgets whose own name normalizes to the canvas title (e.g.
+# 'k-Means' -> kmeans -> OWKMeans) resolve via the registry exact-match
+# below and need no row here; this table carries the names that DIFFER —
+# Orange3 canvas titles and OWSpark*-era aliases (SURVEY §2b r16;
+# reconstructed, mount empty).
 _NAME_MAP = {
+    # environment / sources / viewers
     "owsparkcontext": "OWTpuContext",
     "sparkcontext": "OWTpuContext",
     "sparkenvironment": "OWTpuContext",
@@ -39,27 +45,96 @@ _NAME_MAP = {
     "owfile": "OWCsvReader",
     "file": "OWCsvReader",
     "sparkdatasetreader": "OWCsvReader",
+    "sqltable": "OWSqlReader",
+    "owsqltable": "OWSqlReader",
+    "libsvmfile": "OWLibsvmReader",
     "datatable": "OWTableView",
     "owdatatable": "OWTableView",
     "datainfo": "OWDataInfo",
     "owdatainfo": "OWDataInfo",
+    # scoring / application
     "predictions": "OWApplyModel",
     "owpredictions": "OWApplyModel",
     "applymodel": "OWApplyModel",
     "testandscore": "OWMulticlassEvaluator",
+    "owtestandscore": "OWMulticlassEvaluator",
+    "owtestlearners": "OWMulticlassEvaluator",
+    # wrangling (Orange canvas titles)
     "selectcolumns": "OWSelectColumns",
     "owselectattributes": "OWSelectColumns",
     "selectattributes": "OWSelectColumns",
     "selectrows": "OWSelectRows",
     "owselectrows": "OWSelectRows",
+    "pivottable": "OWPivot",
+    "owpivot": "OWPivot",
+    "aggregate": "OWGroupBy",
+    "owaggregatecolumns": "OWGroupBy",
+    "mergedata": "OWJoin",
+    "owmergedata": "OWJoin",
+    "editdomain": "OWSelectColumns",
+    "transpose": "OWPivot",
+    # preprocessing (Orange canvas titles -> closest transformer)
+    "impute": "OWImputer",
+    "owimpute": "OWImputer",
+    "continuize": "OWOneHotEncoder",
+    "owcontinuize": "OWOneHotEncoder",
+    "discretize": "OWQuantileDiscretizer",
+    "owdiscretize": "OWQuantileDiscretizer",
+    "normalize": "OWNormalizer",
+    "scaling": "OWStandardScaler",
+    "featureconstructor": "OWRFormula",
+    "owfeatureconstructor": "OWRFormula",
+    "bagofwords": "OWCountVectorizer",
+    "owbagofwords": "OWCountVectorizer",
+    "corpustonetwork": "OWNGram",
+    # models (Orange canvas titles / MLlib names)
+    "randomforest": "OWRandomForestClassifier",
+    "owrandomforest": "OWRandomForestClassifier",
+    "randomforestregression": "OWRandomForestRegressor",
+    "gradientboosting": "OWGBTClassifier",
+    "owgradientboosting": "OWGBTClassifier",
+    "gradientboostedtrees": "OWGBTClassifier",
+    "tree": "OWDecisionTreeClassifier",
+    "owtree": "OWDecisionTreeClassifier",
+    "decisiontree": "OWDecisionTreeClassifier",
+    "svm": "OWLinearSVC",
+    "owsvm": "OWLinearSVC",
+    "linearsvm": "OWLinearSVC",
+    "neuralnetwork": "OWMultilayerPerceptronClassifier",
+    "ownnlearner": "OWMultilayerPerceptronClassifier",
+    "mlpclassifier": "OWMultilayerPerceptronClassifier",
+    "sgd": "OWStreamingLinearEstimator",
+    "owsgd": "OWStreamingLinearEstimator",
+    "stochasticgradientdescent": "OWStreamingLinearEstimator",
+    "louvainclustering": "OWKMeans",
+    "word2vecembedding": "OWWord2Vec",
+    "collaborativefiltering": "OWALS",
+    "owals": "OWALS",
+    "frequentitemsets": "OWFPGrowth",
+    "associationrules": "OWFPGrowth",
+    "correspondenceanalysis": "OWPCA",
+    "owpcawidget": "OWPCA",
 }
 
 _CHANNEL_MAP = {
     "data": "data", "preprocesseddata": "data", "sampledata": "data",
-    "table": "data", "dataframe": "data",
+    "table": "data", "dataframe": "data", "transformeddata": "data",
+    "scoreddata": "data", "selecteddata": "data", "remainingdata": "data",
+    "corpus": "data", "matchingdata": "data",
     "model": "model", "learner": "model", "classifier": "model",
     "predictor": "model", "predictors": "model", "transformer": "model",
-    "evaluationresults": "score",
+    "fittedmodel": "model", "clusterer": "model",
+    "evaluationresults": "score", "results": "score",
+}
+
+
+# _NAME_MAP rows that are semantic APPROXIMATIONS, not same-algorithm
+# renames: the import still works, but the substitution is recorded in
+# graph.import_report so the result's divergence from the saved workflow
+# is traceable (same contract as skipped nodes/links).
+_APPROX_ALIASES = {
+    "louvainclustering", "correspondenceanalysis", "transpose",
+    "editdomain", "corpustonetwork", "scaling", "featureconstructor",
 }
 
 
@@ -120,6 +195,12 @@ def read_ows(path: str, *, strict: bool = True) -> WorkflowGraph:
                 raise ValueError(msg + "; pass strict=False to skip it")
             skipped.append(msg)
             continue
+        if any(_norm(c) in _APPROX_ALIASES
+               for c in (qualified.rsplit(".", 1)[-1], name)):
+            skipped.append(
+                f".ows node {name!r} approximated by {wname} "
+                "(different algorithm; results will differ)"
+            )
         id_map[nd.get("id")] = graph.add(WIDGET_REGISTRY[wname]())
 
     props = root.find("node_properties")
@@ -131,6 +212,9 @@ def read_ows(path: str, *, strict: bool = True) -> WorkflowGraph:
             try:
                 settings = ast.literal_eval(pr.text or "{}")
             except (ValueError, SyntaxError):
+                skipped.append(
+                    f"settings for node {nid} unparsable; defaults kept"
+                )
                 continue
             node = graph.nodes[id_map[nid]]
             fields = {f.name for f in dataclasses.fields(node.widget.params)}

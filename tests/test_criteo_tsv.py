@@ -1,0 +1,124 @@
+"""Criteo-format fidelity (round-3 verdict item 5; BASELINE config 2).
+
+Real Criteo display-advertising data is TAB-delimited with NO header,
+1 label + 13 integer columns + 26 HEX-STRING categoricals, and EMPTY cells
+throughout. This file pins the flagship pipeline on the flagship FORMAT:
+
+  strict Criteo TSV -> csv_raw_chunk_source(categorical_cols=...) ->
+  StreamingHashedLinearEstimator(label_in_chunk=True) -> evaluate
+
+with the parse-time missing-value contract: empty dense cell -> NaN
+(imputable; the estimator's missing='zero' default imputes in-jit),
+empty categorical cell -> the reserved code 0 (crc32 of the empty string).
+"""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.io.streaming import csv_raw_chunk_source
+from orange3_spark_tpu.models.hashed_linear import (
+    StreamingHashedLinearEstimator,
+)
+
+N_DENSE, N_CAT = 13, 26
+CAT_COLS = tuple(range(1 + N_DENSE, 1 + N_DENSE + N_CAT))
+MASK = 0x00FFFFFF
+
+HEX_VOCAB = ["68fd1e64", "80e26c9b", "fb936136", "7b4723c4", "25c83c98",
+             "7e0ccccf", "de7995b8", "1f89b562", "a73ee510", "a8cd5504"]
+
+
+def _write_criteo_tsv(path, n_rows=2048, seed=0, missing_rate=0.15):
+    """Strict Criteo shape: no header, tabs, empties in dense AND
+    categorical cells; labels carry real signal from one categorical."""
+    rng = np.random.default_rng(seed)
+    lines = []
+    for _ in range(n_rows):
+        c0 = rng.integers(len(HEX_VOCAB))
+        label = int((c0 < 4) ^ (rng.random() < 0.15))   # signal + noise
+        # small integer counts (real Criteo dense columns are counts that
+        # users log-transform; keeping them O(1..10) keeps this fixture's
+        # un-standardized fit well-conditioned)
+        dense = [
+            "" if rng.random() < missing_rate else str(rng.integers(0, 10))
+            for _ in range(N_DENSE)
+        ]
+        cats = [HEX_VOCAB[c0]] + [
+            "" if rng.random() < missing_rate
+            else HEX_VOCAB[rng.integers(len(HEX_VOCAB))]
+            for _ in range(N_CAT - 1)
+        ]
+        lines.append("\t".join([str(label)] + dense + cats))
+    path.write_text("\n".join(lines) + "\n")
+    return path
+
+
+def test_parse_time_missing_value_semantics(tmp_path):
+    """Empty dense -> NaN; empty categorical -> reserved code 0; hex
+    strings -> zlib.crc32 & 0xFFFFFF, byte-exact."""
+    p = tmp_path / "mini.tsv"
+    p.write_text(
+        "1\t" + "\t".join(["3"] * 6 + [""] + ["7"] * 6)          # I7 empty
+        + "\t" + "\t".join(["68fd1e64"] + [""] + ["fb936136"] * 24)  # C2 empty
+        + "\n"
+        "0\t" + "\t".join([""] * N_DENSE)                        # all empty
+        + "\t" + "\t".join([""] * N_CAT) + "\n"
+    )
+    src = csv_raw_chunk_source(str(p), delimiter="\t", header=False,
+                               categorical_cols=CAT_COLS)
+    chunk = next(iter(src()))
+    assert chunk.shape == (2, 1 + N_DENSE + N_CAT)
+    assert chunk[0, 0] == 1.0 and chunk[1, 0] == 0.0
+    assert np.isnan(chunk[0, 7])                   # empty dense cell
+    assert chunk[0, 1] == 3.0 and chunk[0, 13] == 7.0
+    assert np.isnan(chunk[1, 1:1 + N_DENSE]).all()
+    # hex categoricals: exact crc32 codes
+    assert chunk[0, 14] == float(zlib.crc32(b"68fd1e64") & MASK)
+    assert chunk[0, 16] == float(zlib.crc32(b"fb936136") & MASK)
+    # empty categorical: the reserved code 0 (crc32(b"") == 0)
+    assert chunk[0, 15] == 0.0
+    assert (chunk[1, 1 + N_DENSE:] == 0.0).all()
+
+
+def test_criteo_tsv_fits_end_to_end(session, tmp_path):
+    """The flagship path parses the flagship format and LEARNS through
+    missing cells: tabs + hex + empties -> fit -> holdout metrics."""
+    path = _write_criteo_tsv(tmp_path / "train.tsv")
+    src = csv_raw_chunk_source(str(path), delimiter="\t", header=False,
+                               chunk_rows=512, categorical_cols=CAT_COLS)
+    est = StreamingHashedLinearEstimator(
+        n_dims=1 << 14, n_dense=N_DENSE, n_cat=N_CAT, epochs=8,
+        step_size=0.08, chunk_rows=512, label_in_chunk=True,
+    )
+    model = est.fit_stream(src, session=session, cache_device=True,
+                           holdout_chunks=1)
+    assert np.isfinite(model.final_loss_), "NaNs leaked through imputation"
+    ev = model.evaluate_device(model.holdout_chunks_)
+    assert np.isfinite(ev["logloss"])
+    assert ev["auc"] > 0.8, f"failed to learn from hex categoricals: {ev}"
+
+
+def test_missing_keep_poisons_visibly(session, tmp_path):
+    """missing='keep' hands NaN through untouched — the documented
+    contract for pipelines with their own imputer: a NaN that reaches the
+    step shows up in the loss instead of being silently zeroed."""
+    path = _write_criteo_tsv(tmp_path / "train.tsv", n_rows=512)
+    src = csv_raw_chunk_source(str(path), delimiter="\t", header=False,
+                               chunk_rows=512, categorical_cols=CAT_COLS)
+    est = StreamingHashedLinearEstimator(
+        n_dims=1 << 14, n_dense=N_DENSE, n_cat=N_CAT, epochs=1,
+        step_size=0.08, chunk_rows=512, label_in_chunk=True, missing="keep",
+    )
+    model = est.fit_stream(src, session=session)
+    assert not np.isfinite(model.final_loss_)
+
+
+def test_missing_param_validated():
+    with pytest.raises(ValueError, match="missing"):
+        from orange3_spark_tpu.models.hashed_linear import (
+            HashedLinearParams, _impute_flag,
+        )
+
+        _impute_flag(HashedLinearParams(missing="drop"))

@@ -1,0 +1,122 @@
+"""TRUE multi-process multihost test (round-3 verdict item 3).
+
+Spawns 2 subprocesses with ``jax.distributed.initialize`` on CPU (4 fake
+devices each -> one 8-device global mesh across processes, gloo
+collectives), each reading its ``process_row_slice`` of a shared CSV and
+contributing it through ``put_sharded``'s ``process_count>1`` branch —
+the code path a single-process ``force_global`` test cannot exercise
+(there, local block == global array by construction, so block ordering
+and per-process shape bugs are invisible).
+
+Asserts the assembled global array AND a real sharded LogisticRegression
+fit match the single-process ground truth. Skips cleanly if the sandbox
+forbids multi-process coordination.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "_mp_worker.py")
+N_ROWS, N_COLS = 1000, 4
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env() -> dict:
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p
+    )
+    return env
+
+
+@pytest.fixture(scope="module")
+def mp_results(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("mp")
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((N_ROWS, N_COLS)).astype(np.float32)
+    w_true = np.asarray([1.5, -2.0, 0.7, 0.0], np.float32)
+    y = (X @ w_true + 0.3 * rng.standard_normal(N_ROWS) > 0).astype(np.float32)
+    csv = tmp / "shared.csv"
+    header = ",".join([f"f{i}" for i in range(N_COLS)] + ["y"])
+    np.savetxt(csv, np.column_stack([X, y]), delimiter=",",
+               header=header, comments="", fmt="%.7g")
+
+    port = _free_port()
+    out = tmp / "out.npz"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(i), "2", str(port), str(csv),
+             str(out)],
+            env=_worker_env(), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    logs = []
+    try:
+        for p in procs:
+            stdout, _ = p.communicate(timeout=300)
+            logs.append(stdout)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("multi-process jax.distributed timed out in this sandbox")
+    if any(p.returncode != 0 for p in procs):
+        joined = "\n".join(logs)
+        if "distributed" in joined and ("denied" in joined.lower()
+                                        or "unavailable" in joined.lower()):
+            pytest.skip(f"sandbox forbids multi-process jax: {joined[-400:]}")
+        raise AssertionError(f"worker failed:\n{joined}")
+    return X, y, np.load(out)
+
+
+def test_two_process_global_assembly(mp_results):
+    X, y, res = mp_results
+    assert int(res["process_count"]) == 2
+    # global array = concatenation of both process blocks: its column sums
+    # equal the FULL dataset's (padding rows are zeros)
+    np.testing.assert_allclose(res["colsum"], X.sum(axis=0), rtol=1e-4)
+    assert int(res["global_rows"]) >= N_ROWS
+    # shard_paths round-robins 2 files across 2 processes
+    assert int(res["n_shard_paths"]) == 1
+
+
+def test_two_process_sharded_fit_matches_single_process(mp_results, session):
+    """The fit ran SPMD over blocks no single process ever held together;
+    its coefficients must match the single-process fit of the full data."""
+    X, y, res = mp_results
+
+    from orange3_spark_tpu.core.domain import (
+        ContinuousVariable, DiscreteVariable, Domain,
+    )
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.models.logistic_regression import (
+        LogisticRegression,
+    )
+
+    domain = Domain(
+        [ContinuousVariable(f"f{i}") for i in range(N_COLS)],
+        DiscreteVariable("y", ("0", "1")),
+    )
+    table = TpuTable.from_numpy(domain, X, y, session=session)
+    ref = LogisticRegression(max_iter=100, reg_param=1e-3).fit(table)
+    np.testing.assert_allclose(
+        res["coef"], np.asarray(ref.coef), rtol=5e-3, atol=5e-4
+    )
+    np.testing.assert_allclose(
+        res["intercept"], np.asarray(ref.intercept), rtol=5e-3, atol=5e-4
+    )

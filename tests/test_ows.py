@@ -192,3 +192,91 @@ def test_read_canvas_saved_ows(session, tmp_path):
     scid = by_name["OWStandardScaler"]
     assert (scid, "data", lrid, "data") in ports
     assert (scid, "data", apid, "data") in ports
+
+
+def test_every_catalog_widget_survives_ows_roundtrip(session, tmp_path, iris):
+    """export -> import per catalog widget: the widget resolves by name,
+    its params round-trip, and a data link into it survives (round-3
+    verdict item 6 — no silent link drops for ANY registered widget)."""
+    from orange3_spark_tpu.widgets.catalog import WIDGET_REGISTRY, OWTable
+    from orange3_spark_tpu.workflow.graph import WorkflowGraph
+
+    failures = []
+    for wname, wcls in sorted(WIDGET_REGISTRY.items()):
+        g = WorkflowGraph()
+        w = OWTable(iris) if wname == "OWTable" else wcls()
+        nid = g.add(w)
+        in_names = {i.name for i in wcls.inputs}
+        src = None
+        if in_names:
+            src = g.add(OWTable(iris))
+            for port in sorted(in_names):
+                g.connect(src, "data", nid, port)
+        p = tmp_path / f"{wname}.ows"
+        write_ows(g, str(p))
+        try:
+            g2 = read_ows(str(p), strict=True)
+        except Exception as e:  # noqa: BLE001 - collected for the report
+            failures.append(f"{wname}: {type(e).__name__}: {e}")
+            continue
+        names = sorted(n.widget.name for n in g2.nodes.values())
+        want = sorted([wname] + (["OWTable"] if src is not None else []))
+        if names != want:
+            failures.append(f"{wname}: imported as {names}, wanted {want}")
+            continue
+        if len(g2.edges) != len(g.edges):
+            failures.append(
+                f"{wname}: {len(g.edges)} links exported, "
+                f"{len(g2.edges)} imported"
+            )
+            continue
+        w2 = next(n.widget for n in g2.nodes.values()
+                  if n.widget.name == wname)
+        if w2.params.to_dict() != w.params.to_dict():
+            failures.append(f"{wname}: params did not round-trip")
+    assert not failures, "\n".join(failures)
+
+
+def test_canvas_alias_names_resolve(session):
+    """Orange canvas titles and OWSpark-era aliases map onto the catalog."""
+    from orange3_spark_tpu.workflow.ows import _resolve_widget
+
+    cases = {
+        ("Random Forest", "Orange.widgets.model.owrandomforest"):
+            "OWRandomForestClassifier",
+        ("Gradient Boosting", ""): "OWGBTClassifier",
+        ("Tree", "Orange.widgets.model.owtree"): "OWDecisionTreeClassifier",
+        ("SVM", ""): "OWLinearSVC",
+        ("Neural Network", "Orange.widgets.model.ownnlearner"):
+            "OWMultilayerPerceptronClassifier",
+        ("k-Means", ""): "OWKMeans",
+        ("Impute", ""): "OWImputer",
+        ("Discretize", ""): "OWQuantileDiscretizer",
+        ("Continuize", ""): "OWOneHotEncoder",
+        ("Merge Data", ""): "OWJoin",
+        ("Pivot Table", ""): "OWPivot",
+        ("Test and Score", "Orange.widgets.evaluate.owtestandscore"):
+            "OWMulticlassEvaluator",
+        ("Logistic Regression", ""): "OWLogisticRegression",
+        ("PCA", ""): "OWPCA",
+        ("Spark KMeans", ""): "OWKMeans",
+    }
+    for (name, qual), want in cases.items():
+        assert _resolve_widget(name, qual) == want, (name, qual, want)
+
+
+def test_approximate_aliases_are_reported(session, tmp_path):
+    """A semantic-approximation alias (different algorithm) imports but
+    leaves a trace in import_report — never a silent substitution."""
+    p = tmp_path / "approx.ows"
+    p.write_text(
+        '<?xml version="1.0"?><scheme version="2.0" title="t">'
+        '<nodes>'
+        '<node id="0" name="Louvain Clustering" '
+        ' qualified_name="Orange.widgets.unsupervised.owlouvain"/>'
+        '</nodes><links/><node_properties/></scheme>'
+    )
+    g = read_ows(str(p), strict=False)
+    names = [n.widget.name for n in g.nodes.values()]
+    assert names == ["OWKMeans"]
+    assert any("approximated by OWKMeans" in s for s in g.import_report)

@@ -594,3 +594,103 @@ def test_groupby_pivot_json_roundtrip(session):
     assert Xp.shape == (2, 3)   # key + 2 quarters
     _, _, W = g2.nodes[sr].widget.process(t)["data"].to_numpy()
     assert 0 < (W[:100] > 0).sum() < 100
+
+
+def test_refit_fallback_reason_carries_the_actual_error(session):
+    """An estimator whose fit genuinely cannot trace must land in
+    refit_fallbacks WITH the tracing error recorded — a silently-broken
+    fit and a merely-untraceable one must be distinguishable."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from orange3_spark_tpu.models.base import Estimator, Model, Params
+    from orange3_spark_tpu.models.logistic_regression import (
+        LogisticRegression,
+    )
+    from orange3_spark_tpu.widgets.catalog import widget_for_estimator
+
+    @dataclasses.dataclass(frozen=True)
+    class HostileParams(Params):
+        pass
+
+    class HostileModel(Model):
+        def __init__(self, params, mean):
+            self.params = params
+            self.mean = mean
+
+        def transform(self, table):
+            return table
+
+    class HostileEstimator(Estimator):
+        """Concretizes a device scalar mid-fit: traces must fail."""
+
+        ParamsCls = HostileParams
+
+        def _fit(self, table):
+            return HostileModel(self.params, float(jnp.sum(table.X)))
+
+    HostileWidget = widget_for_estimator(HostileEstimator, "OWHostileTest")
+    iris = load_iris(session)
+    g = WorkflowGraph()
+    src = g.add(OWTable(iris))
+    bad = g.add(HostileWidget())
+    lr = g.add(WIDGET_REGISTRY["OWLogisticRegression"](max_iter=20))
+    g.connect(src, "data", bad, "data")
+    g.connect(bad, "data", lr, "data")
+
+    from orange3_spark_tpu.workflow.staging import stage_graph
+
+    staged = stage_graph(g, lr, refit=True)
+    falls = [f for f in staged.refit_fallbacks if f["widget"] == "OWHostileTest"]
+    assert len(falls) == 1
+    reason = falls[0]["reason"]
+    assert "fit not traceable" in reason
+    # the actual exception type + message travels with the report
+    assert "Error" in reason and "(" in reason
+    # the graph still stages and runs (closed-over eager state)
+    out = staged()
+    assert out.n_rows == iris.n_rows
+
+
+def test_glm_gmm_mlp_are_refit_in_trace_eligible(session):
+    """Host-scalar diagnostics (deviance_, log_likelihood_, final_loss_)
+    must concretize to None under a trace instead of crashing it — these
+    three families previously always fell back under refit=True."""
+    from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.workflow.staging import stage_graph
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((96, 4)).astype(np.float32)
+    yc = (X[:, 0] > 0).astype(np.float32)
+    yr = (X @ rng.standard_normal(4).astype(np.float32) + 1.0)
+
+    # regression target graph (GLM)
+    dom_r = Domain([ContinuousVariable(f"f{i}") for i in range(4)],
+                   ContinuousVariable("y"))
+    t_r = TpuTable.from_numpy(dom_r, X, yr, session=session)
+    g = WorkflowGraph()
+    src = g.add(OWTable(t_r))
+    glm = g.add(WIDGET_REGISTRY["OWGeneralizedLinearRegression"](max_iter=10))
+    g.connect(src, "data", glm, "data")
+    staged = stage_graph(g, glm, refit=True)
+    assert staged.refit_fallbacks == [], staged.refit_fallbacks
+
+    # unsupervised graph (GaussianMixture); classifier graph (MLP)
+    from orange3_spark_tpu.core.domain import DiscreteVariable
+
+    dom_u = Domain([ContinuousVariable(f"f{i}") for i in range(4)])
+    t_u = TpuTable.from_numpy(dom_u, X, session=session)
+    for wname, table in (("OWGaussianMixture", t_u),
+                         ("OWMultilayerPerceptronClassifier", None)):
+        if table is None:
+            dom_c = Domain([ContinuousVariable(f"f{i}") for i in range(4)],
+                           DiscreteVariable("y", ("0", "1")))
+            table = TpuTable.from_numpy(dom_c, X, yc, session=session)
+        g = WorkflowGraph()
+        src = g.add(OWTable(table))
+        est = g.add(WIDGET_REGISTRY[wname]())
+        g.connect(src, "data", est, "data")
+        staged = stage_graph(g, est, refit=True)
+        assert staged.refit_fallbacks == [], (wname, staged.refit_fallbacks)
