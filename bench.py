@@ -253,10 +253,11 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
             epochs=e, step_size=step_size, reg_param=reg,
             chunk_rows=CHUNK_ROWS,
             label_in_chunk=True, prefetch_depth=2,
-            # tools/step_ab.py on the v5e chip (262k rows, 2^22 dims):
-            # sorted 0.95 ms/step < per_column 1.17 < fused 2.38 — the
-            # sort-then-conflict-free-scatter backward wins on TPU
-            emb_update="sorted",
+            # 'auto' resolves to 'sorted' on TPU (tools/step_ab.py on the
+            # v5e chip: sorted 0.95 ms/step < per_column 1.17 < fused
+            # 2.38) and 'fused' elsewhere — a CPU-labeled fallback run
+            # must not pay the sort XLA:CPU is known-slow at
+            emb_update="auto",
         )
 
     source = csv_raw_chunk_source(path, chunk_rows=CHUNK_ROWS)

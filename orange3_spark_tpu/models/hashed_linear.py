@@ -888,11 +888,12 @@ class StreamingHashedLinearEstimator(Estimator):
             p.fused_replay and cache_device and p.epochs > 1
             and checkpointer is None and resume_from == 0
         )
-        def disk_chunk_iter():
+        def disk_chunk_iter(start: int = 0):
             """Device feed for an overflow replay epoch: padded records
             straight off the spill memmap (no parsing), prefetch-overlapped
             like the live stream. Skips the holdout tail — those records
-            were never trained in epoch 1 either."""
+            were never trained in epoch 1 either. ``start`` lets the
+            grouped path hand its partial tail here."""
             from orange3_spark_tpu.io.streaming import prefetch_map
 
             def rec_to_device(i):
@@ -908,13 +909,53 @@ class StreamingHashedLinearEstimator(Estimator):
                     times["h2d_s"] += time.perf_counter() - t0
                 return Xd, jnp.int32(n), yd, wd
 
-            idxs = iter(range(spill.n_records - holdout_chunks))
+            idxs = iter(range(start, spill.n_records - holdout_chunks))
             if p.prefetch_depth > 0:
                 yield from prefetch_map(rec_to_device, idxs,
                                         depth=p.prefetch_depth)
             else:
                 for i in idxs:
                     yield rec_to_device(i)
+
+        def disk_group_iter(group: int):
+            """Grouped feed for fused disk replay: G records stacked into
+            one [G, pad_rows, ...] device batch per item — one scan
+            dispatch trains the whole group (see the replay branch).
+            Yields FULL groups only; the partial tail (a different leading
+            shape that would force a second scan compile) goes through the
+            per-chunk step, which is already compiled from epoch 1."""
+            from orange3_spark_tpu.io.streaming import prefetch_map
+
+            n_train = spill.n_records - holdout_chunks
+            n_full = (n_train // group) * group
+
+            def grp_to_device(start):
+                g = group
+                recs = [spill.read(start + j) for j in range(g)]
+                t0 = time.perf_counter() if times is not None else 0.0
+                Xs = put_sharded(
+                    np.stack([np.asarray(r[0][0]) for r in recs]),
+                    session.sharding(None, session.data_axis, None),
+                )
+                nv = jnp.asarray([r[1] for r in recs], jnp.int32)
+                if p.label_in_chunk:
+                    ys = ws = jnp.zeros((g, 1), jnp.float32)
+                else:
+                    vsh = session.sharding(None, session.data_axis)
+                    ys = put_sharded(
+                        np.stack([np.asarray(r[0][1]) for r in recs]), vsh)
+                    ws = put_sharded(
+                        np.stack([np.asarray(r[0][2]) for r in recs]), vsh)
+                if times is not None:
+                    times["h2d_s"] += time.perf_counter() - t0
+                return g, (Xs, nv, ys, ws)
+
+            starts = iter(range(0, n_full, group))
+            if p.prefetch_depth > 0:
+                yield from prefetch_map(grp_to_device, starts, depth=1)
+            else:
+                for s in starts:
+                    yield grp_to_device(s)
 
         for epoch in range(p.epochs):
             t_epoch = time.perf_counter()
@@ -969,12 +1010,49 @@ class StreamingHashedLinearEstimator(Estimator):
                         continue
                     run_step(dev_chunk)
             else:
-                # overflow epoch off the disk spill: read + DMA, no parse
-                for dev_chunk in disk_chunk_iter():
-                    if n_steps < resume_from:
-                        n_steps += 1
-                        continue
-                    run_step(dev_chunk)
+                # overflow epoch off the disk spill: read + DMA, no parse.
+                # When no per-step checkpoint granularity is needed, G
+                # records stack into one device batch and train as ONE
+                # scan dispatch (_hashed_replay_epochs, n_epochs=1) —
+                # dispatch count drops G-fold, which matters on tunneled
+                # hosts where each dispatch costs ~hundreds of ms. G is
+                # sized so current group + prefetched group + transient
+                # scan copies stay inside the cache budget.
+                rec_bytes = spill.record_floats * 4
+                group = max(1, min(spill.n_records,
+                                   cache_device_bytes // (4 * rec_bytes)))
+                if (p.fused_replay and checkpointer is None
+                        and resume_from == 0 and group > 1):
+                    if times is not None:
+                        times["disk_replay_group"] = group
+                    n_groups = 0
+                    for g, stacks in disk_group_iter(group):
+                        theta, opt_state, losses = _hashed_replay_epochs(
+                            theta, opt_state, *stacks, salts, reg, lr,
+                            n_epochs=1, **static_kw,
+                        )
+                        n_steps += g
+                        n_groups += 1
+                        last_loss = losses[-1, -1]
+                        # bound by GROUPS, not steps: each in-flight group
+                        # dispatch pins a budget/4-byte input stack, so 16
+                        # unsynced groups would hold ~4x the cache budget
+                        # in HBM; period=2 keeps one executing + one queued
+                        # (+ the prefetched next group) <= 3/4 budget
+                        bound_dispatch(n_groups, last_loss, period=2)
+                    # partial tail group (different leading shape would
+                    # recompile the scan): per-chunk steps, already
+                    # compiled from epoch 1
+                    n_train_recs = spill.n_records - holdout_chunks
+                    for dev_chunk in disk_chunk_iter(
+                            start=(n_train_recs // group) * group):
+                        run_step(dev_chunk)
+                else:
+                    for dev_chunk in disk_chunk_iter():
+                        if n_steps < resume_from:
+                            n_steps += 1
+                            continue
+                        run_step(dev_chunk)
             if stage_times is not None:
                 if last_loss is not None:
                     jax.block_until_ready(last_loss)  # honest epoch wall

@@ -150,3 +150,64 @@ def test_dense_streaming_overflow_warns(session):
             array_chunk_source(X, y, chunk_rows=512), n_features=8,
             session=session, cache_device=True, cache_device_bytes=1,
         )
+
+
+def test_grouped_disk_replay_matches_per_chunk(session, tmp_path):
+    """fused_replay=True on an overflowed fit trains replay epochs as
+    grouped scan dispatches off the spill — same records, same order,
+    same numbers as the per-chunk loop (fused_replay=False)."""
+    Xall, y = _criteo_shaped(16384, seed=21)   # 16 chunks of 1024
+    src = array_chunk_source(Xall, y, chunk_rows=1024)
+    # (X + y + w) per padded chunk; budget holds 12 chunks in HBM
+    # (overflow at chunk 13) yet sizes the replay group to 3
+    rec_bytes = 1024 * (10 + 1 + 1) * 4
+    budget = 4 * rec_bytes * 3
+
+    def fit(fused):
+        st: dict = {}
+        m = _est(fused_replay=fused).fit_stream(
+            src, session=session, cache_device=True,
+            cache_device_bytes=budget, cache_spill_dir=str(tmp_path),
+            stage_times=st,
+        )
+        assert st["cache_overflow"] is True
+        assert st["replay_source"] == "disk"
+        if fused:
+            assert st.get("disk_replay_group", 0) == 3  # grouped path ran
+        return m
+
+    grouped, looped = fit(True), fit(False)
+    assert grouped.n_steps_ == looped.n_steps_
+    np.testing.assert_allclose(
+        np.asarray(grouped.theta["emb"]), np.asarray(looped.theta["emb"]),
+        rtol=2e-5, atol=2e-7,
+    )
+    np.testing.assert_allclose(
+        np.asarray(grouped.theta["coef"]), np.asarray(looped.theta["coef"]),
+        rtol=2e-5, atol=2e-7,
+    )
+
+
+def test_grouped_disk_replay_label_in_chunk_with_holdout(session, tmp_path):
+    """Grouped replay through the raw-chunk bench path, holdout excluded
+    (the 15 train records split into groups of 3, never touching the
+    held-out tail record)."""
+    Xall, y = _criteo_shaped(16384, seed=22)   # 16 chunks of 1024
+    raw = np.concatenate([y[:, None], Xall], axis=1).astype(np.float32)
+
+    def raw_source():
+        for s in range(0, len(raw), 1024):
+            yield raw[s:s + 1024]
+
+    rec_bytes = 1024 * 11 * 4          # one [pad, 1+cols] record
+    st: dict = {}
+    m = _est(label_in_chunk=True, fused_replay=True).fit_stream(
+        raw_source, session=session, cache_device=True,
+        cache_device_bytes=4 * rec_bytes * 3, cache_spill_dir=str(tmp_path),
+        holdout_chunks=1, stage_times=st,
+    )
+    assert st["replay_source"] == "disk"
+    assert st.get("disk_replay_group", 0) == 3
+    assert m.n_steps_ == 15 * 3          # 15 train chunks x 3 epochs
+    ev = m.evaluate_device(m.holdout_chunks_)
+    assert 0.0 < ev["logloss"] < 2.0
