@@ -462,11 +462,15 @@ class StreamingKMeans(Estimator):
     def fit_stream(self, source: Callable[[], Iterator[Chunk]], *,
                    n_features: int, session: TpuSession | None = None,
                    cache_device: bool = False,
-                   cache_device_bytes: int = 8 << 30):
+                   cache_device_bytes: int = 8 << 30,
+                   cache_spill_dir: str | None = None):
         """cache_device: retain epoch-1 device batches in HBM and replay
-        them for epochs 2+ (skips host re-parse/re-DMA; degrades to pure
-        streaming past ``cache_device_bytes`` — same contract as the other
-        streaming estimators)."""
+        them for epochs 2+ (skips host re-parse/re-DMA; degrades past
+        ``cache_device_bytes`` — same contract as the other streaming
+        estimators). cache_spill_dir: epoch-1 disk spill of the padded
+        chunks; on cache overflow (the Taxi-1B regime, BASELINE config 5)
+        epochs 2+ replay records at disk bandwidth instead of re-parsing
+        the source."""
         from orange3_spark_tpu.models.kmeans import KMeansModel, KMeansParams
 
         p = self.params
@@ -481,14 +485,33 @@ class StreamingKMeans(Estimator):
         n_steps = 0
         cache = _DeviceCache(cache_device and p.epochs > 1,
                              cache_device_bytes)
+        spill: DiskChunkCache | None = None
+        if cache_device and cache_spill_dir is not None and p.epochs > 1:
+            spill = DiskChunkCache(
+                cache_spill_dir, ((pad_rows, n_features), (pad_rows,))
+            )
+        use_disk = False
         for epoch in range(p.epochs):
-            if epoch > 0 and cache.enabled:
+            if epoch > 0 and (cache.enabled or use_disk):
                 if centers is None:
                     raise ValueError("stream produced no live rows")
                 # pre_seed batches were SKIPPED in epoch 1 (streamed before
                 # seeding) but streaming epochs 2+ step them (centers exist
                 # by then) — replay must step them too for exact parity
-                for Xd, wd, _pre_seed in cache.batches:
+                if cache.enabled:
+                    batches = iter(cache.batches)
+                else:
+                    def _rec(i):
+                        arrs, _n = spill.read(i)
+                        return (put_sharded(np.asarray(arrs[0]), row_sh),
+                                put_sharded(np.asarray(arrs[1]), vec_sh),
+                                None)
+
+                    # read+DMA of record t+1 overlaps the device step on
+                    # record t — same overlap engine as the live stream
+                    batches = prefetch_map(_rec, iter(range(spill.n_records)),
+                                           depth=2)
+                for Xd, wd, _pre_seed in batches:
                     centers, counts, cost = _kmeans_stream_step(
                         centers, counts, Xd, wd, decay, k=p.k
                     )
@@ -506,10 +529,10 @@ class StreamingKMeans(Estimator):
                             else np.flatnonzero(np.asarray(w_np) > 0))
                     if len(live) < 1:
                         # no live rows to seed from: the batch is skipped
-                        # THIS epoch but must still enter the cache —
+                        # THIS epoch but must still enter the cache/spill —
                         # streaming epochs 2+ would step it
                         pre_seed = True
-                        if not cache.enabled:
+                        if not cache.enabled and spill is None:
                             continue  # pure streaming: skip pad/DMA too
                     else:
                         if len(live) > 8192:
@@ -520,6 +543,8 @@ class StreamingKMeans(Estimator):
                             session.replicated,
                         )
                 Xp, _, wp = _pad_chunk(X_np, None, w_np, pad_rows, n_features)
+                if epoch == 0 and spill is not None:
+                    spill.append((Xp, wp), n)
                 Xd = put_sharded(Xp, row_sh)
                 wd = put_sharded(wp, vec_sh)
                 if epoch == 0:
@@ -531,8 +556,15 @@ class StreamingKMeans(Estimator):
                 )
                 n_steps += 1
                 bound_dispatch(n_steps, cost)  # utils/dispatch.py: queue cap
-            if epoch == 0 and cache.degraded and p.epochs > 1:
-                warn_cache_overflow(cache_device_bytes, p.epochs - 1)
+            if epoch == 0:
+                if spill is not None:
+                    spill.finalize()
+                if cache.degraded and p.epochs > 1:
+                    use_disk = spill is not None and spill.n_records > 0
+                    if not use_disk:
+                        warn_cache_overflow(cache_device_bytes, p.epochs - 1)
+        if spill is not None:
+            spill.delete()
         if centers is None:
             raise ValueError("stream produced no live rows")
         model = KMeansModel(KMeansParams(k=p.k), centers)
@@ -571,7 +603,8 @@ class StreamingLinearEstimator(Estimator):
                    n_features: int, session: TpuSession | None = None,
                    class_values: tuple | None = None, checkpointer=None,
                    cache_device: bool = False,
-                   cache_device_bytes: int = 8 << 30):
+                   cache_device_bytes: int = 8 << 30,
+                   cache_spill_dir: str | None = None):
         """checkpointer: optional utils.fault.StreamCheckpointer — snapshots
         (theta, opt_state) every N steps and, if a snapshot exists at start,
         resumes from it (skipping already-consumed batches), so a killed fit
@@ -580,8 +613,10 @@ class StreamingLinearEstimator(Estimator):
         cache_device: retain device-put batches in HBM during epoch 1 and
         replay them for epochs 2+ — skips the host re-parse/re-DMA of every
         later epoch (the hashed estimator's ``cache_device``, per-chunk
-        replay form). Degrades to pure streaming if the stream outgrows
-        ``cache_device_bytes``."""
+        replay form). Degrades if the stream outgrows
+        ``cache_device_bytes``: with ``cache_spill_dir`` set, epochs 2+
+        replay padded records off the epoch-1 disk spill (read + DMA, no
+        re-parse); without it, every epoch re-runs the source, loudly."""
         p = self.params
         session = session or TpuSession.active()
         if p.loss == "logistic":
@@ -623,6 +658,13 @@ class StreamingLinearEstimator(Estimator):
         last_loss = None
         cache = _DeviceCache(cache_device and p.epochs > 1,
                              cache_device_bytes)
+        spill: DiskChunkCache | None = None
+        if cache_device and cache_spill_dir is not None and p.epochs > 1:
+            spill = DiskChunkCache(
+                cache_spill_dir,
+                ((pad_rows, n_features), (pad_rows,), (pad_rows,)),
+            )
+        use_disk = False
 
         def run_step(Xd, yd, wd):
             nonlocal theta, opt_state, n_steps, last_loss
@@ -648,11 +690,29 @@ class StreamingLinearEstimator(Estimator):
                         continue
                     run_step(Xd, yd, wd)
                 continue
+            if epoch > 0 and use_disk:
+                # overflow epoch off the disk spill: read + DMA, no parse.
+                # Checkpoint fast-forward skips whole records WITHOUT
+                # reading them; the rest prefetch-overlap the device steps
+                skip = min(max(resume_from - n_steps, 0), spill.n_records)
+                n_steps += skip
+
+                def _rec(i):
+                    arrs, _n = spill.read(i)
+                    return (put_sharded(np.asarray(arrs[0]), row_sh),
+                            put_sharded(np.asarray(arrs[1]), vec_sh),
+                            put_sharded(np.asarray(arrs[2]), vec_sh))
+
+                for Xd, yd, wd in prefetch_map(
+                        _rec, iter(range(skip, spill.n_records)), depth=2):
+                    run_step(Xd, yd, wd)
+                continue
             for X_np, y_np, w_np in _rechunk(source(), pad_rows):
-                if n_steps < resume_from and not (epoch == 0 and cache.enabled):
+                if n_steps < resume_from and not (
+                        epoch == 0 and (cache.enabled or spill is not None)):
                     # checkpoint fast-forward BEFORE any pad/DMA work —
-                    # except while building the cache, whose batches must
-                    # land in HBM even when their step is skipped
+                    # except while building the cache/spill, whose batches
+                    # must be retained even when their step is skipped
                     n_steps += 1
                     continue
                 # every device batch is EXACTLY pad_rows tall (last one padded
@@ -666,6 +726,10 @@ class StreamingLinearEstimator(Estimator):
                             "true class count"
                         )
                 Xp, yp, wp = _pad_chunk(X_np, y_np, w_np, pad_rows, n_features)
+                if epoch == 0 and spill is not None:
+                    # live PRE-pad rows (the DiskChunkCache contract);
+                    # replay neutralizes padding via w=0 either way
+                    spill.append((Xp, yp, wp), X_np.shape[0])
                 Xd = put_sharded(Xp, row_sh)
                 yd = put_sharded(yp, vec_sh)
                 wd = put_sharded(wp, vec_sh)
@@ -675,8 +739,13 @@ class StreamingLinearEstimator(Estimator):
                     n_steps += 1  # fast-forward past checkpointed batches
                     continue
                 run_step(Xd, yd, wd)
-            if epoch == 0 and cache.degraded and p.epochs > 1:
-                warn_cache_overflow(cache_device_bytes, p.epochs - 1)
+            if epoch == 0:
+                if spill is not None:
+                    spill.finalize()
+                if cache.degraded and p.epochs > 1:
+                    use_disk = spill is not None and spill.n_records > 0
+                    if not use_disk:
+                        warn_cache_overflow(cache_device_bytes, p.epochs - 1)
             if (epoch == 0 and p.epochs > 1 and cache.enabled
                     and cache.batches and checkpointer is None
                     and 2 * cache.nbytes <= cache_device_bytes):
@@ -696,6 +765,8 @@ class StreamingLinearEstimator(Estimator):
                 n_steps += (p.epochs - 1) * len(cache.batches)
                 last_loss = losses[-1, -1]
                 break
+        if spill is not None:
+            spill.delete()
         model = self._wrap_model(theta, k, class_values)
         model.n_steps_ = n_steps
         model.final_loss_ = float(last_loss) if last_loss is not None else None

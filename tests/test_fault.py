@@ -120,3 +120,55 @@ def test_checkpoint_deleted_on_success(session, tmp_path):
     # a finished fit must not leave a snapshot that would fast-forward a
     # future fit past its early batches
     assert ck.load() == (0, None)
+
+
+def test_kill_and_resume_through_spill_replay(session, tmp_path):
+    """Kill-and-resume with the cache OVERFLOWED onto the disk spill: the
+    crash lands inside a disk-replay epoch; the resumed fit (which rebuilds
+    its own spill during its epoch 1) must match the uninterrupted run
+    bit for bit."""
+    X, y = _data(n=2048)
+    params = dict(loss="logistic", epochs=4, step_size=0.1, chunk_rows=512)
+    spill_dir = str(tmp_path / "spill")
+    over = dict(cache_device=True, cache_device_bytes=1,
+                cache_spill_dir=spill_dir)
+    src = lambda: array_chunk_source(X, y, chunk_rows=512)()
+
+    with pytest.warns(RuntimeWarning, match="cache overflowed"):
+        # overflow without spill re-streams; with spill it must match this
+        ref = StreamingLinearEstimator(**params).fit_stream(
+            src, n_features=4, session=session,
+            cache_device=True, cache_device_bytes=1,
+        )
+
+    ck = StreamCheckpointer(str(tmp_path / "s.ckpt"), every_steps=3)
+    blow_after = {"n": 9}   # epoch 1 has 4 chunks; step 9 = inside epoch 3
+
+    class Boom(RuntimeError):
+        pass
+
+    orig = StreamingLinearEstimator.fit_stream
+
+    # crash by poisoning the checkpointer's save hook at a replay step
+    saves = {"n": 0}
+    real_maybe = ck.maybe_save
+
+    def exploding_maybe_save(step, state, meta=None):
+        if step >= blow_after["n"]:
+            raise Boom("injected fault in disk replay")
+        return real_maybe(step, state, meta=meta)
+
+    ck.maybe_save = exploding_maybe_save
+    with pytest.raises(Boom):
+        StreamingLinearEstimator(**params).fit_stream(
+            src, n_features=4, session=session, checkpointer=ck, **over
+        )
+    ck.maybe_save = real_maybe
+
+    resumed = StreamingLinearEstimator(**params).fit_stream(
+        src, n_features=4, session=session, checkpointer=ck, **over
+    )
+    assert resumed.n_steps_ == ref.n_steps_
+    np.testing.assert_array_equal(
+        np.asarray(resumed.coef), np.asarray(ref.coef)
+    )

@@ -211,3 +211,57 @@ def test_grouped_disk_replay_label_in_chunk_with_holdout(session, tmp_path):
     assert m.n_steps_ == 15 * 3          # 15 train chunks x 3 epochs
     ev = m.evaluate_device(m.holdout_chunks_)
     assert 0.0 < ev["logloss"] < 2.0
+
+
+def test_dense_streaming_spill_matches_hbm(session, tmp_path):
+    """StreamingLinearEstimator shares the overflow contract: spill-backed
+    replay epochs produce the same numbers as in-HBM replay."""
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((4096, 8)).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] > 0).astype(np.float32)
+    src = array_chunk_source(X, y, chunk_rows=1024)
+
+    def fit(**kw):
+        return StreamingLinearEstimator(
+            loss="logistic", epochs=3, step_size=0.05, chunk_rows=1024,
+        ).fit_stream(src, n_features=8, session=session,
+                     cache_device=True, **kw)
+
+    hbm = fit()
+    spilled = fit(cache_device_bytes=1, cache_spill_dir=str(tmp_path))
+    assert spilled.n_steps_ == hbm.n_steps_
+    np.testing.assert_allclose(
+        np.asarray(spilled.coef), np.asarray(hbm.coef),
+        rtol=1e-6, atol=1e-8,
+    )
+
+
+def test_kmeans_streaming_spill_matches_hbm(session, tmp_path):
+    """StreamingKMeans too — including the pre-seed (all-dead leading
+    batch) subtlety: spilled replay must step pre-seed batches exactly
+    like cache replay does."""
+    from orange3_spark_tpu.io.streaming import StreamingKMeans
+
+    rng = np.random.default_rng(2)
+    X = np.concatenate([
+        rng.normal(i * 8, 1, (600, 3)).astype(np.float32) for i in range(2)
+    ])
+    rng.shuffle(X)
+    w = np.ones(len(X), np.float32)
+    w[:128] = 0.0   # first rechunked batch is entirely dead (pre-seed)
+
+    src = array_chunk_source(X, None, w, chunk_rows=128)
+
+    def fit(**kw):
+        return StreamingKMeans(k=2, epochs=3, chunk_rows=128, seed=2
+                               ).fit_stream(src, n_features=3,
+                                            session=session,
+                                            cache_device=True, **kw)
+
+    hbm = fit()
+    spilled = fit(cache_device_bytes=1, cache_spill_dir=str(tmp_path))
+    assert spilled.n_iter_ == hbm.n_iter_
+    np.testing.assert_allclose(
+        np.asarray(spilled.centers), np.asarray(hbm.centers),
+        rtol=1e-5, atol=1e-6,
+    )

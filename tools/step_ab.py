@@ -54,13 +54,20 @@ def main():
     out = {"metric": "hashed_step_ms_by_emb_update", "unit": "ms/step",
            "rows": args.rows, "dims": args.dims,
            "backend": jax.default_backend()}
-    for variant in ("fused", "per_column", "sorted"):
+    variants = [(v, "float32") for v in ("fused", "per_column", "sorted")]
+    # dtype axis: bfloat16 halves the gather/matmul bytes of the two
+    # leading formulations — the next hardware window should decide
+    # whether the table can live in bf16 (adam state stays f32 via optax)
+    variants += [("fused", "bfloat16"), ("sorted", "bfloat16")]
+    for variant, dt in variants:
+        key = variant if dt == "float32" else f"{variant}_{dt}"
         theta = {"emb": jnp.zeros((args.dims, 1), jnp.float32),
                  "coef": jnp.zeros((n_dense, 1), jnp.float32),
                  "intercept": jnp.zeros((1,), jnp.float32)}
         opt = _ADAM_UNIT.init(theta)
         kw = dict(loss_kind="binary_logistic", n_dims=args.dims,
-                  n_dense=n_dense, label_in_chunk=True, emb_update=variant)
+                  n_dense=n_dense, label_in_chunk=True, emb_update=variant,
+                  compute_dtype=jnp.dtype(dt))
         theta, opt, loss = _hashed_step(
             theta, opt, Xd, jnp.int32(args.rows), zero, zero, salts,
             jnp.float32(0.0), jnp.float32(0.04), **kw)
@@ -72,8 +79,8 @@ def main():
                 jnp.float32(0.0), jnp.float32(0.04), **kw)
         jax.block_until_ready(loss)
         ms = (time.perf_counter() - t0) / args.steps * 1e3
-        out[variant] = round(ms, 2)
-        out[f"{variant}_rows_per_sec"] = round(args.rows / ms * 1e3, 1)
+        out[key] = round(ms, 2)
+        out[f"{key}_rows_per_sec"] = round(args.rows / ms * 1e3, 1)
     best = min(("fused", "per_column", "sorted"), key=lambda v: out[v])
     out["best"] = best
     print(json.dumps(out))
