@@ -491,7 +491,9 @@ def bench_dense_logreg() -> dict:
     est = LogisticRegression(
         max_iter=n_iters, tol=0.0, reg_param=1e-6, compute_dtype="bfloat16"
     )
-    est.fit(table)  # warm-up
+    # warm-up, DRAINED: an unblocked warm fit's async tail would queue
+    # ahead of the timed fit (the bias root-caused in bench_suite.py)
+    jax.block_until_ready(est.fit(table).state_pytree)
     t0 = time.perf_counter()
     model = est.fit(table)
     jax.block_until_ready(model.state_pytree)

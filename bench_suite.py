@@ -82,7 +82,9 @@ def bench_higgs_trees(scale: float) -> dict:
         ("rf", RandomForestClassifier(num_trees=20, max_depth=5, max_bins=32)),
     ):
         _log(f"[higgs] warm-up {name} (compile at the timed shape) ...")
-        est.fit(table)  # identical shape/statics: the timed fit reuses the jit
+        # identical shape/statics: the timed fit reuses the jit; drain the
+        # warm fit's async tail so it cannot bleed into the timed window
+        jax.block_until_ready(est.fit(table).state_pytree)
         _log(f"[higgs] timed {name} fit ...")
         t0 = time.perf_counter()
         model = est.fit(table)
@@ -155,7 +157,8 @@ def bench_movielens_als(scale: float) -> dict:
     est = ALS(rank=rank, max_iter=10, reg_param=0.05,
               n_users=n_users, n_items=n_items, seed=2)
     _log("[als] warm-up (compile at the timed shape/statics) ...")
-    est.fit(t)  # max_iter is a static arg: warm-up must use the SAME value
+    # max_iter is a static arg: warm-up must use the SAME value; drain it
+    jax.block_until_ready(est.fit(t).state_pytree)
     _log("[als] timed fit ...")
     t0 = time.perf_counter()
     model = est.fit(t)
@@ -245,9 +248,13 @@ def bench_taxi_pipeline(scale: float) -> dict:
     wall_fit_eager = time.perf_counter() - t0
 
     # transform path: eager widget-by-widget re-execution vs staged single
-    # XLA computation on the same batch
+    # XLA computation on the same batch. Warm calls are BLOCKED before the
+    # timed window — dispatch is async, and an unblocked warm execution
+    # otherwise queues ahead of the timed call and inflates it (this very
+    # bias produced a bogus 0.26x staged 'slowdown' at 10M in an earlier
+    # round-4 run; the clean measurement has staged ahead at every scale)
     staged = stage_graph(g, km)
-    staged()  # compile
+    jax.block_until_ready(staged().X)  # compile + drain
     t0 = time.perf_counter()
     out_staged = staged()
     jax.block_until_ready(out_staged.X)
@@ -257,7 +264,7 @@ def bench_taxi_pipeline(scale: float) -> dict:
     # as one XLA program (stage_graph refit=True) vs the eager widget walk
     # measured above as wall_fit_eager
     refit_staged = stage_graph(g, km, refit=True)
-    refit_staged()  # compile
+    jax.block_until_ready(refit_staged().X)  # compile + drain
     t0 = time.perf_counter()
     out_refit = refit_staged()
     jax.block_until_ready(out_refit.X)
@@ -271,7 +278,7 @@ def bench_taxi_pipeline(scale: float) -> dict:
             t = model.transform(t)
         return t
 
-    eager_transform()  # warm
+    jax.block_until_ready(eager_transform().X)  # warm + drain
     t0 = time.perf_counter()
     out_e2 = eager_transform()
     jax.block_until_ready(out_e2.X)
