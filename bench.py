@@ -451,6 +451,7 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
         # actually fed the replay epochs ('fused'|'hbm'|'disk'|'stream')
         "cache_overflow": stage_times.get("cache_overflow"),
         "replay_source": stage_times.get("replay_source"),
+        "disk_replay_group": stage_times.get("disk_replay_group"),
         "spill_s": (round(stage_times["spill_s"], 2)
                     if "spill_s" in stage_times else None),
         "input_gbps": round(n_rows * row_bytes / wall / 1e9, 3),
