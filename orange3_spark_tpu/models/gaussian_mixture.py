@@ -177,12 +177,18 @@ class GaussianMixture(Estimator):
         full-data variance. Deterministic per seed, but a different random
         stream than the host-sample init (same documented caveat as
         KMeans)."""
-        from orange3_spark_tpu.models.kmeans import device_d2_seed
+        from orange3_spark_tpu.models.kmeans import (
+            device_d2_seed, device_sample_live,
+        )
 
         p = self.params
         X, W = table.X, table.W
         k0, k1 = jax.random.split(jax.random.PRNGKey(p.seed))
-        means0 = device_d2_seed(X, W, p.k, k0, k1)
+        # D² seeding on a live subsample, like the eager host init — full-
+        # data seeding costs k distance passes over N rows inside the trace
+        ks, k0b = jax.random.split(k0)
+        Xs, Ws = device_sample_live(X, W, p.init_sample_size, ks)
+        means0 = device_d2_seed(Xs, Ws, p.k, k0b, k1)
         wsum = jnp.maximum(jnp.sum(W), 1e-12)
         mean = jnp.sum(X * W[:, None], axis=0) / wsum
         var = jnp.maximum(

@@ -155,6 +155,22 @@ class KMeansModel(Model):
         return table.with_X(X, new_domain)
 
 
+def device_sample_live(X, W, cap: int, key):
+    """Tracer-safe uniform subsample of up to ``cap`` LIVE rows (gumbel-max
+    top-k over the live mask): the device twin of the eager inits'
+    host-side 8192-row sampling. Seeding on the sample instead of the full
+    data turns the D² init's k distance passes from k x N rows into
+    k x cap rows — at 10M rows that was the dominant cost of a staged
+    REFIT (round-4 measurement: the fused fit program spent more time
+    seeding than Lloyd's took to converge). Returns (Xs [cap, d],
+    Ws [cap]) where dead/past-live picks carry Ws=0."""
+    N = X.shape[0]
+    live = W > 0
+    g = jnp.where(live, jax.random.gumbel(key, (N,)), -jnp.inf)
+    gv, idx = jax.lax.top_k(g, min(cap, N))
+    return X[idx], jnp.isfinite(gv).astype(jnp.float32)
+
+
 def device_d2_seed(X, W, k: int, k0, k1) -> jnp.ndarray:
     """Device-pure categorical D²-sampling (kmeans++) seeding — tracer-safe,
     shared by KMeans (k-means|| init) and GaussianMixture (means init)
@@ -208,27 +224,30 @@ class KMeans(Estimator):
         deterministic, but a different random stream than the host init
         (documented)."""
         p = self.params
-        N, d = X.shape
-        live = W > 0
         key = jax.random.PRNGKey(p.seed)
         k0, k1 = jax.random.split(key)
         if p.init_mode == "random":
-            # k distinct uniform live rows via gumbel-max top-k. Picks past
-            # the live count (gumbel -inf) would land on DEAD rows — the
-            # exact stranded-center failure the eager path guards against —
-            # so they are replaced by jittered duplicates of the first
-            # (live) pick, mirroring the eager path's live-center padding.
-            g = jnp.where(live, jax.random.gumbel(k0, (N,)), -jnp.inf)
-            gv, idx = jax.lax.top_k(g, p.k)
-            centers = X[idx]
-            dead = ~jnp.isfinite(gv)
-            base = X[idx[0]]                      # live whenever any row is
+            # k distinct uniform live rows (device_sample_live's gumbel-max
+            # top-k). Picks past the live count would land on DEAD rows —
+            # the exact stranded-center failure the eager path guards
+            # against — so they are replaced by jittered duplicates of the
+            # first (live) pick, mirroring the eager live-center padding.
+            centers, ws = device_sample_live(X, W, p.k, k0)
+            dead = ws == 0
+            base = centers[0]                     # live whenever any row is
             jit_ = (1e-3 * (1.0 + jnp.abs(base))
                     * jax.random.normal(k1, centers.shape, X.dtype))
             return jnp.where(dead[:, None], base[None, :] + jit_, centers)
         if p.init_mode != "k-means||":
             raise ValueError(f"unknown init_mode {p.init_mode!r}")
-        return device_d2_seed(X, W, p.k, k0, k1)
+        # seed on a uniform live subsample (the eager path's
+        # init_sample_size-row sampling, on device): D² passes then cost
+        # k x sample rows, not k x N — the difference between a staged
+        # refit that beats the eager walk and one that loses to it at
+        # 10M rows
+        ks, k0b = jax.random.split(k0)
+        Xs, Ws = device_sample_live(X, W, p.init_sample_size, ks)
+        return device_d2_seed(Xs, Ws, p.k, k0b, k1)
 
     def _init_centers(self, table: TpuTable) -> jnp.ndarray:
         p = self.params
