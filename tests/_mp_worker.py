@@ -80,11 +80,30 @@ def main() -> None:
     coef = np.asarray(model.coef)
     intercept = np.asarray(model.intercept)
 
+    # --- distributed STREAMING fit: each process streams chunks of its
+    # own row block in lockstep; every global device batch is the
+    # concatenation of the processes' local chunks (Spark's ingest model:
+    # executors read their splits, the fit sees the union) -------------
+    from orange3_spark_tpu.io.streaming import (
+        StreamingLinearEstimator, array_chunk_source,
+    )
+
+    local_chunk = 125   # 500 local rows -> 4 lockstep chunks per process
+    sm = StreamingLinearEstimator(
+        loss="logistic", epochs=2, step_size=0.1, chunk_rows=local_chunk,
+    ).fit_stream(
+        array_chunk_source(X_local, y_local, chunk_rows=local_chunk),
+        n_features=X_local.shape[1], session=session,
+    )
+
     sp = shard_paths([csv_path, csv_path + ".b"])
     if pid == 0:
         np.savez(
             out_npz,
             colsum=colsum, coef=coef, intercept=intercept,
+            stream_coef=np.asarray(sm.coef),
+            stream_intercept=np.asarray(sm.intercept),
+            stream_steps=sm.n_steps_,
             n_shard_paths=len(sp), global_rows=Xg.shape[0],
             process_count=jax.process_count(),
         )

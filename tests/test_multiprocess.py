@@ -52,8 +52,11 @@ def mp_results(tmp_path_factory):
     y = (X @ w_true + 0.3 * rng.standard_normal(N_ROWS) > 0).astype(np.float32)
     csv = tmp / "shared.csv"
     header = ",".join([f"f{i}" for i in range(N_COLS)] + ["y"])
+    # %.9g round-trips float32 exactly: the workers train on IDENTICAL
+    # bits to the in-memory reference fits (no quantization slack needed
+    # in the equivalence tolerances below)
     np.savetxt(csv, np.column_stack([X, y]), delimiter=",",
-               header=header, comments="", fmt="%.7g")
+               header=header, comments="", fmt="%.9g")
 
     port = _free_port()
     out = tmp / "out.npz"
@@ -93,6 +96,58 @@ def test_two_process_global_assembly(mp_results):
     assert int(res["global_rows"]) >= N_ROWS
     # shard_paths round-robins 2 files across 2 processes
     assert int(res["n_shard_paths"]) == 1
+
+
+def test_two_process_streaming_fit_matches_equivalent_chunks(mp_results,
+                                                             session):
+    """Distributed STREAMING ingest: each process streams 128-row padded
+    chunks of its own row block in lockstep, so every global device batch
+    is [proc0 chunk; proc1 chunk]. A single-process fit over explicitly
+    concatenated equivalent chunks must land on the same numbers."""
+    X, y, res = mp_results
+
+    from orange3_spark_tpu.io.streaming import StreamingLinearEstimator
+
+    half = N_ROWS // 2
+    blocks = [(X[:half], y[:half]), (X[half:], y[half:])]
+    pad = 128   # session.pad_rows(125) on the 8-device mesh
+
+    chunks = []
+    for i in range(4):                       # 500 local rows -> 4 chunks
+        xs, ys, ws = [], [], []
+        for Xb, yb in blocks:
+            seg_x = Xb[i * pad:(i + 1) * pad]
+            seg_y = yb[i * pad:(i + 1) * pad]
+            n = len(seg_x)
+            xp = np.zeros((pad, N_COLS), np.float32)
+            xp[:n] = seg_x
+            yp = np.zeros((pad,), np.float32)
+            yp[:n] = seg_y
+            wp = np.zeros((pad,), np.float32)
+            wp[:n] = 1.0
+            xs.append(xp)
+            ys.append(yp)
+            ws.append(wp)
+        chunks.append((np.concatenate(xs), np.concatenate(ys),
+                       np.concatenate(ws)))
+
+    def source():
+        yield from chunks
+
+    ref = StreamingLinearEstimator(
+        loss="logistic", epochs=2, step_size=0.1, chunk_rows=2 * pad,
+    ).fit_stream(source, n_features=N_COLS, session=session)
+
+    assert int(res["stream_steps"]) == ref.n_steps_ == 8
+    # identical input bits (%.9g CSV); the residual slack covers gloo
+    # cross-process reduction ordering vs the in-process reference
+    np.testing.assert_allclose(
+        res["stream_coef"], np.asarray(ref.coef), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        res["stream_intercept"], np.asarray(ref.intercept),
+        rtol=1e-4, atol=1e-5,
+    )
 
 
 def test_two_process_sharded_fit_matches_single_process(mp_results, session):
