@@ -314,13 +314,20 @@ def main():
     args = ap.parse_args()
     platform = ""
     try:
-        from bench import _force_cpu_backend, backend_guard
+        from bench import _force_cpu_backend, backend_guard, \
+            start_stall_watchdog
 
         platform = backend_guard()
         if not platform:
             # accelerator never answered: measure on host CPU, labeled
             _force_cpu_backend()
             platform = "cpu"
+        elif platform == "tpu":
+            # tunnel-wedge guard (bench.py docstring): on TPU a mid-run
+            # tunnel death blocks a device call forever. CPU runs skip it —
+            # their single-dispatch fits (ALS scan, Lloyd while_loop) can
+            # legitimately exceed any sane heartbeat threshold at scale.
+            start_stall_watchdog("bench_suite", unit="s")
     except ImportError:  # run from another cwd: skip the fast-fail probe
         pass
     benches = {"3": bench_higgs_trees, "4": bench_movielens_als,

@@ -42,7 +42,7 @@ import optax
 
 from orange3_spark_tpu.core.session import TpuSession
 from orange3_spark_tpu.io.multihost import put_sharded
-from orange3_spark_tpu.utils.dispatch import bound_dispatch
+from orange3_spark_tpu.utils.dispatch import beat, bound_dispatch
 from orange3_spark_tpu.models.base import Estimator, Params
 
 # (X [n,d], y [n] or None) or (X, y, w) — sources may carry row weights
@@ -119,6 +119,7 @@ def prefetch_map(fn: Callable, items: Iterator, *, depth: int = 2) -> Iterator:
         try:
             for item in items:
                 out = fn(item)
+                beat()  # parse/DMA progress feeds the stall watchdog
                 while not stop.is_set():
                     try:
                         q.put(out, timeout=0.1)

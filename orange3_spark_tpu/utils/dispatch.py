@@ -12,14 +12,35 @@ dispatch latency (the steps are data-dependent anyway) and caps the queue.
 
 from __future__ import annotations
 
+import time
+
 import jax
 
 #: steps between synchronizations; small enough to cap rendezvous pressure,
 #: large enough that the sync cost vanishes against real step times
 DISPATCH_SYNC_PERIOD = 16
 
+#: liveness heartbeat — every step loop and prefetch worker ticks this.
+#: bench.py's stall watchdog reads it to distinguish "long compile" from
+#: "the axon tunnel died mid-run and a device call will block forever"
+#: (observed round 4: tunnel answered the probe, then wedged the fit).
+_last_beat = time.monotonic()
+
+
+def beat() -> None:
+    """Record forward progress (a dispatch, a parsed chunk, a DMA)."""
+    global _last_beat
+    _last_beat = time.monotonic()
+
+
+def last_beat() -> float:
+    """Monotonic timestamp of the most recent progress tick."""
+    return _last_beat
+
 
 def bound_dispatch(step: int, token, period: int = DISPATCH_SYNC_PERIOD) -> None:
     """Block on ``token`` every ``period``-th ``step`` (1-based count)."""
+    beat()
     if step % period == 0:
         jax.block_until_ready(token)
+        beat()

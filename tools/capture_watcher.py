@@ -1,0 +1,148 @@
+"""Persistent TPU-window capture watcher.
+
+The axon tunnel dies and resurrects in short windows (observed rounds 2-4;
+this boot: answered 00:59-01:04, wedged the first full bench mid-fit). This
+watcher probes the backend in a subprocess every few minutes and, the moment
+a probe succeeds, runs the capture ladder below — smallest first, so even a
+two-minute window banks a real hardware number before the full-scale runs
+are attempted. Each step runs with the harness's own stall watchdog armed
+(OTPU_STALL_S) plus a hard wall timeout, so a mid-run tunnel death costs one
+bounded attempt, not the watcher.
+
+    nohup python tools/capture_watcher.py > /tmp/capture_watcher.log 2>&1 &
+
+Results append to BENCH_HW_r4.jsonl (one labeled JSON line per success);
+per-step logs land in /tmp/capture_<name>.log; progress/state in
+/tmp/otpu_capture_state.json (attempts survive watcher restarts).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STATE = "/tmp/otpu_capture_state.json"
+OUT = os.path.join(REPO, "BENCH_HW_r4.jsonl")
+PROBE_EVERY_S = 150
+MAX_ATTEMPTS = 3
+
+#: (name, argv, wall timeout s) — smallest first; the ladder resumes at the
+#: first uncompleted step each window
+STEPS = [
+    ("bench_2m", [sys.executable, "bench.py", "--rows", "2000000"], 1200),
+    ("bench_8m", [sys.executable, "bench.py"], 2700),
+    ("suite_c3", [sys.executable, "bench_suite.py", "--config", "3"], 3000),
+    ("suite_c4", [sys.executable, "bench_suite.py", "--config", "4"], 2400),
+    ("suite_c5", [sys.executable, "bench_suite.py", "--config", "5"], 2400),
+    ("step_ab", [sys.executable, "tools/step_ab.py"], 900),
+]
+
+
+def log(msg: str) -> None:
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def load_state() -> dict:
+    try:
+        with open(STATE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def save_state(st: dict) -> None:
+    tmp = STATE + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(st, f, indent=1)
+    os.replace(tmp, STATE)
+
+
+def probe() -> bool:
+    """True iff the TPU answers AND executes a matmul (this boot the tunnel
+    answered jax.devices() then wedged real work a minute later)."""
+    code = ("import jax, jax.numpy as jnp; d = jax.devices(); "
+            "x = jnp.ones((256, 256)); jax.block_until_ready(x @ x); "
+            "print('OTPU_LIVE', d[0].platform)")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=90, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return False
+    return any(ln.startswith("OTPU_LIVE tpu")
+               for ln in (r.stdout or "").splitlines())
+
+
+def run_step(name: str, argv: list, wall_s: int) -> bool:
+    env = dict(os.environ)
+    # the watcher only launches after a live probe — don't re-probe for
+    # 30 min inside the harness; fail fast and return to the probe loop
+    env.update({"OTPU_TUNNEL_WAIT_S": "120", "OTPU_TUNNEL_RETRY_S": "60",
+                "OTPU_STALL_S": "420"})
+    logp = f"/tmp/capture_{name}.log"
+    log(f"running {name}: {' '.join(argv)} (wall {wall_s}s, log {logp})")
+    t0 = time.time()
+    with open(logp, "w") as lf:
+        try:
+            r = subprocess.run(argv, stdout=subprocess.PIPE, stderr=lf,
+                               text=True, timeout=wall_s, cwd=REPO, env=env)
+        except subprocess.TimeoutExpired:
+            log(f"{name}: WALL TIMEOUT after {wall_s}s")
+            return False
+    dt = time.time() - t0
+    lines = [ln for ln in (r.stdout or "").splitlines()
+             if ln.startswith("{") and '"metric"' in ln]
+    ok_lines = []
+    for ln in lines:
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if d.get("rc") or not d.get("value"):
+            log(f"{name}: harness error line: {ln[:200]}")
+            continue
+        # only bank HARDWARE lines; a cpu-fallback line here means the
+        # tunnel died between the probe and the run
+        if d.get("backend") not in (None, "tpu"):
+            log(f"{name}: non-tpu backend {d.get('backend')!r}, not banking")
+            continue
+        ok_lines.append(ln)
+    if r.returncode == 0 and ok_lines:
+        with open(OUT, "a") as f:
+            for ln in ok_lines:
+                f.write(ln + "\n")
+        log(f"{name}: SUCCESS in {dt:.0f}s — {len(ok_lines)} line(s) banked")
+        return True
+    log(f"{name}: rc={r.returncode}, {len(ok_lines)} usable lines, "
+        f"{dt:.0f}s — see {logp}")
+    return False
+
+
+def main() -> None:
+    st = load_state()
+    log(f"watcher up; state: {st or 'fresh'}")
+    while True:
+        pending = [s for s in STEPS
+                   if not st.get(s[0], {}).get("done")
+                   and st.get(s[0], {}).get("attempts", 0) < MAX_ATTEMPTS]
+        if not pending:
+            log("ALL DONE (or attempts exhausted); exiting")
+            return
+        if not probe():
+            log(f"tunnel down ({len(pending)} steps pending); "
+                f"sleeping {PROBE_EVERY_S}s")
+            time.sleep(PROBE_EVERY_S)
+            continue
+        name, argv, wall_s = pending[0]
+        rec = st.setdefault(name, {"attempts": 0, "done": False})
+        rec["attempts"] += 1
+        save_state(st)
+        rec["done"] = run_step(name, argv, wall_s)
+        save_state(st)
+
+
+if __name__ == "__main__":
+    main()
