@@ -37,7 +37,6 @@
 // is what keeps chunk splitting O(memchr)) — use io/readers.py (pyarrow)
 // for such files.
 
-#include <atomic>
 #include <charconv>
 #include <limits>
 #include <cstdint>
@@ -68,34 +67,50 @@ struct CsvHandle {
 };
 
 // ----------------------------------------------------------------- crc32
-// zlib-compatible crc32 (poly 0xEDB88320), table generated at first use so
-// codes match python's zlib.crc32 byte-for-byte.
-const uint32_t* crc_table() {
-  static uint32_t table[256];
-  static std::atomic<bool> ready{false};
-  if (!ready.load(std::memory_order_acquire)) {
-    static std::atomic<bool> building{false};
-    bool expected = false;
-    if (building.compare_exchange_strong(expected, true)) {
-      for (uint32_t i = 0; i < 256; ++i) {
-        uint32_t c = i;
-        for (int k = 0; k < 8; ++k)
-          c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-        table[i] = c;
-      }
-      ready.store(true, std::memory_order_release);
-    } else {
-      while (!ready.load(std::memory_order_acquire)) {}
+// zlib-compatible crc32 (poly 0xEDB88320), slicing-by-8: eight lookup
+// tables let the hot loop fold 8 input bytes per iteration (~1 cycle/byte
+// vs ~5 for the classic byte-table loop — measurable on real Criteo, where
+// 26 of 39 cells per row take this path). Codes match python's
+// ``zlib.crc32`` byte-for-byte (pinned by tests/test_native_io.py).
+struct CrcTables {
+  uint32_t t[8][256];
+  CrcTables() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[0][i] = c;
     }
+    for (int k = 1; k < 8; ++k)
+      for (uint32_t i = 0; i < 256; ++i)
+        t[k][i] = (t[k - 1][i] >> 8) ^ t[0][t[k - 1][i] & 0xFF];
   }
-  return table;
+};
+
+inline const CrcTables& crc_tables() {
+  // C++11 magic static: thread-safe one-time init
+  static const CrcTables tables;
+  return tables;
 }
 
 inline uint32_t crc32_bytes(const char* p, size_t n) {
-  const uint32_t* t = crc_table();
+  const auto& T = crc_tables();
   uint32_t c = 0xFFFFFFFFu;
+  while (n >= 8) {
+    uint32_t lo, hi;
+    std::memcpy(&lo, p, 4);
+    std::memcpy(&hi, p + 4, 4);
+    lo ^= c;
+    c = T.t[7][lo & 0xFF] ^ T.t[6][(lo >> 8) & 0xFF]
+      ^ T.t[5][(lo >> 16) & 0xFF] ^ T.t[4][lo >> 24]
+      ^ T.t[3][hi & 0xFF] ^ T.t[2][(hi >> 8) & 0xFF]
+      ^ T.t[1][(hi >> 16) & 0xFF] ^ T.t[0][hi >> 24];
+    p += 8;
+    n -= 8;
+  }
+  const uint32_t* t0 = T.t[0];
   for (size_t i = 0; i < n; ++i)
-    c = t[(c ^ (uint8_t)p[i]) & 0xFF] ^ (c >> 8);
+    c = t0[(c ^ (uint8_t)p[i]) & 0xFF] ^ (c >> 8);
   return c ^ 0xFFFFFFFFu;
 }
 
@@ -144,8 +159,9 @@ inline float parse_float(const char* p, const char* end, const char** out) {
   }
   uint64_t mant = 0;
   int exp10 = 0;
-  int ndig = 0;
-  bool any = false;
+  int ndig = 0;  // significant digits — leading zeros are skipped below so
+  bool any = false;  // they never burn the 18-digit mantissa budget
+  while (s < end && *s == '0') { any = true; ++s; }
   while (s < end && *s >= '0' && *s <= '9') {
     if (ndig < 18) { mant = mant * 10 + (uint64_t)(*s - '0'); ++ndig; }
     else ++exp10;  // overflow digits only shift the magnitude
@@ -154,6 +170,9 @@ inline float parse_float(const char* p, const char* end, const char** out) {
   }
   if (s < end && *s == '.') {
     ++s;
+    if (mant == 0) {  // '0.000123': zeros shift the exponent, not the cap
+      while (s < end && *s == '0') { any = true; --exp10; ++s; }
+    }
     while (s < end && *s >= '0' && *s <= '9') {
       if (ndig < 18) { mant = mant * 10 + (uint64_t)(*s - '0'); ++ndig; --exp10; }
       any = true;
@@ -216,41 +235,104 @@ inline float hash_cell(const char* p, const char* cell_end, bool quoted) {
   return static_cast<float>(code & kStringCodeMask);
 }
 
-// Fast-path numeric cell parse over a KNOWN cell extent [s, e):
-// [-+]?digits[.digits] with NO bounds re-checks inside the digit loops
-// (caller guarantees e - s <= 19, so the uint64 mantissa cannot overflow).
-// Returns false when the cell needs the careful parser (exponent, spaces,
-// stray bytes).
-inline bool parse_cell_fast(const char* s, const char* e, float* out) {
-  if (s == e) { *out = std::nanf(""); return true; }  // empty cell
+// ----------------------------------------------------- SWAR digit parsing
+// The numeric fast path eats 8 bytes per 64-bit load instead of one digit
+// per loop iteration: the serial `mant = mant*10 + d` chain is THE parse
+// bottleneck at Criteo scale (40 cells/row, ~7 digits/cell), and the SWAR
+// recombination below turns 8 of those dependent multiplies into 3.
+// Requires 8 readable bytes past any cell start — fcsv_read_chunk appends
+// an 8-byte NUL sentinel to the block buffer before parsing.
+
+// Length of the leading run of ASCII digits among the 8 loaded bytes
+// (first char in the LOW byte — little-endian load).
+inline int digit_run(uint64_t w) {
+  uint64_t t = w ^ 0x3030303030303030ULL;  // '0'..'9' -> 0x00..0x09
+  // bytes > 9 (or with the top bit set) light bit 7; '.' ',' '\n' all do
+  uint64_t nd = ((t + 0x7676767676767676ULL) | t) & 0x8080808080808080ULL;
+  return nd ? (int)(__builtin_ctzll(nd) >> 3) : 8;
+}
+
+// Value of 8 ASCII digits, first digit in the low byte (lemire's
+// parse_eight_digits: two pair-merges and one 32-bit recombination).
+inline uint64_t parse8(uint64_t val) {
+  const uint64_t mask = 0x000000FF000000FFULL;
+  const uint64_t mul1 = 0x000F424000000064ULL;  // 100 + (1000000 << 32)
+  const uint64_t mul2 = 0x0000271000000001ULL;  // 1 + (10000 << 32)
+  val -= 0x3030303030303030ULL;
+  val = (val * 2561) >> 8;
+  return (((val & mask) * mul1) + (((val >> 16) & mask) * mul2)) >> 32;
+}
+
+// Value of the first k (1..7) digit bytes of w: shift them toward the high
+// bytes and fill the vacated low bytes with ASCII zeros, so parse8 sees a
+// zero-padded 8-digit number.
+inline uint64_t parse_k(uint64_t w, int k) {
+  int sh = (8 - k) << 3;  // 8..56
+  w = (w << sh) | (0x3030303030303030ULL >> (64 - sh));
+  return parse8(w);
+}
+
+constexpr uint64_t kPow10U[9] = {1ull, 10ull, 100ull, 1000ull, 10000ull,
+                                 100000ull, 1000000ull, 10000000ull,
+                                 100000000ull};
+
+// Fused scan+parse of one unquoted numeric cell starting at *pp: consumes
+// [-+]?digits[.digits] and requires the next byte to be the delimiter or
+// the row end. On success stores the value, advances *pp to the cell end,
+// returns true. Returns false (with *pp untouched) when the cell needs the
+// careful parser: exponents, inf/nan, spaces, junk, or >18 digits.
+inline bool parse_cell_swar(const char** pp, const char* rend, char delim,
+                            float* out) {
+  const char* s = *pp;
+  if (s == rend || *s == delim) {  // empty cell (row-final or mid-row)
+    *out = std::nanf("");
+    return true;
+  }
   bool neg = false;
   if (*s == '-' || *s == '+') { neg = (*s == '-'); ++s; }
   uint64_t mant = 0;
-  int frac = 0;
-  bool any = false;
-  const char* q = s;
-  while (q < e) {
-    unsigned d = (unsigned)(*q - '0');
-    if (d <= 9) { mant = mant * 10 + d; any = true; ++q; continue; }
-    if (*q == '.') {
-      ++q;
-      const char* f0 = q;
-      while (q < e) {
-        unsigned fd = (unsigned)(*q - '0');
-        if (fd > 9) return false;  // exponent or junk -> careful path
-        mant = mant * 10 + fd;
-        ++q;
-      }
-      frac = (int)(q - f0);
-      any = any || frac > 0;
-      break;
-    }
-    return false;  // 'e', 'E', spaces, text -> careful path
+  int exp10 = 0;
+  int ndig = 0;     // SIGNIFICANT digits only — leading zeros must not
+  bool any = false; // burn the 18-digit budget ('0000000000000000123')
+  while (s < rend && *s == '0') { ++s; any = true; }
+  for (;;) {  // integer digits, 8 per load
+    uint64_t w;
+    std::memcpy(&w, s, 8);
+    int k = digit_run(w);
+    if (k == 0) break;
+    if (ndig + k > 18) return false;  // huge cell -> careful path
+    mant = mant * kPow10U[k] + (k == 8 ? parse8(w) : parse_k(w, k));
+    ndig += k;
+    s += k;
+    if (k < 8) break;  // run ended inside this load
   }
-  if (!any) return false;  // no digits at all ('-', '.', nan)
+  any = any || ndig;
+  if (s < rend && *s == '.') {
+    ++s;
+    if (mant == 0) {  // '0.000123': zeros shift the exponent, not the cap
+      while (s < rend && *s == '0') { ++s; --exp10; any = true; }
+    }
+    for (;;) {  // fraction digits
+      uint64_t w;
+      std::memcpy(&w, s, 8);
+      int k = digit_run(w);
+      if (k == 0) break;
+      if (ndig + k > 18) return false;
+      mant = mant * kPow10U[k] + (k == 8 ? parse8(w) : parse_k(w, k));
+      ndig += k;
+      exp10 -= k;
+      s += k;
+      if (k < 8) break;
+    }
+    any = any || ndig;
+  }
+  if (!any) return false;              // '-', '.', 'nan', 'inf', text
+  if (s != rend && *s != delim) return false;  // exponent/junk/spaces
+  if (exp10 < -60) return false;       // subnormal-zero tail -> careful path
   double val = (double)mant;
-  if (frac) val *= pow10_table()[-frac];
+  if (exp10) val *= pow10_table()[exp10];  // exp10 in [-60, 0]
   *out = (float)(neg ? -val : val);
+  *pp = s;
   return true;
 }
 
@@ -286,17 +368,16 @@ void parse_rows(const char* buf, const std::vector<size_t>& starts,
         p = (q < end) ? q + 1 : q;  // past closing quote
         // skip to the delimiter
         while (p < end && *p != delim) ++p;
+      } else if (!cat && parse_cell_swar(&p, end, delim, &row[c])) {
+        // fused scan+parse consumed the cell and left p at its end
       } else {
-        // one scan finds the cell extent; the parse then runs bounds-free
-        const char* cell_end = p;
-        while (cell_end < end && *cell_end != delim) ++cell_end;
+        // categorical, or a numeric cell the SWAR path rejected
+        // (exponent, inf/nan, text, spaces, >18 digits)
+        const char* cell_end = static_cast<const char*>(
+            memchr(p, delim, end - p));
+        if (!cell_end) cell_end = end;
         if (cat) {
           row[c] = hash_cell(p, cell_end, /*quoted=*/false);
-        } else if (cell_end - p <= 19) {
-          if (!parse_cell_fast(p, cell_end, &row[c])) {
-            const char* next;
-            row[c] = parse_float(p, cell_end, &next);
-          }
         } else {
           const char* next;
           row[c] = parse_float(p, cell_end, &next);
@@ -453,6 +534,11 @@ long fcsv_read_chunk(void* hv, float* out, long max_rows, int nthreads) {
     // adapt the reserve hint to the observed data density
     h->est_row_bytes = (ends[nrows - 1] - starts[0]) / (size_t)nrows + 2;
   }
+  // 8-byte NUL sentinel: parse_cell_swar loads 8 bytes from any position
+  // inside a row extent, so the final row's tail needs readable slack.
+  // Appended AFTER the carry stash (the sentinel must not enter the carry)
+  // and before threads capture buf.data().
+  buf.insert(buf.end(), 8, '\0');
   int T = nthreads > 0 ? nthreads
                        : (int)std::thread::hardware_concurrency();
   if (T < 1) T = 1;

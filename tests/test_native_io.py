@@ -184,6 +184,36 @@ def test_native_reader_quoted_cells(tmp_path):
     assert data[1, 0] == 1.5 and data[1, 1] == 2.0 and np.isnan(data[1, 2])
 
 
+def test_native_reader_numeric_edge_cells(tmp_path):
+    """Regression pin for the SWAR fast path's boundary cases: zero-padded
+    fixed-width cells must not burn the 18-significant-digit budget on
+    leading zeros (round-4 review finding), empty mid-row cells are NaN,
+    and exponent/garbage cells route through the careful parser."""
+    cells = [
+        ("0000000000000000123", 123.0),       # 19 bytes, leading zeros
+        ("0000000000000000001", 1.0),
+        ("0.0000000000000000000123", 1.23e-20),
+        ("00.5", 0.5),
+        ("", float("nan")),                    # mid-row empty -> NaN
+        ("2.5E2", 250.0),
+        ("1e-3", 0.001),
+        ("184467440737095516150", 1.8446744e20),  # > uint64, magnitude kept
+        ("abc", float("nan")),
+    ]
+    p = tmp_path / "edge.csv"
+    p.write_text("a,tail\n" + "".join(f"{c},9\n" for c, _ in cells))
+    with NativeCsvReader(str(p)) as r:
+        data = r.read_all()
+    for i, (cell, want) in enumerate(cells):
+        got = float(data[i, 0])
+        if np.isnan(want):
+            assert np.isnan(got), f"{cell!r}: got {got}, want NaN"
+        else:
+            assert got == pytest.approx(want, rel=1e-6), \
+                f"{cell!r}: got {got}, want {want}"
+        assert data[i, 1] == 9.0  # column alignment survived the odd cell
+
+
 def test_streaming_label_out_of_range_errors(session):
     rng = np.random.default_rng(9)
     X = rng.standard_normal((256, 2)).astype(np.float32)
