@@ -308,6 +308,9 @@ def bench_taxi_pipeline(scale: float) -> dict:
 
 
 def main():
+    from orange3_spark_tpu.io.native import tune_malloc
+
+    tune_malloc()  # dedicated bench process: keep big buffers resident
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="all", choices=["3", "4", "5", "all"])
     ap.add_argument("--rows-scale", type=float, default=1.0)

@@ -67,15 +67,21 @@ def _build() -> str:
     return _LIB
 
 
-def _tune_malloc() -> None:
+def tune_malloc() -> None:
     """Keep large allocations in the heap arena instead of per-call mmap.
 
     Every parsed chunk is a fresh ~40 MB numpy buffer; glibc serves those
     via mmap and unmaps on free, so each chunk pays full first-touch page
     faulting. Raising M_MMAP_THRESHOLD/M_TRIM_THRESHOLD keeps the pages
     resident across chunks — measured ~20% off the steady-state parse wall
-    on the Criteo bench host. Process-wide and harmless elsewhere (the
-    retained arena is bounded by the prefetch depth × chunk size)."""
+    on the Criteo bench host.
+
+    PROCESS-WIDE: after this call, any transient allocation up to 1 GB
+    anywhere in the process stays in the heap and is never trimmed back to
+    the OS. That is the right trade for a dedicated ingest/bench process
+    and the wrong one to impose on a host application by side effect — so
+    this is an explicit opt-in (bench.py/bench_suite.py call it; library
+    loading does not)."""
     try:
         libc = ctypes.CDLL("libc.so.6", use_errno=True)
         libc.mallopt(-3, 1 << 30)  # M_MMAP_THRESHOLD
@@ -90,7 +96,6 @@ def get_lib():
     with _lock:
         if _lib is not None:
             return _lib
-        _tune_malloc()
         if (not os.path.exists(_LIB)
                 or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
             _build()
