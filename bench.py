@@ -309,10 +309,9 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
             chunk_rows=CHUNK_ROWS,
             label_in_chunk=True, prefetch_depth=2,
             fused_replay=fused_env,
-            # 'auto' resolves to 'sorted' on TPU (tools/step_ab.py on the
-            # v5e chip: sorted 0.95 ms/step < per_column 1.17 < fused
-            # 2.38) and 'fused' elsewhere — a CPU-labeled fallback run
-            # must not pay the sort XLA:CPU is known-slow at
+            # 'auto' -> 'fused' everywhere (tools/step_ab.py 2026-07-31 on
+            # the v5e chip: fused 0.27 ms/step < sorted 0.41 < per_column
+            # 0.75; XLA:CPU sorts slowly so fused wins there too)
             emb_update="auto",
         )
 
@@ -692,7 +691,9 @@ def main():
                     "per-chunk replay (OTPU_FUSED_REPLAY=0 retry) after "
                     + ("an attempt-1 internal cpu fallback (probe flake)"
                        if rc1 == 0 else
-                       f"attempt 1 faulted the device (rc={rc1})")))
+                       "attempt 1 died mid-run after a successful probe "
+                       "(rc=3, stall watchdog)" if rc1 == 3 else
+                       f"attempt 1 failed (rc={rc1})")))
             if line and line_backend(line) != "tpu":
                 if not cpu_line:
                     cpu_line = line    # prefer the first (full-size) one

@@ -81,10 +81,10 @@ class HashedLinearParams(Params):
     compute_dtype: str = "float32"
     label_in_chunk: bool = False  # chunks carry the label as column 0
     prefetch_depth: int = 2       # host->device pipeline depth (0 disables)
-    # 'auto' resolves per backend at fit time: 'sorted' on TPU (the
-    # on-chip A/B winner, tools/step_ab.py: 0.95 ms vs 2.38 ms fused),
-    # 'fused' elsewhere (XLA:CPU sorts slowly). Explicit values force.
-    emb_update: str = "auto"     # 'auto' | 'fused' | 'per_column' | 'sorted' 
+    # 'auto' resolves at fit time via resolve_emb_update (currently
+    # 'fused' on every backend — the 2026-07-31 on-chip A/B winner).
+    # Explicit values force a specific scatter lowering.
+    emb_update: str = "auto"     # 'auto' | 'fused' | 'per_column' | 'sorted'
     fused_replay: bool = True    # cache replay epochs as ONE scan program
     # value-weighted sparse rows (MLlib SparseVector semantics): chunks
     # carry n_cat (index, value) PAIRS — [label?, idx..., val...] — and the
@@ -112,11 +112,18 @@ def _effective_k(p: HashedLinearParams) -> int:
 
 def resolve_emb_update(p: HashedLinearParams) -> str:
     """The concrete scatter lowering for this fit — 'auto' picks the
-    measured-best per backend ('sorted' on TPU per the on-chip A/B,
-    'fused' elsewhere). THE one resolver: anything handing
-    ``emb_update`` to a jitted step must go through it."""
+    measured-best per backend. THE one resolver: anything handing
+    ``emb_update`` to a jitted step must go through it.
+
+    Currently 'fused' everywhere: the 2026-07-31 on-chip A/B on the
+    round-4 step (BENCH_HW_r4.jsonl: fused 0.27 ms/step < sorted 0.41 <
+    per_column 0.75 at 2^18 rows x 2^22 dims) reversed round 3's verdict
+    (sorted 0.95 < fused 2.38 on the pre-rewrite step) — the SWAR parse /
+    arena work also made the fused scatter the cheapest lowering on TPU,
+    and XLA:CPU always sorted slowly. 'sorted' (conflict-free custom-vjp
+    scatter) remains available by explicit request."""
     if p.emb_update == "auto":
-        return "sorted" if jax.default_backend() == "tpu" else "fused"
+        return "fused"
     return p.emb_update
 
 

@@ -243,11 +243,9 @@ def test_fused_replay_respects_holdout(session):
 
 
 def test_emb_update_auto_resolves_per_backend(session):
-    """'auto' picks the measured-best lowering for the current backend at
-    fit time ('sorted' on TPU per the on-chip A/B, 'fused' elsewhere) and
-    never reaches the jitted step unresolved."""
-    import jax
-
+    """'auto' picks the measured-best lowering at fit time (currently
+    'fused' on every backend per the 2026-07-31 on-chip A/B — see
+    resolve_emb_update) and never reaches the jitted step unresolved."""
     from orange3_spark_tpu.models.hashed_linear import (
         HashedLinearParams, _init_fit_state,
     )
@@ -255,8 +253,7 @@ def test_emb_update_auto_resolves_per_backend(session):
     p = HashedLinearParams()
     assert p.emb_update == "auto"
     *_, kw = _init_fit_state(p, session)
-    expect = "sorted" if jax.default_backend() == "tpu" else "fused"
-    assert kw["emb_update"] == expect
+    assert kw["emb_update"] == "fused"
     # explicit values pass through untouched
     *_, kw = _init_fit_state(p.replace(emb_update="per_column"), session)
     assert kw["emb_update"] == "per_column"

@@ -1,0 +1,166 @@
+"""Diagnose the round-4 fused-replay device fault on the live TPU.
+
+Observed (2026-07-31, axon tunnel to 1x v5e): executing ANY
+`fit_stream` (even a single zero chunk from numpy, prefetch on or off)
+followed by the big `_hashed_replay_epochs` scan program in the SAME
+process kills the device program with
+`jax.errors.JaxRuntimeError: UNAVAILABLE: TPU device error` — while the
+identical replay program runs clean standalone, and per-chunk replay of
+the same cached epochs is unaffected (bench.py's OTPU_FUSED_REPLAY=0
+retry rung exists because of this). The fault is NOT the tunnel dying:
+probes keep succeeding after it.
+
+This tool runs a small experiment matrix, each cell in a fresh
+subprocess (a faulted cell must not poison the next), and prints one
+JSON line per cell plus a summary — so one short tunnel window answers:
+
+  base       fitnp -> replay with emb_update='sorted' (the faulting
+             round-4 config; expect FAULT — reproduces the signature)
+  embfused   fitnp -> replay with emb_update='fused' (the new 'auto'
+             winner): does the sorted custom-vjp inside the scan carry
+             the fault?
+  cached     replay -> fitnp -> replay2: does a replay EXECUTABLE
+             compiled before any step survive re-execution after steps?
+             (If yes, bench.py can hoist warm_replay first and keep
+             fused replay on hardware.)
+  delwarm    fitnp -> free the warm model -> replay: is it live-buffer /
+             memory-pressure related?
+
+Usage (watcher runs it automatically in a window):
+    python tools/replay_fault_diag.py [--chunk-rows 262144]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CELL_SRC = r"""
+import sys, time
+sys.path.insert(0, __REPO__)
+import jax
+import numpy as np
+
+chunk_rows = __CHUNK_ROWS__
+emb = __EMB__
+stages = __STAGES__
+
+from orange3_spark_tpu.core.session import TpuSession
+from orange3_spark_tpu.models.hashed_linear import (
+    StreamingHashedLinearEstimator,
+)
+
+sess = TpuSession.builder_get_or_create()
+assert jax.default_backend() == "tpu", jax.default_backend()
+
+def make_est(e):
+    return StreamingHashedLinearEstimator(
+        n_dims=1 << 22, n_dense=13, n_cat=26, epochs=e,
+        chunk_rows=chunk_rows, label_in_chunk=True, prefetch_depth=2,
+        emb_update=emb,
+    )
+
+warm = None
+for stage in stages:
+    t0 = time.perf_counter()
+    if stage == "fitnp":
+        Xnp = np.zeros((chunk_rows, 40), np.float32)
+        def np_source():
+            yield Xnp
+        warm = make_est(1).fit_stream(
+            np_source, session=sess, cache_device=True, holdout_chunks=0)
+    elif stage == "delwarm":
+        warm = None
+        import gc; gc.collect()
+    elif stage in ("replay", "replay2"):
+        make_est(100).warm_replay(6, session=sess)
+    else:
+        raise ValueError(stage)
+    print(f"STAGE_OK {stage} {time.perf_counter()-t0:.1f}s", flush=True)
+print("CELL_OK", flush=True)
+"""
+
+CELLS = [
+    # (name, emb_update, stages)
+    ("base", "sorted", ["fitnp", "replay"]),
+    ("embfused", "fused", ["fitnp", "replay"]),
+    ("cached", "sorted", ["replay", "fitnp", "replay2"]),
+    ("delwarm", "sorted", ["fitnp", "delwarm", "replay"]),
+]
+
+
+def run_cell(name: str, emb: str, stages: list, chunk_rows: int,
+             wall_s: float) -> dict:
+    src = (_CELL_SRC
+           .replace("__REPO__", repr(REPO))
+           .replace("__CHUNK_ROWS__", str(chunk_rows))
+           .replace("__EMB__", repr(emb))
+           .replace("__STAGES__", repr(list(stages))))
+    t0 = time.time()
+    try:
+        r = subprocess.run([sys.executable, "-c", src],
+                           capture_output=True, text=True, timeout=wall_s,
+                           cwd=REPO)
+        rc, out, err = r.returncode, r.stdout or "", r.stderr or ""
+    except subprocess.TimeoutExpired as e:
+        rc = "wall-timeout"
+
+        def _dec(b):
+            return (b or b"").decode("utf-8", "replace") \
+                if isinstance(b, bytes) else (b or "")
+        out, err = _dec(e.stdout), _dec(e.stderr)
+    ok_stages = [ln.split()[1] for ln in out.splitlines()
+                 if ln.startswith("STAGE_OK ")]
+    fault = "UNAVAILABLE" in err or "UNAVAILABLE" in out
+    res = {
+        "cell": name, "emb_update": emb, "stages": stages,
+        "ok": rc == 0 and "CELL_OK" in out,
+        "stages_completed": ok_stages, "rc": rc,
+        "device_fault": fault, "wall_s": round(time.time() - t0, 1),
+    }
+    if not res["ok"]:
+        tail = err.strip().splitlines()[-1:] if err.strip() else []
+        res["error_tail"] = tail[0][-200:] if tail else ""
+    return res
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--chunk-rows", type=int, default=1 << 18)
+    ap.add_argument("--wall-s", type=float, default=420.0)
+    args = ap.parse_args()
+    results = []
+    for name, emb, stages in CELLS:
+        res = run_cell(name, emb, stages, args.chunk_rows, args.wall_s)
+        print(json.dumps(res), flush=True)
+        results.append(res)
+    by = {r["cell"]: r for r in results}
+    verdict = {
+        "metric": "replay_fault_diag",
+        # value = cells RUN (nonzero whenever the matrix executed), so an
+        # all-cells-fault outcome — a perfectly valid result — still
+        # passes capture_watcher's `rc or not value` banking filter
+        "value": len(results),
+        "unit": "cells_run",
+        "cells_ok": sum(r["ok"] for r in results),
+        "vs_baseline": None,
+        "backend": "tpu",
+        "reproduced": not by["base"]["ok"] and by["base"]["device_fault"],
+        "fixed_by_fused_emb": by["embfused"]["ok"],
+        "fixed_by_precompile": by["cached"]["ok"],
+        "fixed_by_freeing_warm": by["delwarm"]["ok"],
+        # full per-cell records ride inside the banked line — the watcher
+        # keeps only '"metric"' lines, and stdout is otherwise discarded
+        "cells": results,
+    }
+    print(json.dumps(verdict), flush=True)
+
+
+if __name__ == "__main__":
+    main()
