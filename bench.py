@@ -294,13 +294,18 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
     if dims & (dims - 1):
         raise ValueError(f"dims must be a power of two (hash mask), got {dims}")
 
-    # OTPU_FUSED_REPLAY=0: replay cached epochs per-chunk instead of as one
-    # scan program. The round-4 tunnel reproducibly kills the device when
-    # the big fused-replay program executes after per-chunk steps in the
-    # same process (UNAVAILABLE device error; the identical program runs
-    # clean standalone) — this knob is the hardware-retry rung main() uses
-    # before surrendering to a CPU measurement.
-    fused_env = os.environ.get("OTPU_FUSED_REPLAY", "1") != "0"
+    # OTPU_FUSED_REPLAY selects the cached-epoch replay lowering — the
+    # hardware-retry ladder main() walks before surrendering to CPU
+    # (round-4: the single giant scan reproducibly faults the device when
+    # any per-chunk step ran first in the process, while the same program
+    # runs clean standalone):
+    #   "1"/unset  epochs 2+ as ONE scan dispatch (cheapest)
+    #   "epoch"    one n_epochs=1 scan dispatch per epoch (~99 dispatches;
+    #              seconds of tunnel overhead instead of minutes)
+    #   "0"        per-chunk steps (most dispatches, no scan program)
+    replay_env = os.environ.get("OTPU_FUSED_REPLAY", "1")
+    fused_env = replay_env != "0"
+    granularity = "epoch" if replay_env == "epoch" else "all"
 
     def make_est(e):
         return StreamingHashedLinearEstimator(
@@ -308,7 +313,7 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
             epochs=e, step_size=step_size, reg_param=reg,
             chunk_rows=CHUNK_ROWS,
             label_in_chunk=True, prefetch_depth=2,
-            fused_replay=fused_env,
+            fused_replay=fused_env, replay_granularity=granularity,
             # 'auto' -> 'fused' everywhere (tools/step_ab.py 2026-07-31 on
             # the v5e chip: fused 0.27 ms/step < sorted 0.41 < per_column
             # 0.75; XLA:CPU sorts slowly so fused wins there too)
@@ -644,90 +649,85 @@ def main():
             d["backend_note"] = note
             return json.dumps(d)
 
-        child_out, child_rc, line = try_child({})
-        out1, rc1 = child_out, child_rc
-        cpu_line = ""
-        retried = False
-        if line and line_backend(line) != "tpu":
-            # a child whose own 120 s re-probe flaked falls back internally
-            # and prints a valid rc=0 CPU line — hold it as a last resort,
-            # but it is NOT a hardware capture: the retry rung must run
-            cpu_line, line = line, ""
-        if (not line and args.config == "criteo"
-                and child_rc != "wall-timeout"
-                and os.environ.get("OTPU_FUSED_REPLAY", "1") != "0"):
-            # Second rung before surrendering to CPU: the round-4 tunnel
-            # reproducibly faults the device (UNAVAILABLE) when the big
-            # fused-replay scan executes after per-chunk steps in one
-            # process, while per-chunk replay of the same cached epochs is
-            # unaffected. A fresh child with OTPU_FUSED_REPLAY=0 trades
-            # ~30 ms/epoch fused dispatches for per-chunk dispatch cost —
-            # far better than losing the hardware number entirely. (A
-            # wall-timeout first attempt is NOT that fault signature —
-            # don't double the worst-case window for a wedged run.)
-            _log(("attempt 1 fell back to cpu internally (probe flake); "
-                  if rc1 == 0 else
-                  f"hardware attempt failed (rc={child_rc}); ")
-                 + "retrying once with per-chunk replay "
-                   "(OTPU_FUSED_REPLAY=0)")
-            extra = {"OTPU_FUSED_REPLAY": "0"}
+        def fate(rc):
+            return ("internal cpu fallback (probe flake)" if rc == 0
+                    else "died mid-run after a successful probe "
+                         "(rc=3, stall watchdog)" if rc == 3
+                    else f"failed (rc={rc})")
+
+        # The hardware-retry ladder (round-4: the single giant fused-replay
+        # scan reproducibly faults the device — UNAVAILABLE — whenever any
+        # per-chunk step ran first in the process, while the identical
+        # program runs clean standalone). Each rung re-runs this script in
+        # a fresh child with a weaker replay lowering; rung 2 costs ~99
+        # scan dispatches (seconds of tunnel overhead), rung 3 ~2900 chunk
+        # dispatches (minutes) — both far better than losing the hardware
+        # number. Rungs after the first are criteo-only, skipped when the
+        # caller pinned OTPU_FUSED_REPLAY, and skipped after a wall-timeout
+        # (a wedged run is NOT the fault signature — don't multiply the
+        # worst-case window).
+        rungs = [({}, "fused replay"),
+                 ({"OTPU_FUSED_REPLAY": "epoch"}, "per-epoch fused replay"),
+                 ({"OTPU_FUSED_REPLAY": "0"}, "per-chunk replay")]
+        if os.environ.get("OTPU_FUSED_REPLAY") or args.config != "criteo":
+            rungs = rungs[:1]
+        full_wall = float(os.environ.get("OTPU_CHILD_WALL_S", "3600"))
+        fates: list = []
+        cpu_line, line = "", ""
+        out1 = ""
+        for i, (extra, desc) in enumerate(rungs):
+            extra = dict(extra)
             if cpu_line:
                 # a full-size CPU measurement is already in hand — if this
-                # retry ALSO misses the tunnel, don't pay a second full
+                # rung ALSO misses the tunnel, don't pay a second full
                 # CPU fit just to discard it
                 extra["OTPU_CPU_FALLBACK_ROWS"] = str(min(200_000, cpu_rows))
-            retried = True
             # a deterministic non-device-fault crash would fail again at
-            # full length — give the retry half the wall, still far more
+            # full length — later rungs get half the wall, still far more
             # than the observed fault point (~3 min in)
             child_out, child_rc, line = try_child(
-                extra, wall_s=float(os.environ.get(
-                    "OTPU_CHILD_WALL_S", "3600")) / 2)
-            if line and line_backend(line) == "tpu":
-                # a retry capture ran a DEGRADED config — always say so,
-                # and say why, so the record is distinguishable from a
-                # clean fused run (cf. commit 36b931f's cause labeling)
-                line = annotate_line(line, (
-                    "per-chunk replay (OTPU_FUSED_REPLAY=0 retry) after "
-                    + ("an attempt-1 internal cpu fallback (probe flake)"
-                       if rc1 == 0 else
-                       "attempt 1 died mid-run after a successful probe "
-                       "(rc=3, stall watchdog)" if rc1 == 3 else
-                       f"attempt 1 failed (rc={rc1})")))
+                extra, wall_s=full_wall if i == 0 else full_wall / 2)
+            if i == 0:
+                out1 = child_out
+            fates.append(fate(child_rc) if child_rc != 0
+                         else ("tpu capture" if line_backend(line) == "tpu"
+                               else fate(0)))
             if line and line_backend(line) != "tpu":
                 if not cpu_line:
                     cpu_line = line    # prefer the first (full-size) one
                 line = ""
+            if line:
+                if i > 0:
+                    # a rung-2+ capture ran a DEGRADED config — say so, and
+                    # say what came before, so the record is
+                    # distinguishable from a clean fused run
+                    line = annotate_line(line, (
+                        f"{desc} (OTPU_FUSED_REPLAY="
+                        f"{extra['OTPU_FUSED_REPLAY']}) after attempt(s): "
+                        + "; ".join(fates[:-1])))
+                break
+            if child_rc == "wall-timeout":
+                break   # wedged, not the fault signature — stop the ladder
+            _log(f"rung {i + 1} ({desc}): {fates[-1]}")
         if line or cpu_line:
-            if not line and retried:
-                # the surviving line is a CPU fallback from a two-attempt
+            if not line and len(fates) > 1:
+                # the surviving line is a CPU fallback from a multi-rung
                 # ladder; a single child's own note only knows its half of
-                # the story — record both attempts' fates
-                def fate(rc):
-                    return ("internal cpu fallback (probe flake)" if rc == 0
-                            else "died mid-run after a successful probe "
-                                 "(rc=3)" if rc == 3
-                            else f"failed (rc={rc})")
+                # the story — record every attempt's fate
                 cpu_line = annotate_line(cpu_line, (
-                    f"tpu attempt 1: {fate(rc1)}; retry: {fate(child_rc)}; "
-                    "measured on host cpu instead"))
+                    "tpu attempts: " + "; ".join(fates)
+                    + "; measured on host cpu instead"))
             print(line or cpu_line)
             return
         # rc=3 is the stall watchdog's contract (tunnel died mid-run);
         # anything else is a crash or an undersized wall budget — label
-        # the record with the real cause (BOTH attempts' rcs when they
-        # differ), don't blame the tunnel
-        rcs = (f"rc={rc1}" if child_rc == rc1
-               else f"rc={rc1} then rc={child_rc}")
-        mid_run_death = (
-            f"tpu tunnel died mid-run after a successful probe ({rcs})"
-            if 3 in (rc1, child_rc) else
-            f"tpu attempt failed ({rcs}), not a watchdog stall")
-        _log(f"hardware attempt failed (rc={child_rc}); "
+        # the record with every attempt's real fate, don't blame the tunnel
+        mid_run_death = "tpu attempts: " + "; ".join(fates)
+        _log(f"all hardware rungs failed ({mid_run_death}); "
              "downgrading to a labeled CPU measurement")
-        if retried and out1.strip():
+        if out1.strip() and out1 is not child_out:
             # attempt 1's output usually holds the device-fault trace that
-            # motivated the retry — don't let attempt 2 clobber it
+            # motivated the ladder — don't let later rungs clobber it
             _log(f"attempt-1 stdout tail: {out1.strip()[-300:]}")
         if child_out.strip():
             _log(f"child stdout tail: {child_out.strip()[-300:]}")

@@ -85,7 +85,16 @@ class HashedLinearParams(Params):
     # 'fused' on every backend — the 2026-07-31 on-chip A/B winner).
     # Explicit values force a specific scatter lowering.
     emb_update: str = "auto"     # 'auto' | 'fused' | 'per_column' | 'sorted'
-    fused_replay: bool = True    # cache replay epochs as ONE scan program
+    fused_replay: bool = True    # cache replay epochs as scan program(s)
+    # Granularity of the fused replay dispatches: 'all' lowers epochs 2+
+    # to ONE scan (n_epochs-1 trip count — cheapest, one dispatch);
+    # 'epoch' dispatches one n_epochs=1 scan PER epoch (n_epochs-1
+    # dispatches over the same chunk stack). 'epoch' exists for tunneled
+    # hosts where the single giant program is fragile (the round-4
+    # UNAVAILABLE fault) but per-chunk dispatch overhead (~hundreds of ms
+    # per RPC) would dominate the wall: 99 epoch dispatches cost seconds,
+    # 2900 chunk dispatches cost minutes.
+    replay_granularity: str = "all"   # 'all' | 'epoch'
     # value-weighted sparse rows (MLlib SparseVector semantics): chunks
     # carry n_cat (index, value) PAIRS — [label?, idx..., val...] — and the
     # forward is sum(emb[hash(idx)] * val), io/libsvm.py's fixed-nnz
@@ -693,7 +702,8 @@ class StreamingHashedLinearEstimator(Estimator):
         theta, opt, losses = _hashed_replay_epochs(
             theta, opt, *stacks, salts,
             jnp.float32(p.reg_param), jnp.float32(p.step_size),
-            n_epochs=p.epochs - 1, **kw)
+            n_epochs=(1 if p.replay_granularity == "epoch"
+                      else p.epochs - 1), **kw)
         jax.block_until_ready(losses)
 
     def fit_stream(
@@ -1075,13 +1085,27 @@ class StreamingHashedLinearEstimator(Estimator):
                     jnp.stack([c[i] for c in cache.batches])
                     for i in range(4)
                 )
-                theta, opt_state, chunk_losses = _hashed_replay_epochs(
-                    theta, opt_state, *stacks, salts, reg, lr,
-                    n_epochs=p.epochs - 1, **static_kw,
-                )
+                if p.replay_granularity == "epoch":
+                    # one n_epochs=1 scan dispatch per epoch over the same
+                    # stack — the tunnel-fragility middle ground (see the
+                    # Params docstring); sync every 2 dispatches like the
+                    # grouped disk replay (each pins the full stack)
+                    for rep in range(p.epochs - 1):
+                        theta, opt_state, chunk_losses = \
+                            _hashed_replay_epochs(
+                                theta, opt_state, *stacks, salts, reg, lr,
+                                n_epochs=1, **static_kw,
+                            )
+                        last_loss = chunk_losses[-1, -1]
+                        bound_dispatch(rep + 1, last_loss, period=2)
+                else:
+                    theta, opt_state, chunk_losses = _hashed_replay_epochs(
+                        theta, opt_state, *stacks, salts, reg, lr,
+                        n_epochs=p.epochs - 1, **static_kw,
+                    )
+                    last_loss = chunk_losses[-1, -1]
                 del stacks
                 n_steps += (p.epochs - 1) * len(cache.batches)
-                last_loss = chunk_losses[-1, -1]
                 jax.block_until_ready(last_loss)
                 replay_fused_s = time.perf_counter() - t_rep
                 if stage_times is not None:
@@ -1099,7 +1123,8 @@ class StreamingHashedLinearEstimator(Estimator):
             stage_times["cache_overflow"] = cache.degraded
             stage_times["replay_source"] = (
                 None if p.epochs <= 1
-                else "fused" if replay_fused_s is not None
+                else ("fused" if p.replay_granularity != "epoch"
+                      else "fused_epoch") if replay_fused_s is not None
                 else "disk" if use_disk
                 else "hbm" if cache.enabled
                 else "stream"

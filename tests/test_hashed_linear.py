@@ -220,6 +220,42 @@ def test_fused_replay_matches_per_step_loop(session):
     assert np.mean(pred_f == pred_l) > 0.999
 
 
+def test_epoch_granularity_matches_all(session):
+    """replay_granularity='epoch' (one n_epochs=1 scan dispatch per epoch —
+    bench.py's hardware rung 2 for the round-4 tunnel fault) runs the same
+    step math in the same order as the single n_epochs-1 scan, so the fits
+    must agree to float tolerance and report their own replay_source."""
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+
+    Xall, y = _criteo_shaped(4096, seed=11)
+
+    def fit(gran: str):
+        est = StreamingHashedLinearEstimator(
+            n_dims=1 << 12, n_dense=4, n_cat=6, epochs=5, step_size=0.05,
+            chunk_rows=1024, fused_replay=True, replay_granularity=gran,
+        )
+        st: dict = {}
+        model = est.fit_stream(
+            array_chunk_source(Xall, y, chunk_rows=1024),
+            session=session, cache_device=True, stage_times=st,
+        )
+        return model, st
+
+    all_m, all_st = fit("all")
+    ep_m, ep_st = fit("epoch")
+    assert all_st["replay_source"] == "fused"
+    assert ep_st["replay_source"] == "fused_epoch"
+    assert all_m.n_steps_ == ep_m.n_steps_
+    np.testing.assert_allclose(
+        np.asarray(all_m.theta["emb"]), np.asarray(ep_m.theta["emb"]),
+        rtol=2e-5, atol=2e-7,
+    )
+    np.testing.assert_allclose(
+        np.asarray(all_m.theta["coef"]), np.asarray(ep_m.theta["coef"]),
+        rtol=2e-5, atol=2e-7,
+    )
+
+
 def test_fused_replay_respects_holdout(session):
     """Holdout chunks must stay out of the fused replay scan too."""
     from orange3_spark_tpu.io.streaming import array_chunk_source

@@ -36,9 +36,10 @@ STEPS = [
     ("bench_2m", [sys.executable, "bench.py", "--rows", "2000000"], 1200),
     ("bench_8m", [sys.executable, "bench.py"], 2700),
     # the fused-replay fault experiment matrix (tools/replay_fault_diag.py)
-    # — ~4 bounded subprocess cells; its verdict decides whether round 5
-    # can re-enable fused replay on hardware
-    ("replay_diag", [sys.executable, "tools/replay_fault_diag.py"], 1800),
+    # — 5 bounded subprocess cells (420 s each, worst case 2100 s); its
+    # verdict decides whether round 5 can re-enable fused replay on
+    # hardware. Wall must exceed cells x --wall-s.
+    ("replay_diag", [sys.executable, "tools/replay_fault_diag.py"], 2400),
     ("suite_c3", [sys.executable, "bench_suite.py", "--config", "3"], 3000),
     ("suite_c4", [sys.executable, "bench_suite.py", "--config", "4"], 2400),
     ("suite_c5", [sys.executable, "bench_suite.py", "--config", "5"], 2400),

@@ -59,11 +59,11 @@ from orange3_spark_tpu.models.hashed_linear import (
 sess = TpuSession.builder_get_or_create()
 assert jax.default_backend() == "tpu", jax.default_backend()
 
-def make_est(e):
+def make_est(e, gran="all"):
     return StreamingHashedLinearEstimator(
         n_dims=1 << 22, n_dense=13, n_cat=26, epochs=e,
         chunk_rows=chunk_rows, label_in_chunk=True, prefetch_depth=2,
-        emb_update=emb,
+        emb_update=emb, replay_granularity=gran,
     )
 
 warm = None
@@ -80,6 +80,16 @@ for stage in stages:
         import gc; gc.collect()
     elif stage in ("replay", "replay2"):
         make_est(100).warm_replay(6, session=sess)
+    elif stage == "replayepoch":
+        # the bench's rung-2 lowering: n_epochs=1 scans over the stack,
+        # dispatched REPEATEDLY like the real per-epoch replay loop (the
+        # fault might need repeated execution / cumulative device state —
+        # one dispatch would under-power the verdict). warm_replay with
+        # granularity 'epoch' compiles + executes the n_epochs=1 program;
+        # repeats hit the jit cache, so 8 rounds ~= 8 executions.
+        est = make_est(100, gran="epoch")
+        for _ in range(8):
+            est.warm_replay(6, session=sess)
     else:
         raise ValueError(stage)
     print(f"STAGE_OK {stage} {time.perf_counter()-t0:.1f}s", flush=True)
@@ -90,6 +100,7 @@ CELLS = [
     # (name, emb_update, stages)
     ("base", "sorted", ["fitnp", "replay"]),
     ("embfused", "fused", ["fitnp", "replay"]),
+    ("epochwise", "fused", ["fitnp", "replayepoch"]),  # bench rung 2
     ("cached", "sorted", ["replay", "fitnp", "replay2"]),
     ("delwarm", "sorted", ["fitnp", "delwarm", "replay"]),
 ]
@@ -153,6 +164,7 @@ def main() -> None:
         "backend": "tpu",
         "reproduced": not by["base"]["ok"] and by["base"]["device_fault"],
         "fixed_by_fused_emb": by["embfused"]["ok"],
+        "fixed_by_epoch_granularity": by["epochwise"]["ok"],
         "fixed_by_precompile": by["cached"]["ok"],
         "fixed_by_freeing_warm": by["delwarm"]["ok"],
         # full per-cell records ride inside the banked line — the watcher
