@@ -384,20 +384,12 @@ class UnivariateFeatureSelectorParams(Params):
 
 
 def _anova_f(X, y, w, k: int):
-    """Per-column one-way ANOVA F statistic against k classes (weighted)."""
-    yi = y.astype(jnp.int32)
-    onehot = jax.nn.one_hot(yi, k, dtype=jnp.float32) * w[:, None]   # [N,k]
-    cnt = jnp.maximum(jnp.sum(onehot, axis=0), 1e-12)                # [k]
-    tot_w = jnp.maximum(jnp.sum(w), 1e-12)
-    grand = jnp.sum(X * w[:, None], axis=0) / tot_w                  # [d]
-    grp_sum = onehot.T @ X                                           # [k,d] MXU
-    grp_mean = grp_sum / cnt[:, None]
-    ss_between = jnp.sum(cnt[:, None] * (grp_mean - grand[None, :]) ** 2, axis=0)
-    # memory-light within-group SS: E[x²] - Σ cnt·mean² (never [N,k,d])
-    ex2 = jnp.sum((X * X) * w[:, None], axis=0)
-    ss_within = ex2 - jnp.sum(cnt[:, None] * grp_mean**2, axis=0)
-    df_b, df_w = k - 1, jnp.maximum(tot_w - k, 1.0)
-    return (ss_between / jnp.maximum(df_b, 1)) / jnp.maximum(ss_within / df_w, 1e-12)
+    """Per-column one-way ANOVA F statistic against k classes (weighted).
+    Delegates to the shared kernel in models/stat.py (ANOVATest) so the
+    statistic cannot drift between the selector and the stat API."""
+    from orange3_spark_tpu.models.stat import _anova_kernel
+
+    return _anova_kernel(X, y, w, k=k)[0]
 
 
 def _chi2_stat(X, y, w, k: int, n_bins: int):
@@ -449,16 +441,11 @@ class UnivariateFeatureSelector(Estimator):
                 scores = _chi2_stat(X, y, w, k, p.n_bins)
             else:
                 scores = _anova_f(X, y, w, k)
-        else:  # continuous label: F from squared Pearson correlation
-            sw = jnp.maximum(jnp.sum(w), 1e-12)
-            xm = jnp.sum(X * w[:, None], axis=0) / sw
-            ym = jnp.sum(y * w) / sw
-            xc, yc = X - xm, y - ym
-            r = jnp.sum(xc * yc[:, None] * w[:, None], axis=0) / jnp.sqrt(
-                jnp.maximum(jnp.sum(xc * xc * w[:, None], axis=0), 1e-12)
-                * jnp.maximum(jnp.sum(yc * yc * w), 1e-12)
-            )
-            scores = r * r * (sw - 2) / jnp.maximum(1 - r * r, 1e-12)
+        else:  # continuous label: F from squared Pearson correlation —
+            # the shared FValueTest kernel (models/stat.py)
+            from orange3_spark_tpu.models.stat import _fvalue_kernel
+
+            scores = _fvalue_kernel(X, y, w)[0]
         s = np.asarray(jax.device_get(scores))
         if p.selection_mode == "numTopFeatures":
             top = np.argsort(-s)[: int(p.selection_threshold)]

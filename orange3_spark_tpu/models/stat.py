@@ -1,5 +1,5 @@
 """Statistics — parity with ``pyspark.ml.stat`` (Correlation, ChiSquareTest,
-Summarizer, KolmogorovSmirnovTest).
+Summarizer, KolmogorovSmirnovTest, ANOVATest, FValueTest).
 
 MLlib computes these with one treeAggregate pass per statistic (Pearson via
 a Gramian aggregate, chi-square via per-feature contingency counts;
@@ -234,3 +234,118 @@ class KolmogorovSmirnovTest:
                           jnp.float32(loc), jnp.float32(scale))
         d, n = float(d), float(n)
         return KSTestResult(p_value=_ks_pvalue(d, n), statistic=d)
+
+
+# ------------------------------------------------------- ANOVA / F-value
+class FTestResult(NamedTuple):
+    p_values: np.ndarray            # f64[n_features]
+    degrees_of_freedom: np.ndarray  # i64[n_features, 2] — (df_between, df_within)
+    f_values: np.ndarray            # f64[n_features]
+
+
+def _f_sf(f, d1, d2):
+    """F-distribution survival function via the regularized incomplete
+    beta: sf(f; d1, d2) = I_{d2/(d2 + d1 f)}(d2/2, d1/2)."""
+    x = d2 / (d2 + d1 * jnp.maximum(f, 0.0))
+    return jax.scipy.special.betainc(d2 / 2.0, d1 / 2.0, x)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _anova_kernel(X, y, w, *, k: int):
+    """Per-column one-way ANOVA F + dfs of continuous features vs a k-class
+    label (weighted; padding rows carry w=0). THE one ANOVA kernel —
+    feature_extra._anova_f (UnivariateFeatureSelector) delegates here."""
+    yi = y.astype(jnp.int32)
+    onehot = jax.nn.one_hot(yi, k, dtype=jnp.float32) * w[:, None]    # [N,k]
+    raw_cnt = jnp.sum(onehot, axis=0)                                 # [k]
+    cnt = jnp.maximum(raw_cnt, 1e-12)
+    tot_w = jnp.maximum(jnp.sum(w), 1e-12)
+    grand = jnp.sum(X * w[:, None], axis=0) / tot_w                   # [d]
+    grp_sum = onehot.T @ X                                            # [k,d]
+    grp_mean = grp_sum / cnt[:, None]
+    ss_between = jnp.sum(cnt[:, None] * (grp_mean - grand[None, :]) ** 2,
+                         axis=0)
+    ex2 = jnp.sum((X * X) * w[:, None], axis=0)
+    ss_within = ex2 - jnp.sum(cnt[:, None] * grp_mean**2, axis=0)
+    # dfs count OBSERVED groups (sklearn/Spark use distinct present
+    # classes): an unobserved class index must not inflate df_between —
+    # its empty group contributes ~0 to ss_between, so k-1 would halve F
+    n_grp = jnp.sum(raw_cnt > 1e-6).astype(jnp.float32)
+    df_b = jnp.maximum(n_grp - 1.0, 1.0)
+    df_w = jnp.maximum(tot_w - n_grp, 1.0)
+    f = (ss_between / df_b) / jnp.maximum(ss_within / df_w, 1e-12)
+    return f, df_b, df_w, _f_sf(f, df_b, df_w)
+
+
+class ANOVATest:
+    """``pyspark.ml.stat.ANOVATest.test`` equivalent (Spark 3.1).
+
+    One-way ANOVA F-test of each continuous feature column against the
+    categorical class column. One jitted program: class one-hot ridden on
+    the MXU for the group sums (MLlib aggregates per-class sums/counts in
+    a treeAggregate pass; SURVEY §2b — reconstructed, mount empty), the
+    F survival function evaluated on device via the regularized
+    incomplete beta. Matches sklearn.feature_selection.f_classif on
+    uniform weights (pinned in tests/test_batch1.py).
+    """
+
+    @staticmethod
+    def test(table: TpuTable,
+             feature_cols: Sequence[str] | None = None) -> FTestResult:
+        names = list(feature_cols) if feature_cols is not None else [
+            v.name for v in table.domain.attributes
+        ]
+        X = (table.X if feature_cols is None
+             else jnp.stack([table.column(n) for n in names], axis=1))
+        y, w = table.y, table.W
+        k = int(np.asarray(jnp.max(jnp.where(w > 0, y, 0.0)))) + 1
+        f, df_b, df_w, p = _anova_kernel(X, y, w, k=k)
+        d = len(names)
+        dofs = np.stack([np.full(d, int(df_b)),
+                         np.full(d, int(np.asarray(df_w)))], axis=1)
+        return FTestResult(np.asarray(p, np.float64), dofs,
+                           np.asarray(f, np.float64))
+
+
+@jax.jit
+def _fvalue_kernel(X, y, w):
+    """Per-column regression F-test of continuous features vs a continuous
+    label: F = r^2/(1-r^2) * df2 with df (1, n-2), r the weighted Pearson
+    correlation — one pass of weighted moments, all columns at once."""
+    tot_w = jnp.maximum(jnp.sum(w), 1e-12)
+    xm = jnp.sum(X * w[:, None], axis=0) / tot_w
+    ym = jnp.sum(y * w) / tot_w
+    xc = X - xm[None, :]
+    yc = y - ym
+    cov = jnp.sum(xc * (yc * w)[:, None], axis=0)
+    vx = jnp.maximum(jnp.sum(xc * xc * w[:, None], axis=0), 1e-12)
+    vy = jnp.maximum(jnp.sum(yc * yc * w), 1e-12)
+    r2 = jnp.clip(cov * cov / (vx * vy), 0.0, 1.0 - 1e-9)
+    df2 = jnp.maximum(tot_w - 2.0, 1.0)
+    f = r2 / (1.0 - r2) * df2
+    return f, df2, _f_sf(f, jnp.float32(1.0), df2)
+
+
+class FValueTest:
+    """``pyspark.ml.stat.FValueTest.test`` equivalent (Spark 3.1).
+
+    F-test of each continuous feature against a CONTINUOUS label via the
+    squared weighted Pearson correlation, df (1, n-2). Matches
+    sklearn.feature_selection.f_regression on uniform weights (pinned in
+    tests/test_batch1.py).
+    """
+
+    @staticmethod
+    def test(table: TpuTable,
+             feature_cols: Sequence[str] | None = None) -> FTestResult:
+        names = list(feature_cols) if feature_cols is not None else [
+            v.name for v in table.domain.attributes
+        ]
+        X = (table.X if feature_cols is None
+             else jnp.stack([table.column(n) for n in names], axis=1))
+        f, df2, p = _fvalue_kernel(X, table.y, table.W)
+        d = len(names)
+        dofs = np.stack([np.ones(d, np.int64),
+                         np.full(d, int(np.asarray(df2)))], axis=1)
+        return FTestResult(np.asarray(p, np.float64), dofs,
+                           np.asarray(f, np.float64))

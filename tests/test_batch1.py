@@ -275,3 +275,86 @@ def test_ks_test_matches_scipy(session):
     # a shifted normal must be strongly rejected
     res2 = KolmogorovSmirnovTest.test(t, "x0", "norm", loc=2.0, scale=1.0)
     assert res2.p_value < 1e-6
+
+
+def test_anova_test_matches_sklearn(session):
+    """ANOVATest (pyspark.ml.stat 3.1) == sklearn f_classif on uniform
+    weights; weighted rows == row duplication."""
+    from orange3_spark_tpu.models.stat import ANOVATest
+
+    rng = np.random.default_rng(9)
+    n, d, k = 400, 5, 3
+    y = rng.integers(0, k, size=n)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    X[:, 0] += y * 0.8                               # strongly dependent
+    domain = Domain(
+        [ContinuousVariable(f"f{i}") for i in range(d)],
+        DiscreteVariable("y", tuple(str(i) for i in range(k))),
+    )
+    t = TpuTable.from_numpy(domain, X, y.astype(np.float32), session=session)
+    res = ANOVATest.test(t)
+
+    from sklearn.feature_selection import f_classif
+
+    F, p = f_classif(X, y)
+    np.testing.assert_allclose(res.f_values, F, rtol=2e-3)
+    np.testing.assert_allclose(res.p_values, p, rtol=5e-3, atol=1e-6)
+    assert res.p_values[0] < 1e-6
+    np.testing.assert_array_equal(res.degrees_of_freedom[0], [k - 1, n - k])
+
+    # integer weights behave like row duplication
+    wdup = rng.integers(1, 4, size=n)
+    t_w = TpuTable.from_numpy(domain, X, y.astype(np.float32),
+                              W=wdup.astype(np.float32), session=session)
+    Xdup = np.repeat(X, wdup, axis=0)
+    ydup = np.repeat(y, wdup)
+    Fd, _ = f_classif(Xdup, ydup)
+    np.testing.assert_allclose(ANOVATest.test(t_w).f_values, Fd, rtol=2e-3)
+
+
+def test_fvalue_test_matches_sklearn(session):
+    """FValueTest (pyspark.ml.stat 3.1) == sklearn f_regression."""
+    from orange3_spark_tpu.models.stat import FValueTest
+
+    rng = np.random.default_rng(10)
+    n, d = 350, 4
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (0.9 * X[:, 1] + 0.1 * rng.standard_normal(n)).astype(np.float32)
+    domain = Domain(
+        [ContinuousVariable(f"f{i}") for i in range(d)],
+        ContinuousVariable("y"),
+    )
+    t = TpuTable.from_numpy(domain, X, y, session=session)
+    res = FValueTest.test(t)
+
+    from sklearn.feature_selection import f_regression
+
+    F, p = f_regression(X, y)
+    np.testing.assert_allclose(res.f_values, F, rtol=2e-3)
+    np.testing.assert_allclose(res.p_values, p, rtol=5e-3, atol=1e-6)
+    assert res.p_values[1] < 1e-10 and res.p_values[0] > 1e-4
+    np.testing.assert_array_equal(res.degrees_of_freedom[0], [1, n - 2])
+
+
+def test_anova_unobserved_class_df(session):
+    """A class index never observed among live rows must not inflate
+    df_between (sklearn/Spark count distinct PRESENT classes)."""
+    from orange3_spark_tpu.models.stat import ANOVATest
+
+    rng = np.random.default_rng(11)
+    n = 200
+    y = rng.choice([0, 2], size=n)            # class 1 never occurs
+    X = (rng.standard_normal((n, 3)) + y[:, None] * 0.5).astype(np.float32)
+    domain = Domain(
+        [ContinuousVariable(f"f{i}") for i in range(3)],
+        DiscreteVariable("y", ("0", "1", "2")),
+    )
+    t = TpuTable.from_numpy(domain, X, y.astype(np.float32), session=session)
+    res = ANOVATest.test(t)
+
+    from sklearn.feature_selection import f_classif
+
+    F, p = f_classif(X, y)
+    np.testing.assert_allclose(res.f_values, F, rtol=2e-3)
+    np.testing.assert_allclose(res.p_values, p, rtol=5e-3, atol=1e-6)
+    np.testing.assert_array_equal(res.degrees_of_freedom[0], [1, n - 2])
