@@ -1,5 +1,6 @@
 """Statistics — parity with ``pyspark.ml.stat`` (Correlation, ChiSquareTest,
-Summarizer, KolmogorovSmirnovTest, ANOVATest, FValueTest).
+Summarizer, KolmogorovSmirnovTest, ANOVATest, FValueTest,
+MultivariateGaussian).
 
 MLlib computes these with one treeAggregate pass per statistic (Pearson via
 a Gramian aggregate, chi-square via per-feature contingency counts;
@@ -349,3 +350,70 @@ class FValueTest:
                          np.full(d, int(np.asarray(df2)))], axis=1)
         return FTestResult(np.asarray(p, np.float64), dofs,
                            np.asarray(f, np.float64))
+
+
+# -------------------------------------------------- multivariate gaussian
+class MultivariateGaussian:
+    """``pyspark.ml.stat.distribution.MultivariateGaussian`` equivalent.
+
+    Density of N(mean, cov) with the degenerate-covariance handling MLlib
+    documents (pseudo-inverse via eigendecomposition, pseudo-determinant
+    over eigenvalues above the numerical tolerance). The decomposition
+    happens once at construction; ``pdf``/``logpdf`` evaluate batches of
+    points as one jitted program (rows stay sharded over the data axis).
+    """
+
+    def __init__(self, mean, cov):
+        mean64 = np.asarray(mean, np.float64)
+        cov64 = np.asarray(cov, np.float64)
+        d = mean64.shape[0]
+        if cov64.shape != (d, d):
+            raise ValueError(f"cov must be ({d},{d}), got {cov64.shape}")
+        # construction-time [d,d] decomposition on the HOST in float64,
+        # but with a FLOAT32-scaled rank tolerance: this framework's
+        # tables are f32, so a covariance that was ever rounded through
+        # f32 carries ~1e-9 noise eigenvalues — a float64-eps tolerance
+        # would count that noise as real rank and poison the
+        # pseudo-determinant (scipy upcasting f32 input shows exactly
+        # this failure). MLlib runs eps*d*max|λ| at its working
+        # precision (doubles); ours is f32, so scale accordingly.
+        evals, evecs = np.linalg.eigh(cov64)
+        tol = (np.finfo(np.float32).eps * d) * np.max(np.abs(evals))
+        live = evals > tol
+        if not live.any():
+            # MLlib convention: a covariance with no eigenvalue above the
+            # tolerance is an error, not a rank-0 'density'
+            raise ValueError("covariance matrix has no non-zero eigenvalue")
+        inv = np.zeros(d)
+        inv[live] = 1.0 / evals[live]
+        self.mean = jnp.asarray(mean64, jnp.float32)
+        self.cov = jnp.asarray(cov64, jnp.float32)
+        # rootSigmaInv rows scaled by 1/sqrt(eigenvalue) on the live spectrum
+        self._root_inv = jnp.asarray(evecs * np.sqrt(inv)[None, :],
+                                     jnp.float32)                  # [d, d]
+        log_pseudo_det = float(np.sum(np.log(evals[live])))
+        # MLlib normalizes by the FULL dimension (mean.size * log(2π) +
+        # log pseudo-det), not by the rank as scipy's allow_singular
+        # does — on a rank-r covariance the two differ by
+        # 0.5*(d-r)*log(2π). We follow the MLlib (parity) convention.
+        self._log_norm = -0.5 * (d * float(np.log(2.0 * np.pi))
+                                 + log_pseudo_det)
+
+    def logpdf(self, x) -> jax.Array:
+        """log N(x; mean, cov) for one point [d] or a batch [n, d]."""
+        x = jnp.asarray(x, jnp.float32)
+        out = _mvn_logpdf_kernel(jnp.atleast_2d(x), self.mean,
+                                 self._root_inv,
+                                 jnp.float32(self._log_norm))
+        return out[0] if x.ndim == 1 else out
+
+    def pdf(self, x) -> jax.Array:
+        return jnp.exp(self.logpdf(x))
+
+
+@jax.jit
+def _mvn_logpdf_kernel(x, mean, root_inv, log_norm):
+    """One fused program: rows of ``x`` stay sharded over the data axis;
+    the Mahalanobis contraction rides the MXU."""
+    z = (x - mean[None, :]) @ root_inv                             # [n, d]
+    return log_norm - 0.5 * jnp.sum(z * z, axis=1)

@@ -358,3 +358,46 @@ def test_anova_unobserved_class_df(session):
     np.testing.assert_allclose(res.f_values, F, rtol=2e-3)
     np.testing.assert_allclose(res.p_values, p, rtol=5e-3, atol=1e-6)
     np.testing.assert_array_equal(res.degrees_of_freedom[0], [1, n - 2])
+
+
+def test_multivariate_gaussian_matches_scipy(session):
+    """MultivariateGaussian (pyspark.ml.stat.distribution) pdf/logpdf ==
+    scipy, including a singular covariance (pseudo-det/pseudo-inverse)."""
+    from orange3_spark_tpu.models.stat import MultivariateGaussian
+
+    rng = np.random.default_rng(12)
+    d = 4
+    A = rng.standard_normal((d, d))
+    cov = (A @ A.T + 0.5 * np.eye(d)).astype(np.float32)
+    mean = rng.standard_normal(d).astype(np.float32)
+    pts = rng.standard_normal((32, d)).astype(np.float32)
+
+    from scipy.stats import multivariate_normal
+
+    g = MultivariateGaussian(mean, cov)
+    ref = multivariate_normal(mean, cov)
+    np.testing.assert_allclose(np.asarray(g.logpdf(pts)),
+                               ref.logpdf(pts), rtol=2e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(g.pdf(pts[0])),
+                               ref.pdf(pts[0]), rtol=2e-3)
+
+    # rank-deficient covariance: project onto a 2-D subspace. Build the
+    # singular matrix in FLOAT64 (an f32-rounded one carries ~1e-9 noise
+    # eigenvalues that read as extra rank). MLlib normalizes by the FULL
+    # dimension (d*log(2pi) + log pseudo-det); scipy's allow_singular
+    # uses the rank — shift scipy by 0.5*(d-r)*log(2pi).
+    B = rng.standard_normal((d, 2))
+    cov_sing = B @ B.T
+    g_s = MultivariateGaussian(np.zeros(d), cov_sing)
+    ref_s = multivariate_normal(np.zeros(d), cov_sing, allow_singular=True)
+    pts_in = (rng.standard_normal((8, 2)) @ B.T).astype(np.float32)
+    shift = 0.5 * (d - 2) * np.log(2.0 * np.pi)
+    np.testing.assert_allclose(np.asarray(g_s.logpdf(pts_in)),
+                               ref_s.logpdf(pts_in) - shift,
+                               rtol=2e-3, atol=2e-3)
+
+    # MLlib convention: an all-zero covariance is an error, not rank 0
+    import pytest
+    with pytest.raises(ValueError, match="no non-zero eigenvalue"):
+        MultivariateGaussian(np.zeros(d, np.float32),
+                             np.zeros((d, d), np.float32))
