@@ -45,14 +45,16 @@ bench falls back to a REDUCED, clearly-labeled CPU run
 value 0.0 — the official record then holds a real measurement with an
 honest backend label either way.
 
-Roofline (measured on the bench host, round 3 — see BASELINE.md):
+Roofline (measured on the bench host; r3, step A/B refreshed 2026-07-31 —
+see BASELINE.md):
   * the device step is NOT the bottleneck: pipelined (20 steps, one block)
-    the 2^18-row step runs 0.95 ms ('sorted' formulation) = 276M rows/s —
-    the earlier "~0.1 s scatter-bound step" was per-step sync latency over
-    the tunnel, a measurement artifact. 29 steps of real compute cost
-    ~28 ms/epoch; the wall is host/tunnel overhead: un-overlapped DMA in
-    epoch 1 and per-dispatch/sync cost in replay epochs. The JSON's
-    pure_step_ms / h2d_blocked_gbps / epoch_walls_s quantify each per run.
+    the 2^18-row step runs 0.27 ms ('fused' lowering, the 2026-07-31
+    on-chip A/B winner) = 978M rows/s — the earlier "~0.1 s scatter-bound
+    step" was per-step sync latency over the tunnel, a measurement
+    artifact. 29 steps of real compute cost ~8 ms/epoch; the wall is
+    host/tunnel overhead: un-overlapped DMA in epoch 1 and
+    per-dispatch/sync cost in replay epochs. The JSON's pure_step_ms /
+    h2d_blocked_gbps / epoch_walls_s quantify each per run.
   * epoch 1 is HOST-bound: single-core fastcsv parse + device DMA on the
     prefetch thread; replay epochs are dispatch-overhead-bound on this
     tunneled host, not compute-bound.
