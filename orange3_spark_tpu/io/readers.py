@@ -182,6 +182,19 @@ def read_sql(query: str, database: str, class_col: str = "", *,
     return _table_from_columns(names, columns, class_col, session)
 
 
+def _collect_rows(table: TpuTable, *, drop_filtered: bool = True):
+    """Shared writer preamble: collect X/Y, concatenate, and (by default)
+    drop weight-zero rows — in this framework filters are weight-zeroing,
+    so a writer that ignores W would persist the rows the user filtered
+    out. Returns (variables, data)."""
+    X, Y, W = table.to_numpy()
+    data = X if Y is None else np.concatenate([X, Y], axis=1)
+    variables = list(table.domain.attributes) + list(table.domain.class_vars)
+    if drop_filtered and W is not None:
+        data = data[W[: len(data)] > 0]
+    return variables, data
+
+
 def write_parquet(table: TpuTable, path: str, *,
                   drop_filtered: bool = True) -> None:
     """Collect + write Parquet (df.write.parquet role; host boundary by
@@ -196,11 +209,7 @@ def write_parquet(table: TpuTable, path: str, *,
 
     from orange3_spark_tpu.core.domain import DiscreteVariable
 
-    X, Y, W = table.to_numpy()
-    data = X if Y is None else np.concatenate([X, Y], axis=1)
-    variables = list(table.domain.attributes) + list(table.domain.class_vars)
-    if drop_filtered and W is not None:
-        data = data[W[: len(data)] > 0]
+    variables, data = _collect_rows(table, drop_filtered=drop_filtered)
     cols = []
     for j, var in enumerate(variables):
         v = data[:, j]
@@ -222,16 +231,14 @@ def write_parquet(table: TpuTable, path: str, *,
     )
 
 
-def write_csv(table: TpuTable, path: str) -> None:
+def write_csv(table: TpuTable, path: str, *,
+              drop_filtered: bool = True) -> None:
     """Collect + write (df.write.csv role; host boundary by design).
     Uses the native C++ writer when available (shortest-round-trip floats,
-    ~10x np.savetxt); falls back to numpy otherwise."""
-    X, Y, _ = table.to_numpy()
-    names = [v.name for v in table.domain.attributes]
-    data = X
-    if Y is not None:
-        names += [v.name for v in table.domain.class_vars]
-        data = np.concatenate([X, Y], axis=1)
+    ~10x np.savetxt); falls back to numpy otherwise. ``drop_filtered``:
+    weight-zero (filtered-out) rows are omitted, as in write_parquet."""
+    variables, data = _collect_rows(table, drop_filtered=drop_filtered)
+    names = [v.name for v in variables]
     try:
         from orange3_spark_tpu.io.native import NativeUnavailable, write_csv_native
 
@@ -241,3 +248,59 @@ def write_csv(table: TpuTable, path: str) -> None:
         pass
     header = ",".join(names)
     np.savetxt(path, data, delimiter=",", header=header, comments="", fmt="%.9g")
+
+
+def write_sql(table: TpuTable, database: str, name: str, *,
+              if_exists: str = "replace",
+              drop_filtered: bool = True) -> None:
+    """Collect + write to a SQLite table — the ``df.write.jdbc`` role,
+    completing the SQL read/write symmetry (read_sql above). Discrete
+    columns round-trip as their category STRINGS (not float codes) so a
+    read_sql of the written table reconstructs the same domain shape;
+    missing cells (NaN, discrete or continuous) become NULL.
+
+    if_exists: 'replace' (default) drops any existing table first;
+    'fail' raises if the table exists; 'append' inserts below it.
+    drop_filtered: weight-zero (filtered-out) rows are omitted, as in
+    write_parquet — df.write after a filter never persists them.
+    """
+    import sqlite3
+
+    variables, data = _collect_rows(table, drop_filtered=drop_filtered)
+    if if_exists not in ("replace", "fail", "append"):
+        raise ValueError(f"if_exists must be replace|fail|append, "
+                         f"got {if_exists!r}")
+
+    def cell(var, v):
+        if np.isnan(v):
+            return None     # missing -> NULL, discrete or continuous
+        values = getattr(var, "values", None)
+        if values:          # discrete: store the category string
+            i = int(v)
+            return values[i] if 0 <= i < len(values) else None
+        return float(v)
+
+    qname = '"' + name.replace('"', '""') + '"'
+    cols = ", ".join(
+        '"' + v.name.replace('"', '""') + '"'
+        + (" TEXT" if getattr(v, "values", None) else " REAL")
+        for v in variables
+    )
+    with sqlite3.connect(database) as conn:
+        exists = conn.execute(
+            "SELECT 1 FROM sqlite_master WHERE type='table' AND name=?",
+            (name,),
+        ).fetchone() is not None
+        if exists and if_exists == "fail":
+            raise ValueError(f"table {name!r} already exists")
+        if if_exists == "replace":
+            conn.execute(f"DROP TABLE IF EXISTS {qname}")
+            exists = False
+        if not exists:
+            conn.execute(f"CREATE TABLE {qname} ({cols})")
+        ph = ", ".join("?" for _ in variables)
+        conn.executemany(
+            f"INSERT INTO {qname} VALUES ({ph})",
+            [tuple(cell(v, row[j]) for j, v in enumerate(variables))
+             for row in data],
+        )
