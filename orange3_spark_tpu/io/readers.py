@@ -256,20 +256,24 @@ def write_sql(table: TpuTable, database: str, name: str, *,
     """Collect + write to a SQLite table — the ``df.write.jdbc`` role,
     completing the SQL read/write symmetry (read_sql above). Discrete
     columns round-trip as their category STRINGS (not float codes) so a
-    read_sql of the written table reconstructs the same domain shape;
-    missing cells (NaN, discrete or continuous) become NULL.
+    read_sql of the written table reconstructs the same attribute/class
+    shape; missing cells (NaN, discrete or continuous) become NULL. Meta
+    (string) columns are NOT persisted — the same convention as
+    write_parquet/write_csv, which write attributes + class only.
 
     if_exists: 'replace' (default) drops any existing table first;
-    'fail' raises if the table exists; 'append' inserts below it.
+    'fail' raises if the table exists; 'append' inserts below it. The
+    whole write runs in ONE transaction, so 'replace' is all-or-nothing:
+    a failed insert leaves the previous table intact.
     drop_filtered: weight-zero (filtered-out) rows are omitted, as in
     write_parquet — df.write after a filter never persists them.
     """
     import sqlite3
 
-    variables, data = _collect_rows(table, drop_filtered=drop_filtered)
     if if_exists not in ("replace", "fail", "append"):
         raise ValueError(f"if_exists must be replace|fail|append, "
                          f"got {if_exists!r}")
+    variables, data = _collect_rows(table, drop_filtered=drop_filtered)
 
     def cell(var, v):
         if np.isnan(v):
@@ -286,10 +290,15 @@ def write_sql(table: TpuTable, database: str, name: str, *,
         + (" TEXT" if getattr(v, "values", None) else " REAL")
         for v in variables
     )
-    with sqlite3.connect(database) as conn:
+    conn = sqlite3.connect(database, isolation_level=None)  # manual txn
+    try:
+        conn.execute("BEGIN IMMEDIATE")
+        # SQLite table names are case-insensitive: match accordingly or
+        # 'append'/'fail' miss 'Data' when asked about 'data' and CREATE
+        # then dies with a raw OperationalError
         exists = conn.execute(
-            "SELECT 1 FROM sqlite_master WHERE type='table' AND name=?",
-            (name,),
+            "SELECT 1 FROM sqlite_master WHERE type='table' "
+            "AND lower(name)=lower(?)", (name,),
         ).fetchone() is not None
         if exists and if_exists == "fail":
             raise ValueError(f"table {name!r} already exists")
@@ -304,3 +313,12 @@ def write_sql(table: TpuTable, database: str, name: str, *,
             [tuple(cell(v, row[j]) for j, v in enumerate(variables))
              for row in data],
         )
+        conn.execute("COMMIT")
+    except BaseException:
+        try:
+            conn.execute("ROLLBACK")
+        except sqlite3.Error:
+            pass
+        raise
+    finally:
+        conn.close()

@@ -52,6 +52,9 @@ _NAME_MAP = {
     "owdatatable": "OWTableView",
     "datainfo": "OWDataInfo",
     "owdatainfo": "OWDataInfo",
+    "savedata": "OWSaveData",
+    "owsavedata": "OWSaveData",
+    "save": "OWSaveData",
     # scoring / application
     "predictions": "OWApplyModel",
     "owpredictions": "OWApplyModel",

@@ -239,3 +239,27 @@ def test_write_sql_roundtrip(session, tmp_path):
     write_sql(t2, db, "all")                   # NaN discrete row included
     with sqlite3.connect(db) as c:
         assert c.execute("SELECT kind FROM \"all\"").fetchall()[3][0] is None
+
+
+def test_save_data_widget(session, tmp_path):
+    """OWSaveData dispatches on extension and round-trips via each reader."""
+    from orange3_spark_tpu.io.readers import read_parquet, read_sql
+    from orange3_spark_tpu.widgets.catalog import WIDGET_REGISTRY
+
+    X = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], np.float32)
+    t = TpuTable.from_arrays(X, attr_names=["a", "b"])
+
+    pq = str(tmp_path / "t.parquet")
+    WIDGET_REGISTRY["OWSaveData"](path=pq).process(data=t)
+    np.testing.assert_allclose(
+        read_parquet(pq, session=session).to_numpy()[0], X)
+
+    db = str(tmp_path / "t.db")
+    WIDGET_REGISTRY["OWSaveData"](path=db, sql_table="t").process(data=t)
+    np.testing.assert_allclose(
+        read_sql("SELECT * FROM t", db, session=session).to_numpy()[0], X)
+
+    import pytest
+    with pytest.raises(ValueError, match="cannot infer"):
+        WIDGET_REGISTRY["OWSaveData"](path=str(tmp_path / "t.xyz")
+                                      ).process(data=t)
