@@ -6,7 +6,8 @@ least-squares solve where the ``XᵀWX`` Gram matrix is a treeAggregate
 gaussian|binomial|poisson|gamma|tweedie, link per family, maxIter=25,
 tol=1e-6, regParam, fitIntercept, weightCol, offsetCol, variancePower/
 linkPower for tweedie; summary exposes deviance, nullDeviance, aic,
-dispersion). TPU-native redesign:
+dispersion, and — unregularized IRLS only — coefficientStandardErrors /
+tValues / pValues). TPU-native redesign:
 
 * one IRLS iteration = two MXU matmuls (``Xᵀ·diag(ω)·X`` Gram with the
   intercept column folded in, and ``Xᵀ·diag(ω)·z``) whose row contraction
@@ -156,10 +157,12 @@ def _mu_init(family: str):
 
 
 @partial(jax.jit, static_argnames=("family", "link", "fit_intercept", "max_iter",
-                                   "variance_power", "link_power"))
+                                   "variance_power", "link_power",
+                                   "want_inference"))
 def _irls(X, y, w, offset, reg, tol, *, family: str, link: str,
           fit_intercept: bool, max_iter: int,
-          variance_power: float, link_power: float):
+          variance_power: float, link_power: float,
+          want_inference: bool = True):
     n, d = X.shape
     link_f, link_inv, dmu_deta = _link_fns(link, link_power)
     var_f = _variance_fn(family, variance_power)
@@ -211,9 +214,23 @@ def _irls(X, y, w, offset, reg, tol, *, family: str, link: str,
     ybar = jnp.sum(w * y) / sum_w
     null_dev = jnp.sum(w * dev_f(y, ybar))
     # Pearson chi-square statistic sum w·(y-mu)²/V(mu) (MLlib dispersion base)
-    mu_hat = link_inv(Xa @ beta + offset)
+    eta_hat = Xa @ beta + offset
+    mu_hat = link_inv(eta_hat)
     pearson = jnp.sum(w * (y - mu_hat) ** 2 / jnp.maximum(var_f(mu_hat), 1e-12))
-    return beta, dev, null_dev, pearson, n_iter, sum_w
+    # unscaled covariance diag(inv(X' W_irls X)) at the optimum — the base
+    # of MLlib summary's coefficientStandardErrors (× dispersion). Skipped
+    # (statically) for regularized fits, which carry no inference stats:
+    # the extra Gram + Cholesky inverse would be pure dead weight there.
+    cov_diag = None
+    if want_inference:
+        g_hat = dmu_deta(eta_hat)
+        w_hat = w * g_hat * g_hat / jnp.maximum(var_f(mu_hat), 1e-12)
+        gram_hat = (Xa * w_hat[:, None]).T @ Xa
+        chol_hat = jax.scipy.linalg.cho_factor(
+            gram_hat + 1e-8 * jnp.eye(da, dtype=X.dtype))
+        cov_diag = jnp.diag(jax.scipy.linalg.cho_solve(
+            chol_hat, jnp.eye(da, dtype=X.dtype)))
+    return beta, dev, null_dev, pearson, n_iter, sum_w, cov_diag
 
 
 class GeneralizedLinearRegressionModel(Model):
@@ -228,6 +245,13 @@ class GeneralizedLinearRegressionModel(Model):
         self.null_deviance_: float | None = None  # summary.nullDeviance
         self.dispersion_: float | None = None     # summary.dispersion
         self.aic_: float | None = None
+        # summary inference stats (unregularized IRLS only, like MLlib —
+        # None when reg_param > 0). Device arrays ordered
+        # [coefficients..., intercept]; z-test for binomial/poisson,
+        # t-test (df = n - rank) otherwise.
+        self.coefficient_standard_errors_ = None
+        self.t_values_ = None
+        self.p_values_ = None
 
     @property
     def state_pytree(self):
@@ -278,12 +302,13 @@ class GeneralizedLinearRegression(Estimator):
         else:
             link_power = 1.0
         offset = jnp.zeros_like(y)
-        beta, dev, null_dev, pearson, n_iter, sum_w = _irls(
+        beta, dev, null_dev, pearson, n_iter, sum_w, cov_diag = _irls(
             table.X, y, table.W, offset,
             jnp.float32(p.reg_param), jnp.float32(p.tol),
             family=p.family, link=link, fit_intercept=p.fit_intercept,
             max_iter=p.max_iter,
             variance_power=p.variance_power, link_power=link_power,
+            want_inference=(p.reg_param == 0.0),
         )
         d = table.X.shape[1]
         coef = beta[:d]
@@ -310,6 +335,29 @@ class GeneralizedLinearRegression(Estimator):
             else self._aic(p.family, model.deviance_, n_eff, rank, table,
                            model)
         )
+        if p.reg_param == 0.0:
+            # MLlib summary inference stats (coefficientStandardErrors /
+            # tValues / pValues) exist only for the unregularized IRLS fit
+            # — Spark raises on regParam > 0; here they stay None then.
+            # Order matches Spark: [coefficients..., intercept last].
+            disp = (jnp.float32(1.0)
+                    if p.family in ("binomial", "poisson")
+                    else pearson / jnp.maximum(sum_w - rank, 1.0))
+            se = jnp.sqrt(cov_diag[:rank] * disp)
+            tval = beta[:rank] / jnp.maximum(se, 1e-30)
+            if p.family in ("binomial", "poisson"):
+                # z-test against the standard normal
+                pval = jax.scipy.special.erfc(jnp.abs(tval)
+                                              / jnp.sqrt(jnp.float32(2.0)))
+            else:
+                # two-sided t-test, df = n - rank, sf via the regularized
+                # incomplete beta
+                df = jnp.maximum(sum_w - rank, 1.0)
+                pval = jax.scipy.special.betainc(
+                    df / 2.0, 0.5, df / (df + tval * tval))
+            model.coefficient_standard_errors_ = se
+            model.t_values_ = tval
+            model.p_values_ = pval
         return model
 
     @staticmethod

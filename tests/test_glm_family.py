@@ -203,3 +203,57 @@ def test_mlp_iris_multiclass(session, iris):
 def test_mlp_layer_validation(session, iris):
     with pytest.raises(ValueError, match="layers"):
         MultilayerPerceptronClassifier(layers=(3, 8, 3)).fit(iris)
+
+
+def test_glm_summary_inference_stats(session):
+    """coefficientStandardErrors / tValues / pValues (MLlib summary):
+    gaussian single-feature case is pinned against scipy.linregress's
+    exact OLS inference; binomial z-stats against an independent numpy
+    computation of diag(inv(X'WX)) at the fitted coefficients."""
+    rng = np.random.default_rng(3)
+    n = 200
+    x = rng.standard_normal(n).astype(np.float32)
+    y = (0.8 * x + 0.3 * rng.standard_normal(n) + 0.5).astype(np.float32)
+    t = TpuTable.from_arrays(x[:, None], y, session=session)
+    m = GeneralizedLinearRegression(family="gaussian", reg_param=0.0).fit(t)
+
+    from scipy.stats import linregress
+
+    ref = linregress(x, y)
+    np.testing.assert_allclose(
+        float(m.coefficient_standard_errors_[0]), ref.stderr, rtol=2e-3)
+    np.testing.assert_allclose(
+        float(m.coefficient_standard_errors_[1]), ref.intercept_stderr,
+        rtol=2e-3)
+    np.testing.assert_allclose(float(m.t_values_[0]),
+                               ref.slope / ref.stderr, rtol=2e-3)
+    np.testing.assert_allclose(float(m.p_values_[0]), ref.pvalue,
+                               rtol=5e-2, atol=1e-12)
+    # intercept p-value: clearly significant here
+    assert float(m.p_values_[1]) < 1e-6
+
+    # binomial: z-test stats equal the numpy normal-equations computation
+    # at the fitted coefficients (dispersion fixed at 1, MLlib convention)
+    Xb = rng.standard_normal((400, 2)).astype(np.float32)
+    pb = 1.0 / (1.0 + np.exp(-(Xb @ [1.0, -0.5] - 0.2)))
+    yb = (rng.random(400) < pb).astype(np.float32)
+    tb = TpuTable.from_arrays(Xb, yb, session=session)
+    mb = GeneralizedLinearRegression(family="binomial", reg_param=0.0,
+                                     max_iter=50).fit(tb)
+    beta = np.concatenate([np.asarray(mb.coef), [float(mb.intercept)]])
+    Xa = np.concatenate([Xb, np.ones((400, 1), np.float32)], axis=1)
+    mu = 1.0 / (1.0 + np.exp(-(Xa @ beta)))
+    W = mu * (1.0 - mu)
+    cov = np.linalg.inv((Xa * W[:, None]).T @ Xa)
+    se_ref = np.sqrt(np.diag(cov))
+    np.testing.assert_allclose(np.asarray(mb.coefficient_standard_errors_),
+                               se_ref, rtol=5e-3)
+    from scipy.stats import norm
+
+    z = beta / se_ref
+    np.testing.assert_allclose(np.asarray(mb.p_values_),
+                               2 * norm.sf(np.abs(z)), rtol=2e-2, atol=1e-12)
+
+    # regularized fits carry no inference stats (Spark raises there)
+    mr = GeneralizedLinearRegression(family="gaussian", reg_param=0.1).fit(t)
+    assert mr.p_values_ is None
