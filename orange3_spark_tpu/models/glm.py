@@ -180,15 +180,25 @@ def _irls(X, y, w, offset, reg, tol, *, family: str, link: str,
         mu = link_inv(Xa @ beta + offset)
         return jnp.sum(w * dev_f(y, mu))
 
-    def wls(eta, mu):
+    def irls_weights(eta, mu):
+        """THE working-weight definition: w·g²/V(mu). The inference-stat
+        covariance uses the same helper, so standard errors can never use
+        a different weight formula than the coefficients they describe."""
         g = dmu_deta(eta)
-        irls_w = w * g * g / jnp.maximum(var_f(mu), 1e-12)
+        return g, w * g * g / jnp.maximum(var_f(mu), 1e-12)
+
+    def cho_solve_gram(gram, rhs):
+        chol = jax.scipy.linalg.cho_factor(
+            gram + 1e-8 * jnp.eye(da, dtype=X.dtype))
+        return jax.scipy.linalg.cho_solve(chol, rhs)
+
+    def wls(eta, mu):
+        g, irls_w = irls_weights(eta, mu)
         z = eta - offset + (y - mu) / jnp.where(jnp.abs(g) > 1e-12, g, 1e-12)
         Xw = Xa * irls_w[:, None]
         gram = Xw.T @ Xa + (reg * sum_w) * jnp.diag(reg_diag)   # [da,da], psum'd
         rhs = Xw.T @ z                                          # [da], psum'd
-        chol = jax.scipy.linalg.cho_factor(gram + 1e-8 * jnp.eye(da, dtype=X.dtype))
-        return jax.scipy.linalg.cho_solve(chol, rhs)
+        return cho_solve_gram(gram, rhs)
 
     mu0 = _mu_init(family)(y, None)
     eta0 = link_f(mu0)
@@ -223,13 +233,10 @@ def _irls(X, y, w, offset, reg, tol, *, family: str, link: str,
     # the extra Gram + Cholesky inverse would be pure dead weight there.
     cov_diag = None
     if want_inference:
-        g_hat = dmu_deta(eta_hat)
-        w_hat = w * g_hat * g_hat / jnp.maximum(var_f(mu_hat), 1e-12)
+        _, w_hat = irls_weights(eta_hat, mu_hat)
         gram_hat = (Xa * w_hat[:, None]).T @ Xa
-        chol_hat = jax.scipy.linalg.cho_factor(
-            gram_hat + 1e-8 * jnp.eye(da, dtype=X.dtype))
-        cov_diag = jnp.diag(jax.scipy.linalg.cho_solve(
-            chol_hat, jnp.eye(da, dtype=X.dtype)))
+        cov_diag = jnp.diag(
+            cho_solve_gram(gram_hat, jnp.eye(da, dtype=X.dtype)))
     return beta, dev, null_dev, pearson, n_iter, sum_w, cov_diag
 
 
@@ -321,15 +328,14 @@ class GeneralizedLinearRegression(Estimator):
         model.deviance_ = concrete_or_none(dev)
         model.null_deviance_ = concrete_or_none(null_dev)
         # dispersion (MLlib): fixed at 1 for binomial/poisson, else the
-        # Pearson chi-square statistic over residual degrees of freedom
+        # Pearson chi-square statistic over residual degrees of freedom —
+        # ONE device-side formula, concretized for the summary float
         n_eff = concrete_or_none(sum_w)
         rank = d + (1 if p.fit_intercept else 0)
-        if p.family in ("binomial", "poisson"):
-            model.dispersion_ = 1.0
-        elif n_eff is None:
-            model.dispersion_ = None
-        else:
-            model.dispersion_ = float(pearson) / max(n_eff - rank, 1.0)
+        fixed_disp = p.family in ("binomial", "poisson")
+        disp = (jnp.float32(1.0) if fixed_disp
+                else pearson / jnp.maximum(sum_w - rank, 1.0))
+        model.dispersion_ = 1.0 if fixed_disp else concrete_or_none(disp)
         model.aic_ = (
             None if n_eff is None or model.deviance_ is None
             else self._aic(p.family, model.deviance_, n_eff, rank, table,
@@ -340,21 +346,16 @@ class GeneralizedLinearRegression(Estimator):
             # tValues / pValues) exist only for the unregularized IRLS fit
             # — Spark raises on regParam > 0; here they stay None then.
             # Order matches Spark: [coefficients..., intercept last].
-            disp = (jnp.float32(1.0)
-                    if p.family in ("binomial", "poisson")
-                    else pearson / jnp.maximum(sum_w - rank, 1.0))
+            from orange3_spark_tpu.ops.stats import (
+                two_sided_t_pvalue, two_sided_z_pvalue,
+            )
+
             se = jnp.sqrt(cov_diag[:rank] * disp)
             tval = beta[:rank] / jnp.maximum(se, 1e-30)
             if p.family in ("binomial", "poisson"):
-                # z-test against the standard normal
-                pval = jax.scipy.special.erfc(jnp.abs(tval)
-                                              / jnp.sqrt(jnp.float32(2.0)))
+                pval = two_sided_z_pvalue(tval)
             else:
-                # two-sided t-test, df = n - rank, sf via the regularized
-                # incomplete beta
-                df = jnp.maximum(sum_w - rank, 1.0)
-                pval = jax.scipy.special.betainc(
-                    df / 2.0, 0.5, df / (df + tval * tval))
+                pval = two_sided_t_pvalue(tval, sum_w - rank)
             model.coefficient_standard_errors_ = se
             model.t_values_ = tval
             model.p_values_ = pval

@@ -33,6 +33,23 @@ class LinearRegressionParams(Params):
 
 
 @jax.jit
+def _training_summary(X, y, w, coef, intercept):
+    """One fused pass over the training rows for MLlib's
+    LinearRegressionTrainingSummary scalars (weighted r2 / RMSE / MAE /
+    explainedVariance) — rows stay sharded; GSPMD reduces over ICI."""
+    tot = jnp.maximum(jnp.sum(w), EPS_TOTAL_WEIGHT)
+    yhat = X @ coef + intercept
+    resid = y - yhat
+    rss = jnp.sum(w * resid * resid)
+    ybar = jnp.sum(w * y) / tot
+    tss = jnp.maximum(jnp.sum(w * (y - ybar) ** 2), EPS_TOTAL_WEIGHT)
+    mae = jnp.sum(w * jnp.abs(resid)) / tot
+    yhat_bar = jnp.sum(w * yhat) / tot
+    expl = jnp.sum(w * (yhat - yhat_bar) ** 2) / tot
+    return rss, 1.0 - rss / tss, jnp.sqrt(rss / tot), mae, expl
+
+
+@jax.jit
 def _normal_equations(X, y, w):
     """Weighted ridge normal equations with one all-reduce over the row axis.
 
@@ -54,6 +71,17 @@ class LinearRegressionModel(Model):
         self.coef = coef            # f32[d]
         self.intercept = intercept  # f32[]
         self.n_iter_: int | None = None
+        # MLlib LinearRegressionTrainingSummary (filled at fit on the
+        # training data; device scalars/arrays, trace-safe):
+        self.r2_ = None                    # summary.r2
+        self.root_mean_squared_error_ = None
+        self.mean_absolute_error_ = None
+        self.explained_variance_ = None
+        # inference stats — solver='normal' with reg_param == 0 only
+        # (MLlib raises elsewhere); order [coefficients..., intercept]
+        self.coefficient_standard_errors_ = None
+        self.t_values_ = None
+        self.p_values_ = None
 
     @property
     def state_pytree(self):
@@ -108,6 +136,32 @@ class LinearRegression(Estimator):
             intercept = (mean_y - coef @ mean_x) if p.fit_intercept else jnp.float32(0.0)
             model = LinearRegressionModel(p, coef, intercept)
             model.n_iter_ = 1
+            rss = self._fill_summary(model, X, y, w)
+            if p.reg_param == 0.0:
+                # inference stats on the unregularized normal solve (MLlib
+                # raises on any regularization): sigma^2 = RSS/(n - rank),
+                # coef covariance from inv(A) on the centered moments, the
+                # intercept variance folding the mean back in
+                from orange3_spark_tpu.ops.stats import two_sided_t_pvalue
+
+                rank = d + (1 if p.fit_intercept else 0)
+                df = jnp.maximum(tot - rank, 1.0)
+                sigma2 = rss / df
+                inv_A = jax.scipy.linalg.solve(
+                    A + 1e-8 * jnp.eye(d, dtype=A.dtype),
+                    jnp.eye(d, dtype=A.dtype), assume_a="pos")
+                se_coef = jnp.sqrt(jnp.diag(inv_A) * sigma2)
+                if p.fit_intercept:
+                    se_int = jnp.sqrt(sigma2 * (1.0 / tot
+                                                + mean_x @ inv_A @ mean_x))
+                    se = jnp.concatenate([se_coef, se_int[None]])
+                    beta = jnp.concatenate([coef, intercept[None]])
+                else:
+                    se, beta = se_coef, coef
+                tval = beta / jnp.maximum(se, 1e-30)
+                model.coefficient_standard_errors_ = se
+                model.t_values_ = tval
+                model.p_values_ = two_sided_t_pvalue(tval, df)
             return model
         alpha = p.elastic_net_param
         result = fit_linear(
@@ -121,4 +175,17 @@ class LinearRegression(Estimator):
         )
         model = LinearRegressionModel(p, result.coef[:, 0], result.intercept[0])
         model.n_iter_ = concrete_or_none(result.n_iter, int)
+        self._fill_summary(model, X, y, w)
         return model
+
+    @staticmethod
+    def _fill_summary(model, X, y, w):
+        """One summary pass; returns rss so the inference block need not
+        repeat the full-data reduction."""
+        rss, r2, rmse, mae, expl = _training_summary(
+            X, y, w, model.coef, model.intercept)
+        model.r2_ = r2
+        model.root_mean_squared_error_ = rmse
+        model.mean_absolute_error_ = mae
+        model.explained_variance_ = expl
+        return rss

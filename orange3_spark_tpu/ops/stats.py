@@ -69,3 +69,15 @@ def inv_std_scale(X, w):
     _, var, _ = weighted_moments(X, w)
     std = jnp.sqrt(var)
     return jnp.where(std > 1e-12, 1.0 / std, 1.0)
+
+
+def two_sided_z_pvalue(z):
+    """2·Φ̄(|z|) — two-sided normal test, on device via erfc."""
+    return jax.scipy.special.erfc(jnp.abs(z) / jnp.sqrt(jnp.float32(2.0)))
+
+
+def two_sided_t_pvalue(t, df):
+    """2·sf_t(|t|; df) — two-sided Student-t test via the regularized
+    incomplete beta identity, on device."""
+    df = jnp.maximum(df, 1.0)
+    return jax.scipy.special.betainc(df / 2.0, 0.5, df / (df + t * t))

@@ -190,3 +190,43 @@ def test_fit_linear_max_iter_zero_finite_loss(session, iris):
     est = LogisticRegression(max_iter=0)
     model = est.fit(iris)
     assert model.n_iter_ == 0  # and final_loss must be finite (ln 3 at init)
+
+
+def test_linear_regression_training_summary(session):
+    """MLlib LinearRegressionTrainingSummary: r2/RMSE/MAE vs sklearn
+    metrics, inference stats vs scipy.linregress exact OLS values."""
+    from orange3_spark_tpu.models.linear_regression import LinearRegression
+
+    rng = np.random.default_rng(4)
+    n = 250
+    x = rng.standard_normal(n).astype(np.float32)
+    y = (1.2 * x + 0.4 * rng.standard_normal(n) - 0.7).astype(np.float32)
+    t = TpuTable.from_arrays(x[:, None], y, session=session)
+    m = LinearRegression(solver="normal", reg_param=0.0).fit(t)
+
+    from scipy.stats import linregress
+    from sklearn.metrics import mean_absolute_error, mean_squared_error, r2_score
+
+    yhat = m.predict(t)
+    np.testing.assert_allclose(float(m.r2_), r2_score(y, yhat), rtol=1e-4)
+    np.testing.assert_allclose(float(m.root_mean_squared_error_),
+                               np.sqrt(mean_squared_error(y, yhat)),
+                               rtol=1e-4)
+    np.testing.assert_allclose(float(m.mean_absolute_error_),
+                               mean_absolute_error(y, yhat), rtol=1e-4)
+
+    ref = linregress(x, y)
+    np.testing.assert_allclose(float(m.coefficient_standard_errors_[0]),
+                               ref.stderr, rtol=2e-3)
+    np.testing.assert_allclose(float(m.coefficient_standard_errors_[1]),
+                               ref.intercept_stderr, rtol=2e-3)
+    np.testing.assert_allclose(float(m.t_values_[0]),
+                               ref.slope / ref.stderr, rtol=2e-3)
+    np.testing.assert_allclose(float(m.p_values_[0]), ref.pvalue,
+                               rtol=5e-2, atol=1e-12)
+
+    # regularized or iterative fits: summary yes, inference stats no
+    mr = LinearRegression(solver="normal", reg_param=0.05).fit(t)
+    assert mr.r2_ is not None and mr.p_values_ is None
+    ml = LinearRegression(solver="l-bfgs").fit(t)
+    assert ml.r2_ is not None and ml.p_values_ is None
