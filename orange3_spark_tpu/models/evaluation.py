@@ -144,16 +144,23 @@ def _confusion_weighted(pred, label, w, n_classes):
 class MulticlassClassificationEvaluator(_Evaluator):
     default_metric = "accuracy"
 
-    def _compute(self, table: TpuTable, metric: str):
+    def confusion(self, table: TpuTable) -> np.ndarray:
+        """The weighted [true, pred] confusion matrix — ONE device pass;
+        callers needing several metrics (model.summary) derive them all
+        from this instead of re-reducing per metric."""
         pred = _col(table, self.params.prediction_col)
         label = self._label(table)
-        n_classes = int(np.asarray(jnp.maximum(jnp.max(pred), jnp.max(label))).item()) + 1
-        C = _confusion_weighted(pred, label, table.W, n_classes)
-        C = np.asarray(C)
+        n_classes = int(np.asarray(
+            jnp.maximum(jnp.max(pred), jnp.max(label))).item()) + 1
+        return np.asarray(
+            _confusion_weighted(pred, label, table.W, n_classes))
+
+    @staticmethod
+    def from_confusion(C: np.ndarray, metric: str) -> float:
         tp = np.diag(C)
         tot = max(C.sum(), 1e-12)
         if metric == "accuracy":
-            return tp.sum() / tot
+            return float(tp.sum() / tot)
         prec = tp / np.maximum(C.sum(axis=0), 1e-12)
         rec = tp / np.maximum(C.sum(axis=1), 1e-12)
         support = C.sum(axis=1) / tot
@@ -165,6 +172,9 @@ class MulticlassClassificationEvaluator(_Evaluator):
             f1 = 2 * prec * rec / np.maximum(prec + rec, 1e-12)
             return float(np.sum(f1 * support))
         raise ValueError(f"unknown metric {metric!r}")
+
+    def _compute(self, table: TpuTable, metric: str):
+        return self.from_confusion(self.confusion(table), metric)
 
 
 class RegressionEvaluator(_Evaluator):

@@ -44,8 +44,10 @@ def _training_summary(X, y, w, coef, intercept):
     ybar = jnp.sum(w * y) / tot
     tss = jnp.maximum(jnp.sum(w * (y - ybar) ** 2), EPS_TOTAL_WEIGHT)
     mae = jnp.sum(w * jnp.abs(resid)) / tot
-    yhat_bar = jnp.sum(w * yhat) / tot
-    expl = jnp.sum(w * (yhat - yhat_bar) ** 2) / tot
+    # Spark's RegressionMetrics centers SSreg on the LABEL mean (not the
+    # prediction mean) — the two differ for through-origin or
+    # early-stopped fits whose predictions are biased
+    expl = jnp.sum(w * (yhat - ybar) ** 2) / tot
     return rss, 1.0 - rss / tss, jnp.sqrt(rss / tot), mae, expl
 
 

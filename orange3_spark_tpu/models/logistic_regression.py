@@ -87,6 +87,31 @@ class LogisticRegressionModel(Model):
         prob, _ = self._predict(table.X)
         return np.asarray(prob)[: table.n_rows]
 
+    def summary(self, table: TpuTable) -> dict:
+        """MLlib ``model.summary``-style metrics computed on ``table``
+        (Spark evaluates its TrainingSummary on the training data; pass
+        any labeled table here — a holdout gives the honest version).
+        Returns accuracy / f1 / weightedPrecision / weightedRecall, plus
+        areaUnderROC / areaUnderPR for binomial models — each a device
+        reduction through the pyspark.ml.evaluation twins."""
+        from orange3_spark_tpu.models.evaluation import (
+            BinaryClassificationEvaluator, MulticlassClassificationEvaluator,
+        )
+
+        scored = self.transform(table)
+        ev = MulticlassClassificationEvaluator()
+        C = ev.confusion(scored)   # one device reduction for all four
+        out = {
+            m: ev.from_confusion(C, m)
+            for m in ("accuracy", "f1", "weightedPrecision",
+                      "weightedRecall")
+        }
+        if len(self.class_values) == 2:
+            for m in ("areaUnderROC", "areaUnderPR"):
+                out[m] = BinaryClassificationEvaluator(metric_name=m
+                                                       ).evaluate(scored)
+        return out
+
 
 class LogisticRegression(Estimator):
     ParamsCls = LogisticRegressionParams

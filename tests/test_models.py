@@ -225,8 +225,44 @@ def test_linear_regression_training_summary(session):
     np.testing.assert_allclose(float(m.p_values_[0]), ref.pvalue,
                                rtol=5e-2, atol=1e-12)
 
+    # explainedVariance: Spark centers SSreg on the LABEL mean — pin the
+    # through-origin case where prediction and label means differ
+    m0 = LinearRegression(solver="normal", reg_param=0.0,
+                          fit_intercept=False).fit(t)
+    yhat0 = m0.predict(t)
+    np.testing.assert_allclose(
+        float(m0.explained_variance_),
+        np.mean((yhat0 - y.mean()) ** 2), rtol=1e-4)
+
     # regularized or iterative fits: summary yes, inference stats no
     mr = LinearRegression(solver="normal", reg_param=0.05).fit(t)
     assert mr.r2_ is not None and mr.p_values_ is None
     ml = LinearRegression(solver="l-bfgs").fit(t)
     assert ml.r2_ is not None and ml.p_values_ is None
+
+
+def test_logreg_summary_matches_sklearn(session):
+    """model.summary (MLlib TrainingSummary role): metrics agree with
+    sklearn on the same predictions."""
+    from orange3_spark_tpu.models.logistic_regression import LogisticRegression
+
+    rng = np.random.default_rng(5)
+    n = 300
+    X = rng.standard_normal((n, 3)).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-(X @ [1.0, -1.0, 0.5])))
+    y = (rng.random(n) < p).astype(np.float32)
+    t = TpuTable.from_arrays(X, y, session=session)
+    m = LogisticRegression(max_iter=100).fit(t)
+    s = m.summary(t)
+
+    from sklearn.metrics import accuracy_score, f1_score, roc_auc_score
+
+    pred = m.predict(t)
+    prob = m.predict_proba(t)[:, 1]
+    np.testing.assert_allclose(s["accuracy"], accuracy_score(y, pred),
+                               rtol=1e-5)
+    np.testing.assert_allclose(s["f1"], f1_score(y, pred, average="weighted"),
+                               rtol=1e-4)
+    np.testing.assert_allclose(s["areaUnderROC"], roc_auc_score(y, prob),
+                               rtol=1e-4)
+    assert 0.5 < s["areaUnderPR"] <= 1.0
