@@ -140,6 +140,10 @@ def grow_tree(
     split_bin = jnp.full((n_internal,), n_bins, jnp.int32)  # default: leaf
     threshold = jnp.full((n_internal,), jnp.inf, jnp.float32)
     pos = jnp.zeros((N,), jnp.int32)  # node position within current level
+    # per-feature importance: Σ over chosen splits of the (weight-scaled)
+    # impurity decrease — MLlib's featureImportances accumulator (its
+    # per-node gain × node count equals this absolute gain)
+    imp = jnp.zeros((d,), jnp.float32)
 
     for level in range(depth):
         nodes = 2**level
@@ -166,6 +170,7 @@ def grow_tree(
             jnp.inf,
         )
         thr = jnp.where(do_split, thr, jnp.inf)
+        imp = imp.at[bf].add(jnp.where(do_split, best_gain, 0.0))
         off = nodes - 1  # level-order offset of this level
         feature = jax.lax.dynamic_update_slice(feature, bf, (off,))
         split_bin = jax.lax.dynamic_update_slice(split_bin, bb, (off,))
@@ -175,7 +180,14 @@ def grow_tree(
         pos = 2 * pos + go_right.astype(jnp.int32)
 
     leaf_stats = jax.ops.segment_sum(S, pos, num_segments=2**depth)
-    return Tree(feature, split_bin, threshold, leaf_value=leaf_stats), pos
+    return Tree(feature, split_bin, threshold, leaf_value=leaf_stats), pos, imp
+
+
+def normalize_importances(imp):
+    """MLlib featureImportances normalization: scale to sum 1 (all-zero —
+    no split anywhere — stays zero). Works on [d] or stacked [T, d]."""
+    s = jnp.sum(imp, axis=-1, keepdims=True)
+    return jnp.where(s > 0, imp / jnp.maximum(s, EPS), 0.0)
 
 
 @jax.jit

@@ -26,6 +26,7 @@ from orange3_spark_tpu.models._tree import (
     compute_bin_edges,
     grow_tree,
     leaf_class_probs,
+    normalize_importances,
     tree_apply,
 )
 from orange3_spark_tpu.models.base import Estimator, Model, Params, infer_class_values
@@ -45,13 +46,13 @@ def _grow_single(table: TpuTable, Ystats, p: DecisionTreeParams, gain_mode: str)
     edges = compute_bin_edges(table.X, table.W, p.max_bins)
     B = bin_features(table.X, edges)
     keep = jnp.ones((p.max_depth, table.n_attrs), jnp.float32)
-    tree, _ = grow_tree(
+    tree, _, imp = grow_tree(
         B, Ystats * table.W[:, None], edges, keep,
         jnp.float32(p.min_info_gain),
         depth=p.max_depth, n_bins=p.max_bins, gain_mode=gain_mode,
         min_instances=p.min_instances_per_node,
     )
-    return tree
+    return tree, normalize_importances(imp)
 
 
 class DecisionTreeClassifierModel(Model):
@@ -102,8 +103,10 @@ class DecisionTreeClassifier(Estimator):
         class_values = infer_class_values(table)
         k = len(class_values)
         Ystats = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=jnp.float32)
-        tree = _grow_single(table, Ystats, p, "gini")
-        return DecisionTreeClassifierModel(p, tree, class_values)
+        tree, imp = _grow_single(table, Ystats, p, "gini")
+        model = DecisionTreeClassifierModel(p, tree, class_values)
+        model.feature_importances_ = imp   # MLlib featureImportances
+        return model
 
 
 class DecisionTreeRegressorModel(Model):
@@ -149,5 +152,7 @@ class DecisionTreeRegressor(Estimator):
             )
         y = table.y
         Ystats = jnp.stack([y, y * y, jnp.ones_like(y)], axis=1)
-        tree = _grow_single(table, Ystats, p, "variance")
-        return DecisionTreeRegressorModel(p, tree)
+        tree, imp = _grow_single(table, Ystats, p, "variance")
+        model = DecisionTreeRegressorModel(p, tree)
+        model.feature_importances_ = imp   # MLlib featureImportances
+        return model

@@ -21,6 +21,7 @@ import numpy as np
 from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, Domain
 from orange3_spark_tpu.core.table import TpuTable
 from orange3_spark_tpu.models._tree import (
+    normalize_importances,
     Tree,
     bin_features,
     compute_bin_edges,
@@ -71,7 +72,7 @@ def _gbt_round(F, B, edges, W, y, boot_key, *, p: GBTParams, loss: str,
         g = (F - y) * w
         h = w
     S = jnp.stack([g, h, w], axis=1)
-    tree, leaf_idx = grow_tree(
+    tree, leaf_idx, imp = grow_tree(
         B, S, edges, feat_keep, jnp.float32(p.min_info_gain),
         depth=depth, n_bins=n_bins, gain_mode="newton", reg=p.reg_lambda,
         min_instances=p.min_instances_per_node,
@@ -80,7 +81,8 @@ def _gbt_round(F, B, edges, W, y, boot_key, *, p: GBTParams, loss: str,
     F_new = F + p.step_size * values[leaf_idx]
     # store leaf scalar values in leaf_value[..., :1] for serving
     tree = tree._replace(leaf_value=values[:, None])
-    return F_new, tree
+    # per-tree-normalized, as MLlib's ensemble featureImportances expects
+    return F_new, tree, normalize_importances(imp)
 
 
 def _boost(B, edges, W, y, depth, n_bins, p: GBTParams, loss: str):
@@ -97,18 +99,23 @@ def _boost(B, edges, W, y, depth, n_bins, p: GBTParams, loss: str):
     F = jnp.full((N,), f0)
 
     trees = []
+    imps = []
     for r in range(p.max_iter):
         key, sub = jax.random.split(key)
-        F, tree = _gbt_round(F, B, edges, W, y, sub, p=p, loss=loss,
-                             depth=depth, n_bins=n_bins)
+        F, tree, imp = _gbt_round(F, B, edges, W, y, sub, p=p, loss=loss,
+                                  depth=depth, n_bins=n_bins)
         trees.append(tree)
+        imps.append(imp)
         # rounds are heavyweight: keep at most 4 in flight
         # (utils/dispatch.py has the full story on the XLA:CPU rendezvous
         # wedge this prevents)
         bound_dispatch(r + 1, F, period=4)
     jax.block_until_ready(trees)
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
-    return float(f0), stacked
+    # MLlib ensemble featureImportances: mean of per-tree-normalized,
+    # renormalized
+    imp = normalize_importances(jnp.mean(jnp.stack(imps), axis=0))
+    return float(f0), stacked, imp
 
 
 @jax.jit
@@ -174,9 +181,11 @@ class GBTClassifier(Estimator):
             raise ValueError("GBTClassifier is binary (MLlib parity)")
         edges = compute_bin_edges(table.X, table.W, p.max_bins)
         B = bin_features(table.X, edges)
-        f0, forest = _boost(B, edges, table.W, y, p.max_depth, p.max_bins, p,
+        f0, forest, imp = _boost(B, edges, table.W, y, p.max_depth, p.max_bins, p,
                             loss="logistic")
-        return GBTClassifierModel(p, f0, forest, class_values)
+        model = GBTClassifierModel(p, f0, forest, class_values)
+        model.feature_importances_ = imp   # MLlib featureImportances
+        return model
 
 
 class GBTRegressorModel(Model):
@@ -211,6 +220,8 @@ class GBTRegressor(Estimator):
         p = self.params
         edges = compute_bin_edges(table.X, table.W, p.max_bins)
         B = bin_features(table.X, edges)
-        f0, forest = _boost(B, edges, table.W, table.y, p.max_depth, p.max_bins,
+        f0, forest, imp = _boost(B, edges, table.W, table.y, p.max_depth, p.max_bins,
                             p, loss="squared")
-        return GBTRegressorModel(p, f0, forest)
+        model = GBTRegressorModel(p, f0, forest)
+        model.feature_importances_ = imp   # MLlib featureImportances
+        return model

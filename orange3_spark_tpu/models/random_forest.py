@@ -23,6 +23,7 @@ from orange3_spark_tpu.core.domain import ContinuousVariable, DiscreteVariable, 
 from orange3_spark_tpu.core.table import TpuTable
 from orange3_spark_tpu.models._tree import (
     Tree,
+    normalize_importances,
     bin_features,
     compute_bin_edges,
     grow_tree,
@@ -74,12 +75,13 @@ def _fit_forest(B, edges, Ystats, W, keep_p, min_gain, seed, *, num_trees: int,
         # never mask every feature of a level
         keep = jnp.where(jnp.sum(keep, 1, keepdims=True) > 0, keep, 1.0)
         S = Ystats * w_t[:, None]
-        tree, _ = grow_tree(
+        tree, _, imp = grow_tree(
             B, S, edges, keep, min_gain,
             depth=depth, n_bins=n_bins, gain_mode=gain_mode,
             min_instances=min_instances,
         )
-        return tree
+        # MLlib featureImportances: normalize PER TREE before averaging
+        return tree, normalize_importances(imp)
 
     return jax.vmap(fit_one)(jax.random.split(key, num_trees))
 
@@ -141,7 +143,12 @@ class RandomForestClassifier(Estimator):
             k=k, gain_mode="gini", min_instances=p.min_instances_per_node,
             subsample=p.subsampling_rate,
         )
-        return RandomForestClassifierModel(p, forest, class_values)
+        forest, tree_imps = forest
+        model = RandomForestClassifierModel(p, forest, class_values)
+        # MLlib: average the per-tree-normalized importances, renormalize
+        model.feature_importances_ = normalize_importances(
+            jnp.mean(tree_imps, axis=0))
+        return model
 
 
 # ---------------------------------------------------------------- regressor
@@ -195,4 +202,8 @@ class RandomForestRegressor(Estimator):
             k=3, gain_mode="variance", min_instances=p.min_instances_per_node,
             subsample=p.subsampling_rate,
         )
-        return RandomForestRegressorModel(p, forest)
+        forest, tree_imps = forest
+        model = RandomForestRegressorModel(p, forest)
+        model.feature_importances_ = normalize_importances(
+            jnp.mean(tree_imps, axis=0))
+        return model

@@ -151,3 +151,37 @@ def test_gbt_round_jit_cache_shared_across_fits(session):
     misses_after_first = _gbt_round._cache_size()
     GBTClassifier(max_iter=3, max_depth=3).fit(t)
     assert _gbt_round._cache_size() == misses_after_first
+
+
+def test_feature_importances(session):
+    """featureImportances (MLlib tree-ensemble API): the informative
+    feature dominates, importances are normalized, noise features ~0."""
+    import numpy as np
+    from orange3_spark_tpu.models.decision_tree import DecisionTreeClassifier
+    from orange3_spark_tpu.models.gbt import GBTClassifier
+    from orange3_spark_tpu.models.random_forest import RandomForestClassifier
+
+    rng = np.random.default_rng(6)
+    n = 2000
+    X = rng.standard_normal((n, 5)).astype(np.float32)
+    y = (X[:, 2] + 0.1 * rng.standard_normal(n) > 0).astype(np.float32)
+    t = TpuTable.from_arrays(X, y, session=session)
+
+    for est in (DecisionTreeClassifier(max_depth=4),
+                RandomForestClassifier(num_trees=10, max_depth=4),
+                GBTClassifier(max_iter=5, max_depth=3)):
+        m = est.fit(t)
+        imp = np.asarray(m.feature_importances_)
+        assert imp.shape == (5,)
+        np.testing.assert_allclose(imp.sum(), 1.0, rtol=1e-5)
+        assert np.argmax(imp) == 2
+        assert imp[2] > 0.65
+
+    from sklearn.ensemble import RandomForestClassifier as SkRF
+
+    sk = SkRF(n_estimators=10, max_depth=4, random_state=0).fit(X, y)
+    ours = np.asarray(RandomForestClassifier(num_trees=10, max_depth=4)
+                      .fit(t).feature_importances_)
+    # same dominant feature and the same rough mass on it as sklearn
+    assert np.argmax(sk.feature_importances_) == np.argmax(ours) == 2
+    assert abs(float(ours[2]) - float(sk.feature_importances_[2])) < 0.2
