@@ -288,7 +288,8 @@ class KMeans(Estimator):
         )
         tol = jnp.float32(p.tol)
         if p.n_init <= 1:
-            centers, _, cost, n_iter = lloyd(table.X, table.W, self._init_centers(table), tol)
+            centers, assign, cost, n_iter = lloyd(
+                table.X, table.W, self._init_centers(table), tol)
         else:
             # all restarts advance in lockstep inside one vmapped while_loop —
             # n_init independent Lloyd runs for roughly the cost of one
@@ -296,14 +297,21 @@ class KMeans(Estimator):
                 self.replace_seed(s)._init_centers(table)
                 for s in range(p.seed, p.seed + p.n_init)
             ])
-            centers_v, _, cost_v, iter_v = jax.vmap(
+            centers_v, assign_v, cost_v, iter_v = jax.vmap(
                 lambda c0: lloyd(table.X, table.W, c0, tol)
             )(inits)
             best = jnp.argmin(cost_v)
             centers, cost, n_iter = centers_v[best], cost_v[best], iter_v[best]
+            assign = assign_v[best]
         model = KMeansModel(p, centers)
         model.n_iter_ = concrete_or_none(n_iter, int)
         model.training_cost_ = concrete_or_none(cost)
+        # MLlib summary.clusterSizes: live ROW count per cluster (Spark
+        # counts rows, not weight — only the padding/filter mask W>0
+        # gates membership), reusing the converged Lloyd assignment
+        model.cluster_sizes_ = jax.ops.segment_sum(
+            (table.W > 0).astype(jnp.float32), assign.astype(jnp.int32),
+            num_segments=p.k)
         return model
 
     def replace_seed(self, seed: int) -> "KMeans":

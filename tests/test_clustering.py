@@ -147,3 +147,17 @@ def test_pic_two_cliques():
     a, b = assign[:15], assign[15:]
     assert len(np.unique(a)) == 1 and len(np.unique(b)) == 1
     assert a[0] != b[0]
+
+
+def test_kmeans_cluster_sizes(session):
+    """summary.clusterSizes: weighted per-cluster counts covering all rows."""
+    import numpy as np
+    from orange3_spark_tpu.models.kmeans import KMeans
+
+    rng = np.random.default_rng(7)
+    X = np.concatenate([rng.normal(-4, 0.3, (120, 2)),
+                        rng.normal(4, 0.3, (80, 2))]).astype(np.float32)
+    t = TpuTable.from_arrays(X)
+    m = KMeans(k=2, seed=1).fit(t)
+    sizes = np.sort(np.asarray(m.cluster_sizes_))
+    np.testing.assert_allclose(sizes, [80.0, 120.0])
