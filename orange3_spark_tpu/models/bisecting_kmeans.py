@@ -118,6 +118,10 @@ class BisectingKMeans(Estimator):
             np.stack(leaves).astype(np.float32), table.session.replicated
         )
         model = BisectingKMeansModel(p, centers)
-        _, cost = _assign(X, centers, W)
+        assign, cost = _assign(X, centers, W)
         model.training_cost_ = float(cost)
+        # MLlib summary.clusterSizes: live rows per final-center assignment
+        model.cluster_sizes_ = jax.ops.segment_sum(
+            (W > 0).astype(jnp.float32), assign.astype(jnp.int32),
+            num_segments=len(leaves))
         return model

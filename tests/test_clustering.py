@@ -161,3 +161,21 @@ def test_kmeans_cluster_sizes(session):
     m = KMeans(k=2, seed=1).fit(t)
     sizes = np.sort(np.asarray(m.cluster_sizes_))
     np.testing.assert_allclose(sizes, [80.0, 120.0])
+
+
+def test_gmm_and_bisecting_cluster_sizes(session):
+    import numpy as np
+    from orange3_spark_tpu.models.bisecting_kmeans import BisectingKMeans
+    from orange3_spark_tpu.models.gaussian_mixture import GaussianMixture
+
+    rng = np.random.default_rng(8)
+    X = np.concatenate([rng.normal(-5, 0.3, (150, 2)),
+                        rng.normal(5, 0.3, (50, 2))]).astype(np.float32)
+    t = TpuTable.from_arrays(X)
+
+    g = GaussianMixture(k=2, seed=0).fit(t)
+    np.testing.assert_allclose(np.sort(np.asarray(g.cluster_sizes_)),
+                               [50.0, 150.0])
+    b = BisectingKMeans(k=2, seed=0).fit(t)
+    np.testing.assert_allclose(np.sort(np.asarray(b.cluster_sizes_)),
+                               [50.0, 150.0])
