@@ -121,7 +121,7 @@ class BisectingKMeans(Estimator):
         assign, cost = _assign(X, centers, W)
         model.training_cost_ = float(cost)
         # MLlib summary.clusterSizes: live rows per final-center assignment
-        model.cluster_sizes_ = jax.ops.segment_sum(
-            (W > 0).astype(jnp.float32), assign.astype(jnp.int32),
-            num_segments=len(leaves))
+        from orange3_spark_tpu.models.kmeans import live_cluster_sizes
+
+        model.cluster_sizes_ = live_cluster_sizes(W, assign, len(leaves))
         return model

@@ -239,11 +239,12 @@ class GaussianMixture(Estimator):
         model = GaussianMixtureModel(p, weights, means, covs)
         model.n_iter_ = concrete_or_none(n_iter, int)
         model.log_likelihood_ = concrete_or_none(ll)
-        # MLlib summary.clusterSizes: live rows per argmax-responsibility
-        # component (row counts, matching KMeans's convention here) —
-        # through model._log_joint so sizes can never disagree with
-        # model.predict's assignment
-        assign = jnp.argmax(model._log_joint(table), axis=1).astype(jnp.int32)
-        model.cluster_sizes_ = jax.ops.segment_sum(
-            (table.W > 0).astype(jnp.float32), assign, num_segments=p.k)
+        # MLlib summary.clusterSizes, through model._log_joint so sizes
+        # can never disagree with model.predict. The extra E-step pass is
+        # deliberate eager work: Spark's GaussianMixtureSummary likewise
+        # materializes its predictions at fit time (~1 EM iteration cost).
+        from orange3_spark_tpu.models.kmeans import live_cluster_sizes
+
+        assign = jnp.argmax(model._log_joint(table), axis=1)
+        model.cluster_sizes_ = live_cluster_sizes(table.W, assign, p.k)
         return model

@@ -44,6 +44,15 @@ class KMeansParams(Params):
     compute_dtype: str = "float32"
 
 
+def live_cluster_sizes(W, assign, num_segments: int):
+    """MLlib ``summary.clusterSizes``: live ROW counts per cluster (Spark
+    counts rows, not weights — W only gates padding/filtered membership).
+    THE one implementation, shared by KMeans / BisectingKMeans / GMM."""
+    return jax.ops.segment_sum(
+        (W > 0).astype(jnp.float32), assign.astype(jnp.int32),
+        num_segments=num_segments)
+
+
 @partial(jax.jit, static_argnames=("compute_dtype",))
 def _assign(X, centers, w, compute_dtype=jnp.float32):
     """Nearest-center ids + weighted cost. Distances via the matmul identity."""
@@ -306,12 +315,8 @@ class KMeans(Estimator):
         model = KMeansModel(p, centers)
         model.n_iter_ = concrete_or_none(n_iter, int)
         model.training_cost_ = concrete_or_none(cost)
-        # MLlib summary.clusterSizes: live ROW count per cluster (Spark
-        # counts rows, not weight — only the padding/filter mask W>0
-        # gates membership), reusing the converged Lloyd assignment
-        model.cluster_sizes_ = jax.ops.segment_sum(
-            (table.W > 0).astype(jnp.float32), assign.astype(jnp.int32),
-            num_segments=p.k)
+        # reuses the converged Lloyd assignment — no extra distance pass
+        model.cluster_sizes_ = live_cluster_sizes(table.W, assign, p.k)
         return model
 
     def replace_seed(self, seed: int) -> "KMeans":
