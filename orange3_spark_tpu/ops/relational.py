@@ -548,14 +548,31 @@ def value_counts(table: TpuTable, col: str) -> dict[str, float]:
 
 
 def train_test_split(table: TpuTable, test_fraction: float = 0.25, seed: int = 0):
-    """df.randomSplit([1-f, f]) — weight-mask complementary split."""
-    keep = jax.random.bernoulli(
-        jax.random.PRNGKey(seed), 1.0 - test_fraction, (table.n_pad,)
-    )
-    return (
-        table.with_weights(jnp.where(keep, table.W, 0.0)),
-        table.with_weights(jnp.where(keep, 0.0, table.W)),
-    )
+    """df.randomSplit([1-f, f]) — the two-way special case of
+    ``random_split`` (one implementation, one random stream)."""
+    train, test = random_split(
+        table, [1.0 - test_fraction, test_fraction], seed=seed)
+    return train, test
+
+
+def random_split(table: TpuTable, weights, seed: int = 0) -> list:
+    """``df.randomSplit(weights, seed)`` — n-way disjoint, exhaustive
+    split: every live row lands in exactly one part, with probability
+    proportional to its weight (Spark normalizes the weights). One
+    categorical draw per row; each part is a weight-masked view."""
+    w = np.asarray(weights, np.float64)
+    if not np.isfinite(w).all() or (w <= 0).any():
+        raise ValueError(
+            f"split weights must be positive and finite, got {weights}")
+    p = w / w.sum()
+    # one uniform draw per row + searchsorted on the cumulative weights —
+    # O(N) memory (a [N, n_parts] categorical logit matrix is not)
+    u = jax.random.uniform(jax.random.PRNGKey(seed), (table.n_pad,))
+    part = jnp.searchsorted(jnp.asarray(np.cumsum(p), jnp.float32), u)
+    return [
+        table.with_weights(jnp.where(part == i, table.W, 0.0))
+        for i in range(len(w))
+    ]
 
 
 def distinct(table: TpuTable, cols=None) -> TpuTable:

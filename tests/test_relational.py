@@ -431,3 +431,25 @@ def test_freq_items(session):
     # every category clears a tiny support
     assert set(freq_items(t, "region", support=1e-3)["region_freqItems"]) \
         == set(names)
+
+
+def test_random_split(session):
+    """df.randomSplit: disjoint, exhaustive, proportional."""
+    from orange3_spark_tpu.ops.relational import random_split
+
+    rng = np.random.default_rng(9)
+    t = TpuTable.from_arrays(rng.standard_normal((9000, 2)).astype(np.float32),
+                             session=session)
+    parts = random_split(t, [3.0, 1.0, 1.0], seed=4)
+    counts = [p.count() for p in parts]
+    assert sum(counts) == 9000                      # exhaustive + disjoint
+    np.testing.assert_allclose(counts[0] / 9000, 0.6, atol=0.03)
+    np.testing.assert_allclose(counts[1] / 9000, 0.2, atol=0.03)
+    # disjointness: no row is live in two parts
+    Ws = [np.asarray(p.W) for p in parts]
+    assert (sum((w > 0).astype(int) for w in Ws) <= 1).all()
+
+    with pytest.raises(ValueError, match="positive"):
+        random_split(t, [1.0, 0.0])
+    with pytest.raises(ValueError, match="finite"):
+        random_split(t, [1.0, float("nan")])

@@ -89,6 +89,7 @@ def run_step(name: str, argv: list, wall_s: int) -> bool:
     # dispatch events, so the FIRST tunnel compile of a big suite program
     # (trees/ALS single-dispatch fits, worst observed ~3 min, headroom for
     # worse) must not read as a stall; the wall timeout bounds the step.
+    env.pop("OTPU_STALL_S", None)   # pin the documented 900 s default
     env.update({"OTPU_TUNNEL_WAIT_S": "120", "OTPU_TUNNEL_RETRY_S": "60"})
     logp = f"/tmp/capture_{name}.log"
     log(f"running {name}: {' '.join(argv)} (wall {wall_s}s, log {logp})")
