@@ -95,6 +95,22 @@ class HashedLinearParams(Params):
     # per RPC) would dominate the wall: 99 epoch dispatches cost seconds,
     # 2900 chunk dispatches cost minutes.
     replay_granularity: str = "all"   # 'all' | 'epoch'
+    # Defer epoch-1 training into the replay program: the streaming pass
+    # becomes pure ingest (parse -> pad -> DMA -> cache/spill, NO step
+    # dispatches) and the replay then runs ``epochs`` full passes instead
+    # of ``epochs - 1``. The step sequence is IDENTICAL (epoch 1's
+    # per-chunk steps visit the same chunks in the same order the first
+    # replay pass does), so results are bit-identical to the default —
+    # pinned by tests/test_hashed_defer.py. Wins on tunneled/high-RTT
+    # hosts twice over: (a) epoch 1 sheds n_chunks step dispatches
+    # (~hundreds of ms EACH over a tunnel) and overlaps nothing but
+    # DMA, and (b) no per-chunk step program ever executes before the
+    # fused scan — the round-4 UNAVAILABLE device fault's observed
+    # precondition (see tools/replay_fault_diag.py). Requires
+    # cache_device and no checkpointer/resume (per-step checkpoint
+    # granularity needs per-chunk dispatches by definition); fit_stream
+    # silently falls back to the default schedule when those don't hold.
+    defer_epoch1: bool = False
     # value-weighted sparse rows (MLlib SparseVector semantics): chunks
     # carry n_cat (index, value) PAIRS — [label?, idx..., val...] — and the
     # forward is sum(emb[hash(idx)] * val), io/libsvm.py's fixed-nnz
@@ -664,18 +680,31 @@ class StreamingHashedLinearEstimator(Estimator):
         )
 
     def warm_replay(self, n_chunks: int, *,
-                    session: TpuSession | None = None) -> None:
+                    session: TpuSession | None = None):
         """Pre-compile the fused replay program for a fit whose cache will
         hold ``n_chunks`` train chunks, so a subsequent (timed) fit_stream
         hits the jit cache instead of paying the scan compile mid-fit.
         ``n_epochs`` and the chunk-stack shape are static to that program,
         so the warm shapes must match the real fit's (bench.py computes
         n_chunks = total chunks - holdout chunks). Device-side zeros only —
-        one chunk-sized host transfer, no data pass."""
+        one chunk-sized host transfer, no data pass.
+
+        Returns ``(theta, salts_np)`` from the executed warm scan (or None
+        when no replay program applies): scan-OUTPUT provenance, which is
+        exactly what a defer fit's post-fit ``evaluate_device`` sees — so a
+        caller can warm the eval program against it and hit the jit cache
+        in the timed run (bench.py does).
+
+        The warmed program mirrors ``defer_epoch1`` as configured on the
+        params; the subsequent fit must use the SAME effective schedule —
+        warming a defer estimator and then fitting with a checkpointer (or
+        without cache_device), where fit_stream silently falls back to the
+        default schedule, warms a program that fit never dispatches."""
         p = self.params
         session = session or TpuSession.active()
-        if not (p.fused_replay and p.epochs > 1 and n_chunks > 0):
-            return
+        if not (p.fused_replay and (p.epochs > 1 or p.defer_epoch1)
+                and n_chunks > 0):
+            return None
         n_cols = _chunk_cols(p)
         pad_rows = session.pad_rows(p.chunk_rows)
         theta, opt, _, salts, kw = _init_fit_state(p, session)
@@ -690,11 +719,17 @@ class StreamingHashedLinearEstimator(Estimator):
             zy = put_sharded(np.zeros((pad_rows,), np.float32),
                              session.vector_sharding)
             zw = zy
-        # theta/opt must have step-OUTPUT provenance (GSPMD-placed), like
-        # the real replay's inputs after epoch 1
-        theta, opt, _ = _hashed_step(
-            theta, opt, z, nv, zy, zw, salts,
-            jnp.float32(p.reg_param), jnp.float32(p.step_size), **kw)
+        if not p.defer_epoch1:
+            # theta/opt must have step-OUTPUT provenance (GSPMD-placed),
+            # like the real replay's inputs after a per-chunk epoch 1. A
+            # defer fit hands the replay _init_fit_state outputs directly,
+            # so its warm must NOT run a step — which also keeps the warm
+            # phase free of the step-then-scan sequence the round-4 device
+            # fault needs.
+            theta, opt, _ = _hashed_step(
+                theta, opt, z, nv, zy, zw, salts,
+                jnp.float32(p.reg_param), jnp.float32(p.step_size), **kw)
+        n_rep = p.epochs - 1 + (1 if p.defer_epoch1 else 0)
         stacks = (
             jnp.stack([z] * n_chunks), jnp.stack([nv] * n_chunks),
             jnp.stack([zy] * n_chunks), jnp.stack([zw] * n_chunks),
@@ -702,9 +737,10 @@ class StreamingHashedLinearEstimator(Estimator):
         theta, opt, losses = _hashed_replay_epochs(
             theta, opt, *stacks, salts,
             jnp.float32(p.reg_param), jnp.float32(p.step_size),
-            n_epochs=(1 if p.replay_granularity == "epoch"
-                      else p.epochs - 1), **kw)
+            n_epochs=(1 if p.replay_granularity == "epoch" else n_rep),
+            **kw)
         jax.block_until_ready(losses)
+        return theta, np.asarray(salts)
 
     def fit_stream(
         self,
@@ -864,10 +900,23 @@ class StreamingHashedLinearEstimator(Estimator):
         # the other streaming estimators. Enabled even at epochs=1 because
         # the cache doubles as the model's exposed device_chunks_
         cache = _DeviceCache(cache_device, cache_device_bytes)
+        # Defer-epoch-1 schedule (see the Params docstring): the streaming
+        # pass is pure ingest and ALL p.epochs training passes run off the
+        # cache/spill/stream afterwards. Bit-identical step sequence; the
+        # epoch loop below runs one extra iteration to compensate for the
+        # step-free pass 0. Falls back silently when its preconditions
+        # (cache, no resume granularity) don't hold. Computed up here
+        # because a defer fit has replay passes even at epochs == 1, so
+        # the spill/overflow gates below must read `epochs > 1 or defer`.
+        defer = (
+            p.defer_epoch1 and cache_device
+            and checkpointer is None and resume_from == 0
+        )
         spill: DiskChunkCache | None = None
         spill_active = [False]      # toggled by the epoch loop; read by
         #                             to_device on the prefetch thread
-        if cache_device and cache_spill_dir is not None and p.epochs > 1:
+        if (cache_device and cache_spill_dir is not None
+                and (p.epochs > 1 or defer)):
             shapes = (((pad_rows, n_cols),) if p.label_in_chunk
                       else ((pad_rows, n_cols), (pad_rows,), (pad_rows,)))
             spill = DiskChunkCache(cache_spill_dir, shapes)
@@ -905,6 +954,10 @@ class StreamingHashedLinearEstimator(Estimator):
             p.fused_replay and cache_device and p.epochs > 1
             and checkpointer is None and resume_from == 0
         )
+        if defer:
+            # a defer fit fuses even at epochs == 1 (the single training
+            # pass IS the replay)
+            fuse_replay = p.fused_replay
         def disk_chunk_iter(start: int = 0):
             """Device feed for an overflow replay epoch: padded records
             straight off the spill memmap (no parsing), prefetch-overlapped
@@ -974,7 +1027,7 @@ class StreamingHashedLinearEstimator(Estimator):
                 for s in starts:
                     yield grp_to_device(s)
 
-        for epoch in range(p.epochs):
+        for epoch in range(p.epochs + (1 if defer else 0)):
             t_epoch = time.perf_counter()
             if epoch == 0 or not (cache.enabled or use_disk):
                 # stream from the source; a look-ahead window keeps the LAST
@@ -988,6 +1041,8 @@ class StreamingHashedLinearEstimator(Estimator):
                         if len(window) <= holdout_chunks:
                             continue
                         dev_chunk = window.pop(0)
+                    if epoch == 0 and defer:
+                        continue        # ingest-only pass: no step dispatch
                     if n_steps < resume_from:
                         n_steps += 1
                         continue
@@ -1003,12 +1058,13 @@ class StreamingHashedLinearEstimator(Estimator):
                     spill_active[0] = False   # prefetch thread has exited
                     if spill is not None:
                         spill.finalize()
-                    if cache.degraded and p.epochs > 1:
+                    if cache.degraded and (p.epochs > 1 or defer):
                         use_disk = (spill is not None
                                     and spill.n_records > holdout_chunks)
                         if not use_disk:
                             warn_cache_overflow(
-                                cache_device_bytes, p.epochs - 1,
+                                cache_device_bytes,
+                                p.epochs - 1 + (1 if defer else 0),
                                 detail=(
                                     "The disk spill has no trainable "
                                     "records (fewer chunks than the "
@@ -1058,8 +1114,8 @@ class StreamingHashedLinearEstimator(Estimator):
                         # (+ the prefetched next group) <= 3/4 budget
                         bound_dispatch(n_groups, last_loss, period=2)
                     # partial tail group (different leading shape would
-                    # recompile the scan): per-chunk steps, already
-                    # compiled from epoch 1
+                    # recompile the scan): per-chunk steps — compiled in
+                    # epoch 1, or on first use here under defer_epoch1
                     n_train_recs = spill.n_records - holdout_chunks
                     for dev_chunk in disk_chunk_iter(
                             start=(n_train_recs // group) * group):
@@ -1085,12 +1141,13 @@ class StreamingHashedLinearEstimator(Estimator):
                     jnp.stack([c[i] for c in cache.batches])
                     for i in range(4)
                 )
+                n_rep = p.epochs - 1 + (1 if defer else 0)
                 if p.replay_granularity == "epoch":
                     # one n_epochs=1 scan dispatch per epoch over the same
                     # stack — the tunnel-fragility middle ground (see the
                     # Params docstring); sync every 2 dispatches like the
                     # grouped disk replay (each pins the full stack)
-                    for rep in range(p.epochs - 1):
+                    for rep in range(n_rep):
                         theta, opt_state, chunk_losses = \
                             _hashed_replay_epochs(
                                 theta, opt_state, *stacks, salts, reg, lr,
@@ -1101,11 +1158,11 @@ class StreamingHashedLinearEstimator(Estimator):
                 else:
                     theta, opt_state, chunk_losses = _hashed_replay_epochs(
                         theta, opt_state, *stacks, salts, reg, lr,
-                        n_epochs=p.epochs - 1, **static_kw,
+                        n_epochs=n_rep, **static_kw,
                     )
                     last_loss = chunk_losses[-1, -1]
                 del stacks
-                n_steps += (p.epochs - 1) * len(cache.batches)
+                n_steps += n_rep * len(cache.batches)
                 jax.block_until_ready(last_loss)
                 replay_fused_s = time.perf_counter() - t_rep
                 if stage_times is not None:
@@ -1122,7 +1179,7 @@ class StreamingHashedLinearEstimator(Estimator):
                 stage_times["replay_fused_s"] = round(replay_fused_s, 3)
             stage_times["cache_overflow"] = cache.degraded
             stage_times["replay_source"] = (
-                None if p.epochs <= 1
+                None if (p.epochs <= 1 and not defer)
                 else ("fused" if p.replay_granularity != "epoch"
                       else "fused_epoch") if replay_fused_s is not None
                 else "disk" if use_disk

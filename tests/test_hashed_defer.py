@@ -1,0 +1,139 @@
+"""defer_epoch1 schedule: the streaming pass is pure ingest and the replay
+program carries ALL `epochs` training passes. The step SEQUENCE is identical
+to the default interleaved schedule (epoch 1's per-chunk steps visit the same
+chunks in the same order the first replay pass does), so every variant here
+must match the default fit BIT-IDENTICALLY — that equality is the whole
+contract that lets bench.py turn it on unconditionally on hardware, where it
+sheds one ~RTT-priced step dispatch per chunk from epoch 1 and keeps any
+per-chunk step program from executing before the fused scan (the round-4
+UNAVAILABLE fault's observed precondition)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from orange3_spark_tpu.io.streaming import array_chunk_source
+from orange3_spark_tpu.models.hashed_linear import (
+    StreamingHashedLinearEstimator,
+)
+from orange3_spark_tpu.utils.fault import StreamCheckpointer
+
+from tests.test_hashed_linear import _criteo_shaped
+
+
+def _est(**kw):
+    base = dict(n_dims=1 << 12, n_dense=4, n_cat=6, epochs=3,
+                step_size=0.05, reg_param=1e-4, chunk_rows=1024)
+    base.update(kw)
+    return StreamingHashedLinearEstimator(**base)
+
+
+def _theta_np(model):
+    return jax.tree.map(np.asarray, model.theta)
+
+
+def _assert_identical(a, b):
+    ta, tb = _theta_np(a), _theta_np(b)
+    jax.tree.map(np.testing.assert_array_equal, ta, tb)
+    assert a.n_steps_ == b.n_steps_
+
+
+@pytest.fixture(scope="module")
+def data():
+    Xall, y = _criteo_shaped(4096, seed=21)
+    return Xall, y
+
+
+def test_defer_matches_default_fused(session, data):
+    Xall, y = data
+    src = array_chunk_source(Xall, y, chunk_rows=1024)
+    base = _est().fit_stream(src, session=session, cache_device=True)
+    deferred = _est(defer_epoch1=True).fit_stream(
+        src, session=session, cache_device=True)
+    _assert_identical(base, deferred)
+
+
+def test_defer_matches_default_epoch_granularity(session, data):
+    Xall, y = data
+    src = array_chunk_source(Xall, y, chunk_rows=1024)
+    base = _est(replay_granularity="epoch").fit_stream(
+        src, session=session, cache_device=True)
+    deferred = _est(replay_granularity="epoch", defer_epoch1=True).fit_stream(
+        src, session=session, cache_device=True)
+    _assert_identical(base, deferred)
+
+
+def test_defer_single_epoch_trains_once(session, data):
+    """epochs=1 + defer: the single training pass runs INSIDE the replay
+    program (fuse engages at epochs == 1) and matches the default exactly."""
+    Xall, y = data
+    src = array_chunk_source(Xall, y, chunk_rows=1024)
+    base = _est(epochs=1).fit_stream(src, session=session, cache_device=True)
+    deferred = _est(epochs=1, defer_epoch1=True).fit_stream(
+        src, session=session, cache_device=True)
+    _assert_identical(base, deferred)
+
+
+def test_defer_holdout_and_eval_match(session, data):
+    Xall, y = data
+    src = array_chunk_source(Xall, y, chunk_rows=1024)
+    base = _est().fit_stream(src, session=session, cache_device=True,
+                             holdout_chunks=1)
+    deferred = _est(defer_epoch1=True).fit_stream(
+        src, session=session, cache_device=True, holdout_chunks=1)
+    _assert_identical(base, deferred)
+    assert len(deferred.holdout_chunks_) == 1
+    ev_b = base.evaluate_device(base.holdout_chunks_)
+    ev_d = deferred.evaluate_device(deferred.holdout_chunks_)
+    assert ev_b["logloss"] == pytest.approx(ev_d["logloss"], abs=0)
+
+
+def test_defer_disk_spill_parity(session, data, tmp_path):
+    """Overflowed defer fit: ingest writes the spill, the disk replay then
+    carries all `epochs` passes — same records, same order, same numbers."""
+    Xall, y = data
+    src = array_chunk_source(Xall, y, chunk_rows=1024)
+    base = _est().fit_stream(src, session=session, cache_device=True)
+    st: dict = {}
+    deferred = _est(defer_epoch1=True).fit_stream(
+        src, session=session, cache_device=True,
+        cache_device_bytes=1 << 16,   # force overflow: ~176 KB/chunk
+        cache_spill_dir=str(tmp_path), stage_times=st,
+    )
+    assert st["cache_overflow"] is True
+    assert st["replay_source"] == "disk"
+    _assert_identical(base, deferred)
+
+
+def test_defer_falls_back_with_checkpointer(session, data, tmp_path):
+    """Per-step checkpoint granularity needs per-chunk dispatches, so a
+    checkpointered fit silently ignores defer_epoch1 — and still matches the
+    default checkpointered fit exactly."""
+    Xall, y = data
+    src = array_chunk_source(Xall, y, chunk_rows=1024)
+    base = _est(fused_replay=False).fit_stream(
+        src, session=session, cache_device=True,
+        checkpointer=StreamCheckpointer(str(tmp_path / "a"), every_steps=3),
+    )
+    deferred = _est(fused_replay=False, defer_epoch1=True).fit_stream(
+        src, session=session, cache_device=True,
+        checkpointer=StreamCheckpointer(str(tmp_path / "b"), every_steps=3),
+    )
+    _assert_identical(base, deferred)
+
+
+def test_defer_warm_replay_matches_fit_program(session, data):
+    """warm_replay for a defer estimator must pre-compile the EXACT program
+    the timed fit dispatches (n_epochs = epochs, init-state provenance, no
+    provenance step). Cheap proxy assertion: warming then fitting produces
+    the same result as fitting cold, and the fit is bit-identical to the
+    non-warmed defer fit."""
+    Xall, y = data
+    src = array_chunk_source(Xall, y, chunk_rows=1024)
+    cold = _est(defer_epoch1=True).fit_stream(
+        src, session=session, cache_device=True)
+    est = _est(defer_epoch1=True)
+    est.warm_replay(4, session=session)
+    warmed = est.fit_stream(src, session=session, cache_device=True)
+    _assert_identical(cold, warmed)
