@@ -381,7 +381,7 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
         it = source()
         yield next(it)
 
-    if fused_env and defer and replay_fusible:
+    if defer and replay_fusible:
         # warm the replay scan at the timed fit's exact static shapes
         # (n_epochs + train chunk count), then warm the eval program with
         # the scan's OUTPUT theta — the same provenance the timed
@@ -417,21 +417,17 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
                   jnp.zeros((1,), jnp.float32))
             m0.evaluate_device([zc])
     else:
+        # non-fusible or per-chunk config: the timed fit trains through
+        # per-chunk steps (and, when overflowing, the grouped disk scan
+        # compiles at its own group shape mid-run — a known, logged cost),
+        # so warm the step + csv/h2d path with one real chunk. There is no
+        # replay scan to pre-compile here: replay either streams/loops
+        # per-chunk (no scan program) or is disabled.
         warm = make_est(1, defer_epoch1=False).fit_stream(
             head_source, session=session, cache_device=True,
             holdout_chunks=0
         )
         warm.evaluate_device([warm.device_chunks_[0]])  # compile eval too
-        # compile the fused replay program at the timed fit's exact static
-        # shapes — n_epochs and the stack shape are static args, so without
-        # this the scan compile would land inside the timed window and be
-        # misread as replay time. The stream rechunks to session.pad_rows
-        # (a data-axis multiple), so count chunks at that size. Gated on
-        # the SAME budget rule as fit_stream's fusion: when replay will
-        # stream instead, there is no scan program to warm.
-        if replay_fusible and fused_env:
-            make_est(epochs).warm_replay(n_chunks - holdout_chunks,
-                                         session=session)
 
     _log(f"timed fit: {epochs} epochs ...")
     stage_times: dict = {}
@@ -747,22 +743,33 @@ def main():
                          "(rc=3, stall watchdog)" if rc == 3
                     else f"failed (rc={rc})")
 
-        # The hardware-retry ladder (round-4: the single giant fused-replay
-        # scan reproducibly faults the device — UNAVAILABLE — whenever any
-        # per-chunk step ran first in the process, while the identical
-        # program runs clean standalone). Each rung re-runs this script in
-        # a fresh child with a weaker replay lowering; rung 2 costs ~99
-        # scan dispatches (seconds of tunnel overhead), rung 3 ~2900 chunk
-        # dispatches (minutes) — both far better than losing the hardware
-        # number. Rungs after the first are criteo-only, skipped when the
-        # caller pinned OTPU_FUSED_REPLAY, and skipped after a wall-timeout
-        # (a wedged run is NOT the fault signature — don't multiply the
+        # The hardware-retry ladder. Round-4 evidence, in order: (a) the
+        # single giant (n_epochs=N) fused-replay scan faults the device —
+        # UNAVAILABLE — whenever any program ran before it in the process
+        # (per-chunk steps originally; the 2026-07-31 8M run reproduced it
+        # after only a 1-chunk warm scan + eval under the defer schedule),
+        # though the same program runs clean standalone and at tiny stack
+        # sizes; (b) the diag matrix (tools/replay_fault_diag.py, banked
+        # verdict: fixed_by_epoch_granularity=true, everything else false)
+        # shows n_epochs=1 scan dispatches are immune in EVERY order
+        # tested. So per-epoch granularity is the hardware default rung —
+        # ~N dispatches of tunnel overhead buys the only lowering that has
+        # never faulted — and the one-dispatch giant scan is the explicit
+        # opt-in (OTPU_FUSED_REPLAY=1). Rung 2 drops to per-chunk replay
+        # (~n_chunks*N dispatches, minutes, no scan program at all).
+        # Rungs after the first are criteo-only, skipped when the caller
+        # pinned OTPU_FUSED_REPLAY, and skipped after a wall-timeout (a
+        # wedged run is NOT the fault signature — don't multiply the
         # worst-case window).
-        rungs = [({}, "fused replay"),
-                 ({"OTPU_FUSED_REPLAY": "epoch"}, "per-epoch fused replay"),
+        rungs = [({"OTPU_FUSED_REPLAY": "epoch"}, "per-epoch fused replay"),
                  ({"OTPU_FUSED_REPLAY": "0"}, "per-chunk replay")]
-        if os.environ.get("OTPU_FUSED_REPLAY") or args.config != "criteo":
-            rungs = rungs[:1]
+        if os.environ.get("OTPU_FUSED_REPLAY"):
+            # caller pinned the lowering: one attempt, environment untouched
+            rungs = [({}, "pinned replay lowering (OTPU_FUSED_REPLAY="
+                          f"{os.environ['OTPU_FUSED_REPLAY']})")]
+        elif args.config != "criteo":
+            # non-streaming config: replay lowering does not apply
+            rungs = [({}, "single attempt")]
         full_wall = float(os.environ.get("OTPU_CHILD_WALL_S", "3600"))
         fates: list = []
         cpu_line, line = "", ""
