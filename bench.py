@@ -266,7 +266,8 @@ def ensure_criteo_csv(n_rows: int) -> str:
     if not os.path.exists(path):
         _log(f"generating {path} ...")
         t0 = time.perf_counter()
-        gen_criteo_csv(path, n_rows)
+        gen_criteo_csv(path, n_rows)   # writes .tmp, then os.replace —
+        #                                a killed run leaves no final file
         _log(f"  generated in {time.perf_counter() - t0:.1f}s "
              f"({os.path.getsize(path) / 1e9:.2f} GB)")
     return path
