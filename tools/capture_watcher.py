@@ -41,8 +41,14 @@ STEPS = [
     # 99 epochs instead of 99) — so it outranks the long benches. Wall
     # must exceed cells x --wall-s.
     ("replay_diag", [sys.executable, "tools/replay_fault_diag.py"], 2400),
-    ("bench_8m", [sys.executable, "bench.py"], 2700),
-    ("step_ab", [sys.executable, "tools/step_ab.py"], 900),
+    # 3300 s: on a 2 MB/s-h2d window the 8M run is ~600 s of DMA + up to
+    # ~1500 s of per-epoch replay dispatches before eval — 2700 was
+    # borderline (the 08:12 attempt burned 1808 s on two rungs alone)
+    ("bench_8m", [sys.executable, "bench.py"], 3300),
+    # 1500 s: six tunnel compiles (five variants + the in-scan cell's
+    # replay program) plus 140 dispatched steps at up to ~1 s each on a
+    # degraded window
+    ("step_ab", [sys.executable, "tools/step_ab.py"], 1500),
     ("suite_c3", [sys.executable, "bench_suite.py", "--config", "3"], 3000),
     ("suite_c4", [sys.executable, "bench_suite.py", "--config", "4"], 2400),
     ("suite_c5", [sys.executable, "bench_suite.py", "--config", "5"], 2400),
