@@ -176,6 +176,14 @@ class StreamingLinearParams(Params):
     reg_param: float = 0.0       # L2
     chunk_rows: int = 1 << 18    # padded device batch per step
     seed: int = 0
+    # Defer epoch-1 training into the replay program (the hashed
+    # estimator's schedule, models/hashed_linear.py): the streaming pass
+    # becomes pure ingest and the replay carries ALL ``epochs`` passes —
+    # identical step sequence, bit-identical results, but zero step
+    # dispatches before the fused scan and none interleaved with ingest
+    # (each costs ~an RTT on tunneled hosts). Needs cache_device and no
+    # checkpointer/resume; silently falls back otherwise.
+    defer_epoch1: bool = False
 
 
 class _DeviceCache:
@@ -397,6 +405,18 @@ class StreamingKMeansParams(Params):
     chunk_rows: int = 1 << 18
     decay: float = 1.0           # MLlib StreamingKMeans decayFactor
     seed: int = 0
+    # Defer epoch-1 updates into the fused replay (the hashed/linear
+    # estimators' schedule): pass 0 seeds the centers and ingests into the
+    # cache/spill with ZERO update dispatches, then the replay carries all
+    # ``epochs`` passes. Identical to the default schedule except for
+    # batches streamed BEFORE the first live chunk seeded the centers
+    # ("pre-seed" batches): the default's epoch 1 skips their update while
+    # its replay epochs step them (a no-op for centers, a decay tick for
+    # counts); under defer every pass is a replay pass, so pre-seed
+    # batches get p.epochs decay ticks instead of p.epochs - 1. Fits with
+    # no pre-seed batches (any normal stream whose first chunk has a live
+    # row) are bit-identical.
+    defer_epoch1: bool = False
 
 
 @partial(jax.jit, static_argnames=("loss_kind", "n_epochs"),
@@ -424,6 +444,33 @@ def _stream_replay_epochs(theta, opt_state, Xs, ys, ws, reg, lr, *,
         epoch, (theta, opt_state), None, length=n_epochs
     )
     return theta, opt_state, losses
+
+
+@partial(jax.jit, static_argnames=("k", "n_epochs"), donate_argnums=(0, 1))
+def _kmeans_replay_epochs(centers, counts, Xs, ws, decay, *,
+                          k: int, n_epochs: int):
+    """Replay epochs over the HBM batch cache as ONE XLA program — the
+    KMeans twin of ``_stream_replay_epochs`` (epoch-level scan around a
+    batch-level scan; replay cost becomes pure device time regardless of
+    per-dispatch latency). Pre-seed batches ride the stack like any other:
+    their all-zero weights make the update a centers no-op + a counts
+    decay tick, exactly what the per-chunk replay loop does to them.
+    Returns per-(epoch, batch) costs."""
+    def body(carry, xs):
+        centers, counts = carry
+        X, w = xs
+        centers, counts, cost = _kmeans_stream_step(
+            centers, counts, X, w, decay, k=k)
+        return (centers, counts), cost
+
+    def epoch(carry, _):
+        carry, costs = jax.lax.scan(body, carry, (Xs, ws))
+        return carry, costs
+
+    (centers, counts), costs = jax.lax.scan(
+        epoch, (centers, counts), None, length=n_epochs
+    )
+    return centers, counts, costs
 
 
 @partial(jax.jit, static_argnames=("k",), donate_argnums=(0, 1))
@@ -484,15 +531,21 @@ class StreamingKMeans(Estimator):
         counts = jnp.zeros((p.k,), jnp.float32)
         decay = jnp.float32(p.decay)
         n_steps = 0
-        cache = _DeviceCache(cache_device and p.epochs > 1,
+        # defer-epoch-1 (see StreamingKMeansParams.defer_epoch1): pass 0
+        # seeds + ingests only; the loop runs one extra iteration and the
+        # replay carries all p.epochs update passes
+        defer = p.defer_epoch1 and cache_device and p.epochs > 0
+        n_replay = p.epochs - 1 + (1 if defer else 0)
+        cache = _DeviceCache(cache_device and (p.epochs > 1 or defer),
                              cache_device_bytes)
         spill: DiskChunkCache | None = None
-        if cache_device and cache_spill_dir is not None and p.epochs > 1:
+        if (cache_device and cache_spill_dir is not None
+                and (p.epochs > 1 or defer)):
             spill = DiskChunkCache(
                 cache_spill_dir, ((pad_rows, n_features), (pad_rows,))
             )
         use_disk = False
-        for epoch in range(p.epochs):
+        for epoch in range(p.epochs + (1 if defer else 0)):
             if epoch > 0 and (cache.enabled or use_disk):
                 if centers is None:
                     raise ValueError("stream produced no live rows")
@@ -550,8 +603,8 @@ class StreamingKMeans(Estimator):
                 wd = put_sharded(wp, vec_sh)
                 if epoch == 0:
                     cache.offer((Xd, wd, pre_seed))
-                if pre_seed:
-                    continue
+                if pre_seed or (epoch == 0 and defer):
+                    continue        # defer: ingest-only pass, no update
                 centers, counts, cost = _kmeans_stream_step(
                     centers, counts, Xd, wd, decay, k=p.k
                 )
@@ -560,10 +613,24 @@ class StreamingKMeans(Estimator):
             if epoch == 0:
                 if spill is not None:
                     spill.finalize()
-                if cache.degraded and p.epochs > 1:
+                if cache.degraded and (p.epochs > 1 or defer):
                     use_disk = spill is not None and spill.n_records > 0
                     if not use_disk:
-                        warn_cache_overflow(cache_device_bytes, p.epochs - 1)
+                        warn_cache_overflow(cache_device_bytes, n_replay)
+            if (epoch == 0 and n_replay > 0 and cache.enabled
+                    and cache.batches and centers is not None
+                    and 2 * cache.nbytes <= cache_device_bytes):
+                # remaining update passes in ONE dispatch — same transient
+                # stack + half-budget rule as the other streaming
+                # estimators' fused replay
+                Xs = jnp.stack([b[0] for b in cache.batches])
+                ws = jnp.stack([b[1] for b in cache.batches])
+                centers, counts, _costs = _kmeans_replay_epochs(
+                    centers, counts, Xs, ws, decay, k=p.k, n_epochs=n_replay,
+                )
+                del Xs, ws
+                n_steps += n_replay * len(cache.batches)
+                break
         if spill is not None:
             spill.delete()
         if centers is None:
@@ -657,10 +724,17 @@ class StreamingLinearEstimator(Estimator):
         lr = jnp.float32(p.step_size)
         n_steps = 0
         last_loss = None
-        cache = _DeviceCache(cache_device and p.epochs > 1,
+        # defer-epoch-1 (see StreamingLinearParams.defer_epoch1): pass 0 is
+        # ingest-only and the loop below runs one extra iteration so the
+        # replay carries all p.epochs training passes
+        defer = (p.defer_epoch1 and cache_device and p.epochs > 0
+                 and checkpointer is None and resume_from == 0)
+        n_replay = p.epochs - 1 + (1 if defer else 0)
+        cache = _DeviceCache(cache_device and (p.epochs > 1 or defer),
                              cache_device_bytes)
         spill: DiskChunkCache | None = None
-        if cache_device and cache_spill_dir is not None and p.epochs > 1:
+        if (cache_device and cache_spill_dir is not None
+                and (p.epochs > 1 or defer)):
             spill = DiskChunkCache(
                 cache_spill_dir,
                 ((pad_rows, n_features), (pad_rows,), (pad_rows,)),
@@ -682,7 +756,7 @@ class StreamingLinearEstimator(Estimator):
                     meta=ckpt_meta,
                 )
 
-        for epoch in range(p.epochs):
+        for epoch in range(p.epochs + (1 if defer else 0)):
             if epoch > 0 and cache.enabled:
                 # pure-HBM epoch: replay cached batches, zero host work
                 for Xd, yd, wd in cache.batches:
@@ -736,6 +810,8 @@ class StreamingLinearEstimator(Estimator):
                 wd = put_sharded(wp, vec_sh)
                 if epoch == 0:
                     cache.offer((Xd, yd, wd))
+                if epoch == 0 and defer:
+                    continue        # ingest-only pass: no step dispatch
                 if n_steps < resume_from:
                     n_steps += 1  # fast-forward past checkpointed batches
                     continue
@@ -743,11 +819,11 @@ class StreamingLinearEstimator(Estimator):
             if epoch == 0:
                 if spill is not None:
                     spill.finalize()
-                if cache.degraded and p.epochs > 1:
+                if cache.degraded and (p.epochs > 1 or defer):
                     use_disk = spill is not None and spill.n_records > 0
                     if not use_disk:
-                        warn_cache_overflow(cache_device_bytes, p.epochs - 1)
-            if (epoch == 0 and p.epochs > 1 and cache.enabled
+                        warn_cache_overflow(cache_device_bytes, n_replay)
+            if (epoch == 0 and n_replay > 0 and cache.enabled
                     and cache.batches and checkpointer is None
                     and 2 * cache.nbytes <= cache_device_bytes):
                 # remaining epochs in ONE dispatch (the transient batch
@@ -760,10 +836,10 @@ class StreamingLinearEstimator(Estimator):
                 )
                 theta, opt_state, losses = _stream_replay_epochs(
                     theta, opt_state, *stacks, reg, lr,
-                    loss_kind=p.loss, n_epochs=p.epochs - 1,
+                    loss_kind=p.loss, n_epochs=n_replay,
                 )
                 del stacks
-                n_steps += (p.epochs - 1) * len(cache.batches)
+                n_steps += n_replay * len(cache.batches)
                 last_loss = losses[-1, -1]
                 break
         if spill is not None:

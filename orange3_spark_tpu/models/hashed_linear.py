@@ -909,7 +909,7 @@ class StreamingHashedLinearEstimator(Estimator):
         # because a defer fit has replay passes even at epochs == 1, so
         # the spill/overflow gates below must read `epochs > 1 or defer`.
         defer = (
-            p.defer_epoch1 and cache_device
+            p.defer_epoch1 and cache_device and p.epochs > 0
             and checkpointer is None and resume_from == 0
         )
         spill: DiskChunkCache | None = None

@@ -1,0 +1,134 @@
+"""defer_epoch1 for the dense streaming estimators (the hashed estimator's
+schedule, tests/test_hashed_defer.py): pass 0 is pure ingest, the replay
+carries ALL epochs, results match the default schedule bit-identically.
+Also pins the NEW KMeans fused replay (one scan dispatch for epochs 2+)
+against the streaming path it replaces dispatch-for-dispatch."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from orange3_spark_tpu.io.streaming import (
+    StreamingKMeans,
+    StreamingLinearEstimator,
+    array_chunk_source,
+)
+from orange3_spark_tpu.utils.fault import StreamCheckpointer
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(33)
+    X = rng.standard_normal((4096, 6)).astype(np.float32)
+    w_true = rng.standard_normal(6)
+    y = (X @ w_true > 0).astype(np.float32)
+    return X, y
+
+
+def _lin(**kw):
+    base = dict(loss="logistic", epochs=3, step_size=0.05, chunk_rows=512)
+    base.update(kw)
+    return StreamingLinearEstimator(**base)
+
+
+def _fit_lin(est, data, session, **kw):
+    X, y = data
+    return est.fit_stream(
+        array_chunk_source(X, y, chunk_rows=512),
+        n_features=X.shape[1], session=session, **kw)
+
+
+def _assert_lin_identical(a, b):
+    np.testing.assert_array_equal(np.asarray(a.coef), np.asarray(b.coef))
+    np.testing.assert_array_equal(np.asarray(a.intercept),
+                                  np.asarray(b.intercept))
+    assert a.n_steps_ == b.n_steps_
+
+
+def test_linear_defer_matches_default(session, data):
+    base = _fit_lin(_lin(), data, session, cache_device=True)
+    deferred = _fit_lin(_lin(defer_epoch1=True), data, session,
+                        cache_device=True)
+    _assert_lin_identical(base, deferred)
+
+
+def test_linear_defer_single_epoch(session, data):
+    base = _fit_lin(_lin(epochs=1), data, session, cache_device=True)
+    deferred = _fit_lin(_lin(epochs=1, defer_epoch1=True), data, session,
+                        cache_device=True)
+    _assert_lin_identical(base, deferred)
+
+
+def test_linear_defer_disk_spill_parity(session, data, tmp_path):
+    base = _fit_lin(_lin(), data, session, cache_device=True)
+    deferred = _fit_lin(
+        _lin(defer_epoch1=True), data, session, cache_device=True,
+        cache_device_bytes=1 << 14,    # force overflow
+        cache_spill_dir=str(tmp_path),
+    )
+    _assert_lin_identical(base, deferred)
+
+
+def test_linear_defer_falls_back_with_checkpointer(session, data, tmp_path):
+    base = _fit_lin(_lin(), data, session, cache_device=True,
+                    checkpointer=StreamCheckpointer(str(tmp_path / "a"),
+                                                    every_steps=3))
+    deferred = _fit_lin(_lin(defer_epoch1=True), data, session,
+                        cache_device=True,
+                        checkpointer=StreamCheckpointer(str(tmp_path / "b"),
+                                                        every_steps=3))
+    _assert_lin_identical(base, deferred)
+
+
+# ---------------------------------------------------------------- kmeans
+
+def _km(**kw):
+    base = dict(k=4, epochs=3, chunk_rows=512, seed=7)
+    base.update(kw)
+    return StreamingKMeans(**base)
+
+
+def _fit_km(est, X, session, **kw):
+    return est.fit_stream(
+        array_chunk_source(X, None, chunk_rows=512),
+        n_features=X.shape[1], session=session, **kw)
+
+
+@pytest.fixture(scope="module")
+def km_data():
+    rng = np.random.default_rng(5)
+    return np.concatenate([
+        rng.standard_normal((1024, 5)).astype(np.float32) + c
+        for c in (0.0, 4.0, 8.0, 12.0)
+    ]).astype(np.float32)
+
+
+def test_kmeans_fused_replay_matches_streaming(session, km_data):
+    """The new one-dispatch replay must reproduce the re-streaming path
+    step for step (same batches, same order, same update program)."""
+    cached = _fit_km(_km(), km_data, session, cache_device=True)
+    streamed = _fit_km(_km(), km_data, session, cache_device=False)
+    np.testing.assert_array_equal(np.asarray(cached.centers),
+                                  np.asarray(streamed.centers))
+    assert cached.n_iter_ == streamed.n_iter_
+
+
+def test_kmeans_defer_matches_default(session, km_data):
+    base = _fit_km(_km(), km_data, session, cache_device=True)
+    deferred = _fit_km(_km(defer_epoch1=True), km_data, session,
+                       cache_device=True)
+    np.testing.assert_array_equal(np.asarray(base.centers),
+                                  np.asarray(deferred.centers))
+    assert base.n_iter_ == deferred.n_iter_
+
+
+def test_kmeans_defer_disk_spill_parity(session, km_data, tmp_path):
+    base = _fit_km(_km(), km_data, session, cache_device=True)
+    deferred = _fit_km(
+        _km(defer_epoch1=True), km_data, session, cache_device=True,
+        cache_device_bytes=1 << 14, cache_spill_dir=str(tmp_path),
+    )
+    np.testing.assert_array_equal(np.asarray(base.centers),
+                                  np.asarray(deferred.centers))
+    assert base.n_iter_ == deferred.n_iter_

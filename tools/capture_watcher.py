@@ -89,6 +89,23 @@ def probe() -> bool:
                for ln in (r.stdout or "").splitlines())
 
 
+def bank(name: str, lines: list) -> int:
+    """Append valid lines to OUT, skipping exact duplicates (a retried
+    step legitimately re-prints measurements it already banked before a
+    later stage of the run died)."""
+    try:
+        with open(OUT) as f:
+            seen = set(f.read().splitlines())
+    except OSError:
+        seen = set()
+    fresh = [ln for ln in lines if ln not in seen]
+    if fresh:
+        with open(OUT, "a") as f:
+            for ln in fresh:
+                f.write(ln + "\n")
+    return len(fresh)
+
+
 def run_step(name: str, argv: list, wall_s: int) -> bool:
     env = dict(os.environ)
     # the watcher only launches after a live probe — don't re-probe for
@@ -102,15 +119,23 @@ def run_step(name: str, argv: list, wall_s: int) -> bool:
     logp = f"/tmp/capture_{name}.log"
     log(f"running {name}: {' '.join(argv)} (wall {wall_s}s, log {logp})")
     t0 = time.time()
+    rc: object
     with open(logp, "w") as lf:
         try:
             r = subprocess.run(argv, stdout=subprocess.PIPE, stderr=lf,
                                text=True, timeout=wall_s, cwd=REPO, env=env)
-        except subprocess.TimeoutExpired:
-            log(f"{name}: WALL TIMEOUT after {wall_s}s")
-            return False
+            out, rc = r.stdout or "", r.returncode
+        except subprocess.TimeoutExpired as e:
+            # keep whatever the step printed before the wall: multi-line
+            # tools (step_ab) flush each measurement as its own complete
+            # JSON line precisely so an end-of-run wedge cannot cost the
+            # early lines
+            ob = e.stdout or b""
+            out = ob.decode("utf-8", "replace") if isinstance(ob, bytes) \
+                else (ob or "")
+            rc = "wall-timeout"
     dt = time.time() - t0
-    lines = [ln for ln in (r.stdout or "").splitlines()
+    lines = [ln for ln in out.splitlines()
              if ln.startswith("{") and '"metric"' in ln]
     ok_lines = []
     for ln in lines:
@@ -127,13 +152,14 @@ def run_step(name: str, argv: list, wall_s: int) -> bool:
             log(f"{name}: non-tpu backend {d.get('backend')!r}, not banking")
             continue
         ok_lines.append(ln)
-    if r.returncode == 0 and ok_lines:
-        with open(OUT, "a") as f:
-            for ln in ok_lines:
-                f.write(ln + "\n")
-        log(f"{name}: SUCCESS in {dt:.0f}s — {len(ok_lines)} line(s) banked")
+    # bank every complete measurement line even from a failed/wedged run —
+    # each line is self-contained — but only a clean exit marks the step
+    # done (a retry may add lines a mid-run death cost this attempt)
+    n_banked = bank(name, ok_lines) if ok_lines else 0
+    if rc == 0 and ok_lines:
+        log(f"{name}: SUCCESS in {dt:.0f}s — {n_banked} new line(s) banked")
         return True
-    log(f"{name}: rc={r.returncode}, {len(ok_lines)} usable lines, "
+    log(f"{name}: rc={rc}, {n_banked} line(s) banked from partial output, "
         f"{dt:.0f}s — see {logp}")
     return False
 
