@@ -336,14 +336,27 @@ def main():
     benches = {"3": bench_higgs_trees, "4": bench_movielens_als,
                "5": bench_taxi_pipeline}
     keys = ["3", "4", "5"] if args.config == "all" else [args.config]
+    failed = []
     for k in keys:
-        out = benches[k](args.rows_scale)
+        try:
+            out = benches[k](args.rows_scale)
+        except Exception as e:  # noqa: BLE001 — one config's device fault
+            # (or OOM) must not cost the other configs' measurements in an
+            # --config all run; single-config runs re-raise for an honest rc
+            if len(keys) == 1:
+                raise
+            _log(f"config {k} failed, continuing: "
+                 f"{type(e).__name__}: {e}"[:300])
+            failed.append(k)
+            continue
         if platform:
             import jax
 
             out["backend"] = platform if platform != "cpu" \
                 else jax.default_backend()
         print(json.dumps(out), flush=True)
+    if failed:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -7,8 +7,6 @@ against the streaming path it replaces dispatch-for-dispatch."""
 import numpy as np
 import pytest
 
-import jax
-
 from orange3_spark_tpu.io.streaming import (
     StreamingKMeans,
     StreamingLinearEstimator,
