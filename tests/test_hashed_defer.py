@@ -123,6 +123,32 @@ def test_defer_falls_back_with_checkpointer(session, data, tmp_path):
     _assert_identical(base, deferred)
 
 
+def test_defer_value_weighted_parity(session):
+    """The sparse value-weighted mode (libsvm fixed-nnz layout, label in
+    chunk) rides the same ingest/replay machinery — defer must be
+    bit-identical there too."""
+    rng = np.random.default_rng(9)
+    n, nnz, d = 2048, 6, 200
+    idx = np.stack([np.sort(rng.choice(d, nnz, replace=False))
+                    for _ in range(n)]).astype(np.float32)
+    val = rng.normal(1.0, 0.5, (n, nnz)).astype(np.float32)
+    y = rng.integers(0, 2, n).astype(np.float32)
+    chunks = np.concatenate([y[:, None], idx, val], axis=1)
+
+    def src():
+        for s in range(0, n, 512):
+            yield chunks[s:s + 512]
+
+    def fit(defer):
+        est = StreamingHashedLinearEstimator(
+            n_dims=1 << 12, n_dense=0, n_cat=nnz, epochs=4, step_size=0.1,
+            chunk_rows=512, label_in_chunk=True, value_weighted=True,
+            defer_epoch1=defer)
+        return est.fit_stream(src, session=session, cache_device=True)
+
+    _assert_identical(fit(False), fit(True))
+
+
 def test_defer_warm_replay_matches_fit_program(session, data):
     """warm_replay for a defer estimator must pre-compile the EXACT program
     the timed fit dispatches (n_epochs = epochs, init-state provenance, no

@@ -122,8 +122,10 @@ def bank(name: str, lines: list, attempt: int, partial: bool) -> int:
     with open(OUT, "a") as f:
         for ln in lines:
             d = json.loads(ln)
-            if canon(d) in seen:
+            c = canon(d)
+            if c in seen:
                 continue
+            seen.add(c)     # also dedupe within this batch
             d["capture_step"] = name
             d["capture_attempt"] = attempt
             if partial:
