@@ -107,9 +107,13 @@ class HashedLinearParams(Params):
     # DMA, and (b) no per-chunk step program ever executes before the
     # fused scan — the round-4 UNAVAILABLE device fault's observed
     # precondition (see tools/replay_fault_diag.py). Requires
-    # cache_device and no checkpointer/resume (per-step checkpoint
-    # granularity needs per-chunk dispatches by definition); fit_stream
-    # silently falls back to the default schedule when those don't hold.
+    # cache_device. Checkpointing composes ONLY with
+    # replay_granularity='epoch' (snapshots land at epoch boundaries
+    # between the per-epoch replay dispatches; resume re-ingests the
+    # cache step-free and fast-forwards checkpointed epochs — see
+    # tests/test_hashed_defer.py kill-and-resume); with granularity
+    # 'all' a checkpointered fit silently keeps the default schedule,
+    # whose per-chunk dispatches give step-granular snapshots.
     defer_epoch1: bool = False
     # value-weighted sparse rows (MLlib SparseVector semantics): chunks
     # carry n_cat (index, value) PAIRS — [label?, idx..., val...] — and the
@@ -696,10 +700,13 @@ class StreamingHashedLinearEstimator(Estimator):
         in the timed run (bench.py does).
 
         The warmed program mirrors ``defer_epoch1`` as configured on the
-        params; the subsequent fit must use the SAME effective schedule —
-        warming a defer estimator and then fitting with a checkpointer (or
-        without cache_device), where fit_stream silently falls back to the
-        default schedule, warms a program that fit never dispatches."""
+        params; the subsequent fit must use the SAME effective schedule.
+        With ``replay_granularity='epoch'`` a checkpointered defer fit
+        keeps the fused schedule (epoch-boundary snapshots), so warming it
+        is correct; with granularity 'all' a checkpointered fit silently
+        falls back to the default schedule (as does any fit without
+        cache_device), and the warm would compile a program that fit
+        never dispatches."""
         p = self.params
         session = session or TpuSession.active()
         if not (p.fused_replay and (p.epochs > 1 or p.defer_epoch1)
@@ -905,12 +912,22 @@ class StreamingHashedLinearEstimator(Estimator):
         # cache/spill/stream afterwards. Bit-identical step sequence; the
         # epoch loop below runs one extra iteration to compensate for the
         # step-free pass 0. Falls back silently when its preconditions
-        # (cache, no resume granularity) don't hold. Computed up here
-        # because a defer fit has replay passes even at epochs == 1, so
-        # the spill/overflow gates below must read `epochs > 1 or defer`.
+        # don't hold. Computed up here because a defer fit has replay
+        # passes even at epochs == 1, so the spill/overflow gates below
+        # must read `epochs > 1 or defer`.
+        #
+        # Checkpointing: per-STEP snapshots need per-chunk dispatches, so a
+        # checkpointered fit normally keeps the interleaved schedule — but
+        # with replay_granularity='epoch' the replay is one dispatch PER
+        # EPOCH, which gives a natural epoch-boundary snapshot cadence:
+        # defer + checkpointer compose there (resume re-ingests the cache
+        # step-free, fast-forwards whole checkpointed epochs, and resumes
+        # dispatching — bit-identical, pinned by the kill-and-resume test).
+        ckpt_epoch_ok = p.replay_granularity == "epoch"
         defer = (
             p.defer_epoch1 and cache_device and p.epochs > 0
-            and checkpointer is None and resume_from == 0
+            and (checkpointer is None or ckpt_epoch_ok)
+            and (resume_from == 0 or ckpt_epoch_ok)
         )
         spill: DiskChunkCache | None = None
         spill_active = [False]      # toggled by the epoch loop; read by
@@ -952,7 +969,10 @@ class StreamingHashedLinearEstimator(Estimator):
         # past half the budget it falls back to the per-chunk loop.
         fuse_replay = (
             p.fused_replay and cache_device and p.epochs > 1
-            and checkpointer is None and resume_from == 0
+            and ((checkpointer is None and resume_from == 0)
+                 # per-epoch dispatches snapshot/resume at epoch
+                 # boundaries — fusion stays available (see defer above)
+                 or ckpt_epoch_ok)
         )
         if defer:
             # a defer fit fuses even at epochs == 1 (the single training
@@ -1132,7 +1152,14 @@ class StreamingHashedLinearEstimator(Estimator):
                 epoch_walls.append(time.perf_counter() - t_epoch)
             if (epoch == 0 and fuse_replay and cache.enabled
                     and cache.batches
-                    and 2 * cache.nbytes <= cache_device_bytes):
+                    and 2 * cache.nbytes <= cache_device_bytes
+                    # epoch-granular resume can only fast-forward WHOLE
+                    # epochs; a snapshot written off an epoch boundary
+                    # (e.g. by a per-chunk phase of an earlier run whose
+                    # fusion gate differed) must take the per-chunk replay
+                    # below, which skips at step grain — entering the
+                    # fused path would re-apply the partial epoch's steps
+                    and resume_from % len(cache.batches) == 0):
                 # remaining epochs in one program: stack the cache (HBM->
                 # HBM copy; the per-chunk list stays live for evaluate_device
                 # / bench probes) and scan
@@ -1142,31 +1169,57 @@ class StreamingHashedLinearEstimator(Estimator):
                     for i in range(4)
                 )
                 n_rep = p.epochs - 1 + (1 if defer else 0)
+                spe = len(cache.batches)          # steps per replay epoch
                 if p.replay_granularity == "epoch":
                     # one n_epochs=1 scan dispatch per epoch over the same
                     # stack — the tunnel-fragility middle ground (see the
                     # Params docstring); sync every 2 dispatches like the
-                    # grouped disk replay (each pins the full stack)
+                    # grouped disk replay (each pins the full stack).
+                    # Epoch boundaries are the snapshot/resume grain:
+                    # checkpoints land every ~every_steps steps rounded to
+                    # whole epochs, and a resumed fit fast-forwards the
+                    # epochs its snapshot already covers without
+                    # dispatching them.
+                    save_every = (max(1, checkpointer.every_steps // spe)
+                                  if checkpointer is not None else 0)
+                    n_dispatched = 0
                     for rep in range(n_rep):
+                        if n_steps + spe <= resume_from:
+                            n_steps += spe    # checkpointed epoch: skip
+                            continue
                         theta, opt_state, chunk_losses = \
                             _hashed_replay_epochs(
                                 theta, opt_state, *stacks, salts, reg, lr,
                                 n_epochs=1, **static_kw,
                             )
+                        n_steps += spe
                         last_loss = chunk_losses[-1, -1]
-                        bound_dispatch(rep + 1, last_loss, period=2)
+                        n_dispatched += 1
+                        bound_dispatch(n_dispatched, last_loss, period=2)
+                        if save_every and (rep + 1) % save_every == 0:
+                            checkpointer.save(
+                                n_steps,
+                                {"theta": theta, "opt_state": opt_state},
+                                meta=ckpt_meta,
+                            )
                 else:
                     theta, opt_state, chunk_losses = _hashed_replay_epochs(
                         theta, opt_state, *stacks, salts, reg, lr,
                         n_epochs=n_rep, **static_kw,
                     )
                     last_loss = chunk_losses[-1, -1]
+                    n_steps += n_rep * spe
+                    n_dispatched = 1
                 del stacks
-                n_steps += n_rep * len(cache.batches)
-                jax.block_until_ready(last_loss)
-                replay_fused_s = time.perf_counter() - t_rep
-                if stage_times is not None:
-                    epoch_walls.append(replay_fused_s)
+                if n_dispatched:
+                    jax.block_until_ready(last_loss)
+                    replay_fused_s = time.perf_counter() - t_rep
+                    if stage_times is not None:
+                        epoch_walls.append(replay_fused_s)
+                # else: the snapshot already covered every replay epoch —
+                # nothing dispatched, so no replay wall to record (the
+                # model is complete; final_loss_ stays None for this
+                # resume-at-completion edge)
                 break
 
         if spill is not None:
