@@ -181,9 +181,18 @@ class StreamingLinearParams(Params):
     # becomes pure ingest and the replay carries ALL ``epochs`` passes —
     # identical step sequence, bit-identical results, but zero step
     # dispatches before the fused scan and none interleaved with ingest
-    # (each costs ~an RTT on tunneled hosts). Needs cache_device and no
-    # checkpointer/resume; silently falls back otherwise.
+    # (each costs ~an RTT on tunneled hosts). Needs cache_device.
+    # Checkpointing composes only with replay_granularity='epoch'
+    # (epoch-boundary snapshots between the per-epoch dispatches, same
+    # contract as the hashed estimator); otherwise a checkpointered fit
+    # silently keeps the default schedule.
     defer_epoch1: bool = False
+    # 'all': every replay pass in ONE scan dispatch (cheapest; fragile on
+    # the round-4 tunnel, see models/hashed_linear.py). 'epoch': one
+    # n_epochs=1 scan dispatch per pass — a dispatch per epoch instead of
+    # per chunk, the granularity that has never faulted on hardware, and
+    # the one that admits epoch-boundary checkpointing.
+    replay_granularity: str = "all"   # 'all' | 'epoch'
 
 
 class _DeviceCache:
@@ -417,6 +426,10 @@ class StreamingKMeansParams(Params):
     # no pre-seed batches (any normal stream whose first chunk has a live
     # row) are bit-identical.
     defer_epoch1: bool = False
+    # 'all': every replay pass in ONE scan dispatch; 'epoch': one
+    # n_epochs=1 dispatch per pass (the hardware-robust granularity — see
+    # StreamingLinearParams.replay_granularity).
+    replay_granularity: str = "all"   # 'all' | 'epoch'
 
 
 @partial(jax.jit, static_argnames=("loss_kind", "n_epochs"),
@@ -444,6 +457,38 @@ def _stream_replay_epochs(theta, opt_state, Xs, ys, ws, reg, lr, *,
         epoch, (theta, opt_state), None, length=n_epochs
     )
     return theta, opt_state, losses
+
+
+def run_epoch_replay(n_replay, spe, n_steps, resume_from, checkpointer,
+                     dispatch_one, snapshot, ckpt_meta):
+    """The per-epoch replay protocol shared by the streaming estimators
+    (linear, hashed, kmeans): fast-forward whole checkpointed epochs
+    without dispatching them, dispatch one n_epochs=1 scan per remaining
+    epoch, bound the in-flight dispatch queue (each dispatch pins the full
+    chunk stack, so period=2 keeps one executing + one queued), and
+    snapshot at epoch boundaries every ~``checkpointer.every_steps`` steps
+    rounded to whole epochs. ONE implementation so the three estimators'
+    checkpoint/resume semantics cannot drift.
+
+    ``dispatch_one()`` runs one epoch and returns the value to block on;
+    ``snapshot()`` returns the state dict to checkpoint. Returns
+    ``(n_steps, last, n_dispatched)`` — ``last`` is None when every epoch
+    was fast-forwarded (resume-at-completion)."""
+    save_every = (max(1, checkpointer.every_steps // spe)
+                  if checkpointer is not None else 0)
+    last = None
+    n_disp = 0
+    for rep in range(n_replay):
+        if n_steps + spe <= resume_from:
+            n_steps += spe          # checkpointed epoch: skip, no dispatch
+            continue
+        last = dispatch_one()
+        n_steps += spe
+        n_disp += 1
+        bound_dispatch(n_disp, last, period=2)
+        if save_every and (rep + 1) % save_every == 0:
+            checkpointer.save(n_steps, snapshot(), meta=ckpt_meta)
+    return n_steps, last, n_disp
 
 
 @partial(jax.jit, static_argnames=("k", "n_epochs"), donate_argnums=(0, 1))
@@ -620,16 +665,32 @@ class StreamingKMeans(Estimator):
             if (epoch == 0 and n_replay > 0 and cache.enabled
                     and cache.batches and centers is not None
                     and 2 * cache.nbytes <= cache_device_bytes):
-                # remaining update passes in ONE dispatch — same transient
-                # stack + half-budget rule as the other streaming
-                # estimators' fused replay
+                # remaining update passes as scan program(s) — same
+                # transient stack + half-budget rule as the other
+                # streaming estimators' fused replay
+                spe = len(cache.batches)
                 Xs = jnp.stack([b[0] for b in cache.batches])
                 ws = jnp.stack([b[1] for b in cache.batches])
-                centers, counts, _costs = _kmeans_replay_epochs(
-                    centers, counts, Xs, ws, decay, k=p.k, n_epochs=n_replay,
-                )
+                if p.replay_granularity == "epoch":
+                    def _disp_km():
+                        nonlocal centers, counts
+                        centers, counts, _c = _kmeans_replay_epochs(
+                            centers, counts, Xs, ws, decay, k=p.k,
+                            n_epochs=1,
+                        )
+                        return centers
+
+                    n_steps, _, _ = run_epoch_replay(
+                        n_replay, spe, n_steps, 0, None, _disp_km,
+                        None, None,
+                    )
+                else:
+                    centers, counts, _costs = _kmeans_replay_epochs(
+                        centers, counts, Xs, ws, decay, k=p.k,
+                        n_epochs=n_replay,
+                    )
+                    n_steps += n_replay * spe
                 del Xs, ws
-                n_steps += n_replay * len(cache.batches)
                 break
         if spill is not None:
             spill.delete()
@@ -726,9 +787,13 @@ class StreamingLinearEstimator(Estimator):
         last_loss = None
         # defer-epoch-1 (see StreamingLinearParams.defer_epoch1): pass 0 is
         # ingest-only and the loop below runs one extra iteration so the
-        # replay carries all p.epochs training passes
+        # replay carries all p.epochs training passes. Checkpointing
+        # composes only at epoch granularity (same contract and resume
+        # semantics as models/hashed_linear.py fit_stream).
+        ckpt_epoch_ok = p.replay_granularity == "epoch"
         defer = (p.defer_epoch1 and cache_device and p.epochs > 0
-                 and checkpointer is None and resume_from == 0)
+                 and (checkpointer is None or ckpt_epoch_ok)
+                 and (resume_from == 0 or ckpt_epoch_ok))
         n_replay = p.epochs - 1 + (1 if defer else 0)
         cache = _DeviceCache(cache_device and (p.epochs > 1 or defer),
                              cache_device_bytes)
@@ -784,10 +849,15 @@ class StreamingLinearEstimator(Estimator):
                 continue
             for X_np, y_np, w_np in _rechunk(source(), pad_rows):
                 if n_steps < resume_from and not (
-                        epoch == 0 and (cache.enabled or spill is not None)):
+                        epoch == 0 and (cache.enabled or spill is not None
+                                        or defer)):
                     # checkpoint fast-forward BEFORE any pad/DMA work —
                     # except while building the cache/spill, whose batches
-                    # must be retained even when their step is skipped
+                    # must be retained even when their step is skipped,
+                    # and except a defer ingest pass: it contributes ZERO
+                    # steps, so counting its chunks here would corrupt the
+                    # resume offset (even after a mid-ingest cache
+                    # overflow, when cache.enabled has flipped off)
                     n_steps += 1
                     continue
                 # every device batch is EXACTLY pad_rows tall (last one padded
@@ -824,23 +894,57 @@ class StreamingLinearEstimator(Estimator):
                     if not use_disk:
                         warn_cache_overflow(cache_device_bytes, n_replay)
             if (epoch == 0 and n_replay > 0 and cache.enabled
-                    and cache.batches and checkpointer is None
-                    and 2 * cache.nbytes <= cache_device_bytes):
-                # remaining epochs in ONE dispatch (the transient batch
-                # stack is a second device copy — same half-budget rule as
-                # the hashed estimator); checkpointed fits keep the
-                # per-batch loop for step-granular snapshots
+                    and cache.batches
+                    and ((checkpointer is None and resume_from == 0)
+                         or ckpt_epoch_ok)
+                    and 2 * cache.nbytes <= cache_device_bytes
+                    # off-boundary snapshots (written by a run whose
+                    # fusion gate differed) resume via the per-batch
+                    # replay, which skips at step grain
+                    and resume_from % len(cache.batches) == 0):
+                # remaining epochs as scan program(s): ONE dispatch with
+                # granularity 'all', one per epoch with 'epoch' (the
+                # transient batch stack is a second device copy — same
+                # half-budget rule as the hashed estimator). Per-step
+                # checkpointered fits keep the per-batch loop for
+                # step-granular snapshots; 'epoch' fits snapshot at epoch
+                # boundaries between dispatches (run_epoch_replay).
+                spe = len(cache.batches)
+                if n_steps + n_replay * spe <= resume_from:
+                    # the snapshot already covers every replay epoch —
+                    # don't build the (potentially GBs) transient stack
+                    # just to skip it
+                    n_steps += n_replay * spe
+                    break
                 stacks = tuple(
                     jnp.stack([b[i] for b in cache.batches])
                     for i in range(3)
                 )
-                theta, opt_state, losses = _stream_replay_epochs(
-                    theta, opt_state, *stacks, reg, lr,
-                    loss_kind=p.loss, n_epochs=n_replay,
-                )
+                if p.replay_granularity == "epoch":
+                    def _disp_lin():
+                        nonlocal theta, opt_state
+                        theta, opt_state, losses = _stream_replay_epochs(
+                            theta, opt_state, *stacks, reg, lr,
+                            loss_kind=p.loss, n_epochs=1,
+                        )
+                        return losses[-1, -1]
+
+                    n_steps, last, _ = run_epoch_replay(
+                        n_replay, spe, n_steps, resume_from, checkpointer,
+                        _disp_lin,
+                        lambda: {"theta": theta, "opt_state": opt_state},
+                        ckpt_meta,
+                    )
+                    if last is not None:
+                        last_loss = last
+                else:
+                    theta, opt_state, losses = _stream_replay_epochs(
+                        theta, opt_state, *stacks, reg, lr,
+                        loss_kind=p.loss, n_epochs=n_replay,
+                    )
+                    n_steps += n_replay * spe
+                    last_loss = losses[-1, -1]
                 del stacks
-                n_steps += n_replay * len(cache.batches)
-                last_loss = losses[-1, -1]
                 break
         if spill is not None:
             spill.delete()

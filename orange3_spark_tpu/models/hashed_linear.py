@@ -1163,45 +1163,48 @@ class StreamingHashedLinearEstimator(Estimator):
                 # remaining epochs in one program: stack the cache (HBM->
                 # HBM copy; the per-chunk list stays live for evaluate_device
                 # / bench probes) and scan
+                n_rep = p.epochs - 1 + (1 if defer else 0)
+                spe = len(cache.batches)          # steps per replay epoch
+                if n_steps + n_rep * spe <= resume_from:
+                    # snapshot already covers every replay epoch: skip
+                    # without building the (second-HBM-copy) stack; the
+                    # model is complete, final_loss_ stays None, and no
+                    # replay wall is recorded for this
+                    # resume-at-completion edge
+                    n_steps += n_rep * spe
+                    break
                 t_rep = time.perf_counter()
                 stacks = tuple(
                     jnp.stack([c[i] for c in cache.batches])
                     for i in range(4)
                 )
-                n_rep = p.epochs - 1 + (1 if defer else 0)
-                spe = len(cache.batches)          # steps per replay epoch
                 if p.replay_granularity == "epoch":
                     # one n_epochs=1 scan dispatch per epoch over the same
                     # stack — the tunnel-fragility middle ground (see the
-                    # Params docstring); sync every 2 dispatches like the
-                    # grouped disk replay (each pins the full stack).
-                    # Epoch boundaries are the snapshot/resume grain:
-                    # checkpoints land every ~every_steps steps rounded to
-                    # whole epochs, and a resumed fit fast-forwards the
-                    # epochs its snapshot already covers without
-                    # dispatching them.
-                    save_every = (max(1, checkpointer.every_steps // spe)
-                                  if checkpointer is not None else 0)
-                    n_dispatched = 0
-                    for rep in range(n_rep):
-                        if n_steps + spe <= resume_from:
-                            n_steps += spe    # checkpointed epoch: skip
-                            continue
+                    # Params docstring). Epoch boundaries are the
+                    # snapshot/resume grain; the skip/save protocol is the
+                    # shared run_epoch_replay.
+                    from orange3_spark_tpu.io.streaming import (
+                        run_epoch_replay,
+                    )
+
+                    def _disp():
+                        nonlocal theta, opt_state
                         theta, opt_state, chunk_losses = \
                             _hashed_replay_epochs(
                                 theta, opt_state, *stacks, salts, reg, lr,
                                 n_epochs=1, **static_kw,
                             )
-                        n_steps += spe
-                        last_loss = chunk_losses[-1, -1]
-                        n_dispatched += 1
-                        bound_dispatch(n_dispatched, last_loss, period=2)
-                        if save_every and (rep + 1) % save_every == 0:
-                            checkpointer.save(
-                                n_steps,
-                                {"theta": theta, "opt_state": opt_state},
-                                meta=ckpt_meta,
-                            )
+                        return chunk_losses[-1, -1]
+
+                    n_steps, last, _ = run_epoch_replay(
+                        n_rep, spe, n_steps, resume_from, checkpointer,
+                        _disp,
+                        lambda: {"theta": theta, "opt_state": opt_state},
+                        ckpt_meta,
+                    )
+                    if last is not None:
+                        last_loss = last
                 else:
                     theta, opt_state, chunk_losses = _hashed_replay_epochs(
                         theta, opt_state, *stacks, salts, reg, lr,
@@ -1209,17 +1212,11 @@ class StreamingHashedLinearEstimator(Estimator):
                     )
                     last_loss = chunk_losses[-1, -1]
                     n_steps += n_rep * spe
-                    n_dispatched = 1
                 del stacks
-                if n_dispatched:
-                    jax.block_until_ready(last_loss)
-                    replay_fused_s = time.perf_counter() - t_rep
-                    if stage_times is not None:
-                        epoch_walls.append(replay_fused_s)
-                # else: the snapshot already covered every replay epoch —
-                # nothing dispatched, so no replay wall to record (the
-                # model is complete; final_loss_ stays None for this
-                # resume-at-completion edge)
+                jax.block_until_ready(last_loss)
+                replay_fused_s = time.perf_counter() - t_rep
+                if stage_times is not None:
+                    epoch_walls.append(replay_fused_s)
                 break
 
         if spill is not None:

@@ -79,6 +79,80 @@ def test_linear_defer_falls_back_with_checkpointer(session, data, tmp_path):
     _assert_lin_identical(base, deferred)
 
 
+def test_linear_epoch_granularity_parity(session, data):
+    base = _fit_lin(_lin(), data, session, cache_device=True)
+    ep = _fit_lin(_lin(replay_granularity="epoch", defer_epoch1=True),
+                  data, session, cache_device=True)
+    _assert_lin_identical(base, ep)
+
+
+def test_linear_defer_epoch_ckpt_kill_and_resume(session, data, tmp_path):
+    """Same composition as the hashed estimator: defer + 'epoch'
+    granularity + checkpointer snapshots at epoch boundaries; a killed fit
+    resumes bit-identical."""
+    kw = dict(replay_granularity="epoch", defer_epoch1=True, epochs=4)
+    ref = _fit_lin(_lin(**kw), data, session, cache_device=True)
+
+    ckpt_path = str(tmp_path / "lin.ckpt")
+
+    class Killer(StreamCheckpointer):
+        saves = 0
+
+        def save(self, step, state, meta=None):
+            super().save(step, state, meta)
+            Killer.saves += 1
+            if Killer.saves >= 2:
+                raise RuntimeError("injected")
+
+    with pytest.raises(RuntimeError, match="injected"):
+        _fit_lin(_lin(**kw), data, session, cache_device=True,
+                 checkpointer=Killer(ckpt_path, every_steps=8))
+    ck = StreamCheckpointer(ckpt_path, every_steps=8)
+    step, state = ck.load()
+    assert state is not None and step % 8 == 0   # 8 batches/epoch
+    resumed = _fit_lin(_lin(**kw), data, session, cache_device=True,
+                       checkpointer=ck)
+    _assert_lin_identical(ref, resumed)
+
+
+def test_linear_defer_ckpt_resume_with_cache_overflow(session, data,
+                                                      tmp_path):
+    """Resume of a defer+'epoch'+checkpointer fit whose device cache
+    OVERFLOWS mid-ingest (no spill dir): the ingest pass contributes zero
+    steps, so the resume offset must not count its chunks even after
+    cache.enabled flips off mid-pass — a phantom offset here silently
+    trained the wrong step subset before the guard existed."""
+    import warnings
+
+    kw = dict(replay_granularity="epoch", defer_epoch1=True, epochs=4)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ref = _fit_lin(_lin(**kw), data, session, cache_device=True,
+                       cache_device_bytes=1 << 14)
+
+        ckpt_path = str(tmp_path / "ovf.ckpt")
+
+        class Killer(StreamCheckpointer):
+            saves = 0
+
+            def save(self, step, state, meta=None):
+                super().save(step, state, meta)
+                Killer.saves += 1
+                if Killer.saves >= 2:
+                    raise RuntimeError("injected")
+
+        with pytest.raises(RuntimeError, match="injected"):
+            _fit_lin(_lin(**kw), data, session, cache_device=True,
+                     cache_device_bytes=1 << 14,
+                     checkpointer=Killer(ckpt_path, every_steps=5))
+        ck = StreamCheckpointer(ckpt_path, every_steps=5)
+        step, state = ck.load()
+        assert state is not None and step > 0
+        resumed = _fit_lin(_lin(**kw), data, session, cache_device=True,
+                           cache_device_bytes=1 << 14, checkpointer=ck)
+    _assert_lin_identical(ref, resumed)
+
+
 # ---------------------------------------------------------------- kmeans
 
 def _km(**kw):
@@ -119,6 +193,15 @@ def test_kmeans_defer_matches_default(session, km_data):
     np.testing.assert_array_equal(np.asarray(base.centers),
                                   np.asarray(deferred.centers))
     assert base.n_iter_ == deferred.n_iter_
+
+
+def test_kmeans_epoch_granularity_parity(session, km_data):
+    base = _fit_km(_km(), km_data, session, cache_device=True)
+    ep = _fit_km(_km(replay_granularity="epoch", defer_epoch1=True),
+                 km_data, session, cache_device=True)
+    np.testing.assert_array_equal(np.asarray(base.centers),
+                                  np.asarray(ep.centers))
+    assert base.n_iter_ == ep.n_iter_
 
 
 def test_kmeans_defer_disk_spill_parity(session, km_data, tmp_path):
