@@ -44,6 +44,13 @@ def main() -> None:
     est = StreamingHashedLinearEstimator(
         n_dims=1 << 18, n_dense=N_DENSE, n_cat=N_CAT, epochs=8,
         chunk_rows=1 << 15, label_in_chunk=True, step_size=0.05,
+        # defer_epoch1: the streaming pass is pure ingest and ALL epochs
+        # train inside the fused replay program — bit-identical to the
+        # interleaved schedule, but zero per-chunk step dispatches (each
+        # costs ~an RTT on tunneled hosts). replay_granularity='epoch'
+        # (one dispatch per epoch) additionally composes with a
+        # StreamCheckpointer for kill-and-resume at epoch boundaries.
+        defer_epoch1=True,
     )
     model = est.fit_stream(
         csv_raw_chunk_source(path, chunk_rows=1 << 15),

@@ -459,6 +459,17 @@ def _stream_replay_epochs(theta, opt_state, Xs, ys, ws, reg, lr, *,
     return theta, opt_state, losses
 
 
+def check_replay_granularity(value: str) -> None:
+    """Reject typo'd enum values at fit entry: every granularity
+    comparison is an exact string match, so 'epochs'/'Epoch' would
+    silently behave as 'all' AND silently disable the defer+checkpointer
+    composition the caller asked for."""
+    if value not in ("all", "epoch"):
+        raise ValueError(
+            f"replay_granularity must be 'all' or 'epoch', got {value!r}"
+        )
+
+
 def run_epoch_replay(n_replay, spe, n_steps, resume_from, checkpointer,
                      dispatch_one, snapshot, ckpt_meta):
     """The per-epoch replay protocol shared by the streaming estimators
@@ -567,6 +578,7 @@ class StreamingKMeans(Estimator):
         from orange3_spark_tpu.models.kmeans import KMeansModel, KMeansParams
 
         p = self.params
+        check_replay_granularity(p.replay_granularity)
         session = session or TpuSession.active()
         pad_rows = session.pad_rows(p.chunk_rows)
         row_sh = session.row_sharding
@@ -747,6 +759,7 @@ class StreamingLinearEstimator(Estimator):
         replay padded records off the epoch-1 disk spill (read + DMA, no
         re-parse); without it, every epoch re-runs the source, loudly."""
         p = self.params
+        check_replay_granularity(p.replay_granularity)
         session = session or TpuSession.active()
         if p.loss == "logistic":
             if class_values is not None:

@@ -796,10 +796,12 @@ class StreamingHashedLinearEstimator(Estimator):
           and 'replay_fused_s' carries that second number explicitly.
         """
         from orange3_spark_tpu.io.streaming import (
-            DiskChunkCache, _pad_chunk, _rechunk, warn_cache_overflow,
+            DiskChunkCache, _pad_chunk, _rechunk, check_replay_granularity,
+            warn_cache_overflow,
         )
 
         p = self.params
+        check_replay_granularity(p.replay_granularity)
         session = session or TpuSession.active()
         k = _effective_k(p)
         n_cols = _chunk_cols(p)

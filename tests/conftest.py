@@ -54,3 +54,22 @@ def iris(session):
     from orange3_spark_tpu.datasets import load_iris
 
     return load_iris(session)
+
+
+def make_killing_checkpointer(path: str, every_steps: int, die_after: int):
+    """Fault-injecting StreamCheckpointer for kill-and-resume drills: dies
+    right AFTER the ``die_after``-th snapshot lands — the nastiest resume
+    point (state on disk, process gone). Raising after ``super().save`` is
+    load-bearing: the resume test must find that snapshot on disk."""
+    from orange3_spark_tpu.utils.fault import StreamCheckpointer
+
+    class Killer(StreamCheckpointer):
+        saves = 0
+
+        def save(self, step, state, meta=None):
+            super().save(step, state, meta)
+            Killer.saves += 1
+            if Killer.saves >= die_after:
+                raise RuntimeError("injected fault")
+
+    return Killer(path, every_steps=every_steps)

@@ -156,6 +156,8 @@ def test_defer_epoch_ckpt_kill_and_resume_bit_identical(session, data,
     killed fit resumed from its snapshot re-ingests the cache step-free,
     fast-forwards the checkpointed epochs, and finishes bit-identical to an
     uninterrupted run."""
+    from tests.conftest import make_killing_checkpointer
+
     Xall, y = data
     src = array_chunk_source(Xall, y, chunk_rows=1024)
     kw = dict(epochs=6, replay_granularity="epoch", defer_epoch1=True)
@@ -163,25 +165,9 @@ def test_defer_epoch_ckpt_kill_and_resume_bit_identical(session, data,
     ref = _est(**kw).fit_stream(src, session=session, cache_device=True)
 
     ckpt_path = str(tmp_path / "defer.ckpt")
-
-    class KillingCheckpointer(StreamCheckpointer):
-        """Dies right AFTER the Nth snapshot lands — the nastiest resume
-        point (state on disk, process gone)."""
-
-        def __init__(self, path, every_steps, die_after):
-            super().__init__(path, every_steps=every_steps)
-            self.die_after = die_after
-            self.saves = 0
-
-        def save(self, step, state, meta=None):
-            super().save(step, state, meta)
-            self.saves += 1
-            if self.saves >= self.die_after:
-                raise RuntimeError("injected fault after snapshot")
-
     # every_steps=4 with 4 train chunks/epoch -> snapshot every epoch;
     # die right after the 3rd (mid-replay)
-    killer = KillingCheckpointer(ckpt_path, every_steps=4, die_after=3)
+    killer = make_killing_checkpointer(ckpt_path, every_steps=4, die_after=3)
     with pytest.raises(RuntimeError, match="injected fault"):
         _est(**kw).fit_stream(src, session=session, cache_device=True,
                               checkpointer=killer)
@@ -210,30 +196,19 @@ def test_misaligned_resume_takes_per_chunk_replay(session, data, tmp_path):
 
     ref = _est(**kw).fit_stream(src, session=session, cache_device=True)
 
+    from tests.conftest import make_killing_checkpointer
+
     ckpt_path = str(tmp_path / "mis.ckpt")
-
-    class KillAfter(StreamCheckpointer):
-        def __init__(self, path, every_steps, die_after):
-            super().__init__(path, every_steps=every_steps)
-            self.die_after = die_after
-            self.saves = 0
-
-        def save(self, step, state, meta=None):
-            super().save(step, state, meta)
-            self.saves += 1
-            if self.saves >= self.die_after:
-                raise RuntimeError("boom")
-
     # first run: cache too small -> defer's stream-replay fallback, which
     # checkpoints at STEP grain; die at step 10 (4 chunks/epoch -> mid-epoch)
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")   # expected cache-overflow warning
-        with pytest.raises(RuntimeError, match="boom"):
+        with pytest.raises(RuntimeError, match="injected fault"):
             _est(**kw).fit_stream(
                 src, session=session, cache_device=True,
                 cache_device_bytes=1 << 14,
-                checkpointer=KillAfter(ckpt_path, every_steps=5,
-                                       die_after=2))
+                checkpointer=make_killing_checkpointer(
+                    ckpt_path, every_steps=5, die_after=2))
     ck = StreamCheckpointer(ckpt_path, every_steps=5)
     step, state = ck.load()
     assert state is not None and step % 4 != 0    # genuinely misaligned

@@ -90,23 +90,16 @@ def test_linear_defer_epoch_ckpt_kill_and_resume(session, data, tmp_path):
     """Same composition as the hashed estimator: defer + 'epoch'
     granularity + checkpointer snapshots at epoch boundaries; a killed fit
     resumes bit-identical."""
+    from tests.conftest import make_killing_checkpointer
+
     kw = dict(replay_granularity="epoch", defer_epoch1=True, epochs=4)
     ref = _fit_lin(_lin(**kw), data, session, cache_device=True)
 
     ckpt_path = str(tmp_path / "lin.ckpt")
-
-    class Killer(StreamCheckpointer):
-        saves = 0
-
-        def save(self, step, state, meta=None):
-            super().save(step, state, meta)
-            Killer.saves += 1
-            if Killer.saves >= 2:
-                raise RuntimeError("injected")
-
-    with pytest.raises(RuntimeError, match="injected"):
+    with pytest.raises(RuntimeError, match="injected fault"):
         _fit_lin(_lin(**kw), data, session, cache_device=True,
-                 checkpointer=Killer(ckpt_path, every_steps=8))
+                 checkpointer=make_killing_checkpointer(
+                     ckpt_path, every_steps=8, die_after=2))
     ck = StreamCheckpointer(ckpt_path, every_steps=8)
     step, state = ck.load()
     assert state is not None and step % 8 == 0   # 8 batches/epoch
@@ -124,6 +117,8 @@ def test_linear_defer_ckpt_resume_with_cache_overflow(session, data,
     trained the wrong step subset before the guard existed."""
     import warnings
 
+    from tests.conftest import make_killing_checkpointer
+
     kw = dict(replay_granularity="epoch", defer_epoch1=True, epochs=4)
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
@@ -131,20 +126,11 @@ def test_linear_defer_ckpt_resume_with_cache_overflow(session, data,
                        cache_device_bytes=1 << 14)
 
         ckpt_path = str(tmp_path / "ovf.ckpt")
-
-        class Killer(StreamCheckpointer):
-            saves = 0
-
-            def save(self, step, state, meta=None):
-                super().save(step, state, meta)
-                Killer.saves += 1
-                if Killer.saves >= 2:
-                    raise RuntimeError("injected")
-
-        with pytest.raises(RuntimeError, match="injected"):
+        with pytest.raises(RuntimeError, match="injected fault"):
             _fit_lin(_lin(**kw), data, session, cache_device=True,
                      cache_device_bytes=1 << 14,
-                     checkpointer=Killer(ckpt_path, every_steps=5))
+                     checkpointer=make_killing_checkpointer(
+                         ckpt_path, every_steps=5, die_after=2))
         ck = StreamCheckpointer(ckpt_path, every_steps=5)
         step, state = ck.load()
         assert state is not None and step > 0
