@@ -676,6 +676,17 @@ def main():
     args = ap.parse_args()
     rows = args.rows
     cpu_rows = int(os.environ.get("OTPU_CPU_FALLBACK_ROWS", 2_000_000))
+    # Serialize against any other harness touching the TPU (the capture
+    # watcher's ladder vs the driver's round-end run): two concurrent TPU
+    # processes wedge/fault each other. Taken before the first probe;
+    # no-op inside retry-ladder children (the parent owns the device).
+    from orange3_spark_tpu.utils.devlock import tpu_device_lock
+
+    with tpu_device_lock(name="bench") as lk:
+        _main_locked(args, rows, cpu_rows, lk)
+
+
+def _main_locked(args, rows, cpu_rows, lk):
     if args.config == "criteo":
         # BEFORE the first probe: an open tunnel window must be spent
         # measuring, never generating (pure numpy/pyarrow — cannot wedge
@@ -838,6 +849,12 @@ def main():
         # smaller and honestly labeled, rather than record a 0.0 error line
         _force_cpu_backend()
         platform = "cpu"
+    if platform != "tpu":
+        # committed to a CPU run: free the device lock NOW so a multi-hour
+        # host-only measurement never starves another harness's probe loop
+        # (the capture watcher's whole job is catching tunnel windows that
+        # may open during exactly this stretch)
+        lk.release()
     if platform == "cpu" and args.config == "criteo" and rows > cpu_rows:
         # whether probed-as-cpu or fallen back: the full-scale config on a
         # host CPU is a multi-hour run nobody asked for — cap it (raise

@@ -309,12 +309,19 @@ def bench_taxi_pipeline(scale: float) -> dict:
 
 def main():
     from orange3_spark_tpu.io.native import tune_malloc
+    from orange3_spark_tpu.utils.devlock import tpu_device_lock
 
     tune_malloc()  # dedicated bench process: keep big buffers resident
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="all", choices=["3", "4", "5", "all"])
     ap.add_argument("--rows-scale", type=float, default=1.0)
     args = ap.parse_args()
+    # serialize against any other TPU harness (see utils/devlock.py)
+    with tpu_device_lock(name=f"bench_suite:{args.config}") as lk:
+        _main_locked(args, lk)
+
+
+def _main_locked(args, lk):
     platform = ""
     try:
         from bench import _force_cpu_backend, backend_guard, \
@@ -333,6 +340,14 @@ def main():
             start_stall_watchdog("bench_suite", unit="s")
     except ImportError:  # run from another cwd: skip the fast-fail probe
         pass
+    if platform == "cpu":
+        # committed to a CPU run: free the device lock so a multi-hour
+        # host-only suite never starves another harness (bench.py does
+        # the same — see utils/devlock.py). Gated on an EXPLICIT cpu
+        # commit: the ImportError arm leaves platform "" with the backend
+        # undetermined, and a lock-less run there could still drive the
+        # TPU — keep the lock in that case
+        lk.release()
     benches = {"3": bench_higgs_trees, "4": bench_movielens_als,
                "5": bench_taxi_pipeline}
     keys = ["3", "4", "5"] if args.config == "all" else [args.config]

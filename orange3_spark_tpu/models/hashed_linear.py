@@ -708,6 +708,9 @@ class StreamingHashedLinearEstimator(Estimator):
         cache_device), and the warm would compile a program that fit
         never dispatches."""
         p = self.params
+        from orange3_spark_tpu.io.streaming import check_replay_granularity
+
+        check_replay_granularity(p.replay_granularity)
         session = session or TpuSession.active()
         if not (p.fused_replay and (p.epochs > 1 or p.defer_epoch1)
                 and n_chunks > 0):

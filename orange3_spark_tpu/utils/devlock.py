@@ -1,0 +1,123 @@
+"""Cross-process TPU device lock for the bench/capture harnesses.
+
+Two python processes driving the tunneled TPU concurrently wedge or fault
+each other (observed repeatedly in round 4 — the fused-replay UNAVAILABLE
+fault's flakiest confounder was exactly an overlapping probe). Every
+harness ENTRY POINT (bench.py, bench_suite.py, tools/step_ab.py,
+tools/replay_fault_diag.py) takes this advisory flock before its first
+device touch, so the round-end driver run and the background capture
+watcher serialize instead of colliding: whoever arrives second waits for
+the holder's bounded step instead of destroying both runs. Runs that
+commit to the CPU backend release the lock early (``release()``) so a
+multi-hour CPU fallback never starves another harness's probe loop.
+
+flock, not a pidfile: the lock dies with the holder's fd (a SIGKILLed
+bench never leaves a stale lock). Acquisition polls LOCK_NB every 2 s up
+to a deadline — a poll loop, not a blocking flock, so the timeout needs
+no signals; there is no FIFO fairness between multiple waiters.
+
+Child processes MUST NOT re-acquire (bench.py's retry-ladder rungs re-exec
+bench.py as children while the parent conceptually owns the device) —
+acquisition no-ops when OTPU_CHILD is set, and the flock being
+per-open-file (not per-process-tree) makes the child's skip safe.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import os
+import sys
+import time
+
+LOCK_PATH = "/tmp/otpu_tpu.lock"
+
+
+class TpuDeviceLock:
+    """Exclusive advisory harness lock with idempotent early release."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._fd: int | None = None
+
+    @property
+    def held(self) -> bool:
+        return self._fd is not None
+
+    def acquire(self, *, wait_s: float | None = None,
+                blocking: bool = True) -> bool:
+        """True if acquired (or OTPU_CHILD made it a no-op-success).
+        ``blocking=False`` returns False immediately when contended;
+        blocking mode raises TimeoutError past ``wait_s`` (default:
+        OTPU_LOCK_WAIT_S or 5400) — proceeding lock-less would
+        reintroduce the collision this exists to prevent."""
+        if os.environ.get("OTPU_CHILD"):
+            return True
+        if self._fd is not None:
+            return True
+        if wait_s is None:
+            wait_s = float(os.environ.get("OTPU_LOCK_WAIT_S", "5400"))
+        fd = os.open(LOCK_PATH, os.O_CREAT | os.O_RDWR, 0o666)
+        t0 = time.monotonic()
+        logged = False
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except (BlockingIOError, InterruptedError):
+                if not blocking:
+                    os.close(fd)
+                    return False
+                if not logged:
+                    print(f"[{self.name or 'harness'}] TPU device lock "
+                          f"held by another harness process; waiting (up "
+                          f"to {wait_s:.0f}s) ...",
+                          file=sys.stderr, flush=True)
+                    logged = True
+                if time.monotonic() - t0 > wait_s:
+                    os.close(fd)
+                    raise TimeoutError(
+                        f"TPU device lock {LOCK_PATH} still held after "
+                        f"{wait_s:.0f}s — another harness is wedged? "
+                        "(kill it or raise OTPU_LOCK_WAIT_S)"
+                    )
+                time.sleep(2.0)
+        try:
+            os.ftruncate(fd, 0)
+            os.write(fd, f"{os.getpid()} {self.name}\n".encode())
+        except OSError:
+            pass
+        self._fd = fd
+        return True
+
+    def release(self) -> None:
+        """Idempotent; closing the fd releases the flock."""
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+
+
+@contextlib.contextmanager
+def tpu_device_lock(*, wait_s: float | None = None, name: str = ""):
+    """Hold the lock for the block; yields the TpuDeviceLock so callers
+    that commit to a CPU-only path can ``release()`` early."""
+    lock = TpuDeviceLock(name)
+    lock.acquire(wait_s=wait_s)
+    try:
+        yield lock
+    finally:
+        lock.release()
+
+
+@contextlib.contextmanager
+def try_tpu_device_lock(*, name: str = ""):
+    """Non-blocking variant: yields the lock; ``lock.held`` is False when
+    another harness owns the device (callers should then back off — e.g.
+    the capture watcher defers its probe). Not for OTPU_CHILD processes
+    (``held`` stays False there even though acquire no-op-succeeds)."""
+    lock = TpuDeviceLock(name)
+    lock.acquire(blocking=False)
+    try:
+        yield lock
+    finally:
+        lock.release()

@@ -56,20 +56,27 @@ def iris(session):
     return load_iris(session)
 
 
-def make_killing_checkpointer(path: str, every_steps: int, die_after: int):
-    """Fault-injecting StreamCheckpointer for kill-and-resume drills: dies
-    right AFTER the ``die_after``-th snapshot lands — the nastiest resume
-    point (state on disk, process gone). Raising after ``super().save`` is
-    load-bearing: the resume test must find that snapshot on disk."""
+@pytest.fixture()
+def make_killing_checkpointer():
+    """Factory fixture for kill-and-resume drills: builds a fault-injecting
+    StreamCheckpointer that dies right AFTER the ``die_after``-th snapshot
+    lands — the nastiest resume point (state on disk, process gone).
+    Raising after ``super().save`` is load-bearing: the resume test must
+    find that snapshot on disk. A fixture (not an importable helper) so
+    tests need no `import tests.conftest`, which only resolves when the
+    repo root happens to be on sys.path."""
     from orange3_spark_tpu.utils.fault import StreamCheckpointer
 
-    class Killer(StreamCheckpointer):
-        saves = 0
+    def _make(path: str, every_steps: int, die_after: int):
+        class Killer(StreamCheckpointer):
+            saves = 0
 
-        def save(self, step, state, meta=None):
-            super().save(step, state, meta)
-            Killer.saves += 1
-            if Killer.saves >= die_after:
-                raise RuntimeError("injected fault")
+            def save(self, step, state, meta=None):
+                super().save(step, state, meta)
+                Killer.saves += 1
+                if Killer.saves >= die_after:
+                    raise RuntimeError("injected fault")
 
-    return Killer(path, every_steps=every_steps)
+        return Killer(path, every_steps=every_steps)
+
+    return _make

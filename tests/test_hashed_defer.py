@@ -149,15 +149,13 @@ def test_defer_value_weighted_parity(session):
     _assert_identical(fit(False), fit(True))
 
 
-def test_defer_epoch_ckpt_kill_and_resume_bit_identical(session, data,
-                                                        tmp_path):
+def test_defer_epoch_ckpt_kill_and_resume_bit_identical(
+        session, data, tmp_path, make_killing_checkpointer):
     """defer + replay_granularity='epoch' + checkpointer compose: snapshots
     land at epoch boundaries during the per-epoch replay dispatches, and a
     killed fit resumed from its snapshot re-ingests the cache step-free,
     fast-forwards the checkpointed epochs, and finishes bit-identical to an
     uninterrupted run."""
-    from tests.conftest import make_killing_checkpointer
-
     Xall, y = data
     src = array_chunk_source(Xall, y, chunk_rows=1024)
     kw = dict(epochs=6, replay_granularity="epoch", defer_epoch1=True)
@@ -181,7 +179,8 @@ def test_defer_epoch_ckpt_kill_and_resume_bit_identical(session, data,
     _assert_identical(ref, resumed)
 
 
-def test_misaligned_resume_takes_per_chunk_replay(session, data, tmp_path):
+def test_misaligned_resume_takes_per_chunk_replay(
+        session, data, tmp_path, make_killing_checkpointer):
     """A snapshot written OFF an epoch boundary (here: by the stream-replay
     fallback of a cache-starved first run) must not enter the fused
     epoch-replay path on resume — fast-forwarding whole epochs there would
@@ -195,8 +194,6 @@ def test_misaligned_resume_takes_per_chunk_replay(session, data, tmp_path):
     kw = dict(epochs=4, replay_granularity="epoch", defer_epoch1=True)
 
     ref = _est(**kw).fit_stream(src, session=session, cache_device=True)
-
-    from tests.conftest import make_killing_checkpointer
 
     ckpt_path = str(tmp_path / "mis.ckpt")
     # first run: cache too small -> defer's stream-replay fallback, which

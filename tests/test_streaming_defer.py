@@ -86,12 +86,11 @@ def test_linear_epoch_granularity_parity(session, data):
     _assert_lin_identical(base, ep)
 
 
-def test_linear_defer_epoch_ckpt_kill_and_resume(session, data, tmp_path):
+def test_linear_defer_epoch_ckpt_kill_and_resume(
+        session, data, tmp_path, make_killing_checkpointer):
     """Same composition as the hashed estimator: defer + 'epoch'
     granularity + checkpointer snapshots at epoch boundaries; a killed fit
     resumes bit-identical."""
-    from tests.conftest import make_killing_checkpointer
-
     kw = dict(replay_granularity="epoch", defer_epoch1=True, epochs=4)
     ref = _fit_lin(_lin(**kw), data, session, cache_device=True)
 
@@ -108,16 +107,14 @@ def test_linear_defer_epoch_ckpt_kill_and_resume(session, data, tmp_path):
     _assert_lin_identical(ref, resumed)
 
 
-def test_linear_defer_ckpt_resume_with_cache_overflow(session, data,
-                                                      tmp_path):
+def test_linear_defer_ckpt_resume_with_cache_overflow(
+        session, data, tmp_path, make_killing_checkpointer):
     """Resume of a defer+'epoch'+checkpointer fit whose device cache
     OVERFLOWS mid-ingest (no spill dir): the ingest pass contributes zero
     steps, so the resume offset must not count its chunks even after
     cache.enabled flips off mid-pass — a phantom offset here silently
     trained the wrong step subset before the guard existed."""
     import warnings
-
-    from tests.conftest import make_killing_checkpointer
 
     kw = dict(replay_granularity="epoch", defer_epoch1=True, epochs=4)
     with warnings.catch_warnings():

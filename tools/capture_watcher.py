@@ -25,6 +25,10 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from orange3_spark_tpu.utils.devlock import try_tpu_device_lock  # noqa: E402
+
 STATE = "/tmp/otpu_capture_state.json"
 OUT = os.path.join(REPO, "BENCH_HW_r4.jsonl")
 PROBE_EVERY_S = 150
@@ -83,17 +87,27 @@ def save_state(st: dict) -> None:
 
 def probe() -> bool:
     """True iff the TPU answers AND executes a matmul (this boot the tunnel
-    answered jax.devices() then wedged real work a minute later)."""
-    code = ("import jax, jax.numpy as jnp; d = jax.devices(); "
-            "x = jnp.ones((256, 256)); jax.block_until_ready(x @ x); "
-            "print('OTPU_LIVE', d[0].platform)")
-    try:
-        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                           text=True, timeout=90, cwd=REPO)
-    except subprocess.TimeoutExpired:
-        return False
-    return any(ln.startswith("OTPU_LIVE tpu")
-               for ln in (r.stdout or "").splitlines())
+    answered jax.devices() then wedged real work a minute later).
+
+    Holds the harness device lock for the probe's duration and reports
+    "down" WITHOUT probing when another harness (e.g. the driver's
+    round-end bench) owns the device — a probe poking a busy tunnel is
+    exactly the two-process collision the lock exists to prevent."""
+    with try_tpu_device_lock(name="watcher-probe") as lk:
+        if not lk.held:
+            log("device lock held by another harness; deferring probe")
+            return False
+        code = ("import jax, jax.numpy as jnp; d = jax.devices(); "
+                "x = jnp.ones((256, 256)); jax.block_until_ready(x @ x); "
+                "print('OTPU_LIVE', d[0].platform)")
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True, timeout=90,
+                               cwd=REPO)
+        except subprocess.TimeoutExpired:
+            return False
+        return any(ln.startswith("OTPU_LIVE tpu")
+                   for ln in (r.stdout or "").splitlines())
 
 
 def bank(name: str, lines: list, attempt: int, partial: bool) -> int:
@@ -145,24 +159,49 @@ def run_step(name: str, argv: list, wall_s: int, attempt: int = 0) -> bool:
     # worse) must not read as a stall; the wall timeout bounds the step.
     env.pop("OTPU_STALL_S", None)   # pin the documented 900 s default
     env.update({"OTPU_TUNNEL_WAIT_S": "120", "OTPU_TUNNEL_RETRY_S": "60"})
+    # the step child acquires the device lock itself; bound its wait well
+    # below the wall so lock contention (another harness grabbed the lock
+    # in the probe->step gap) fails FAST and visibly instead of idling
+    # the whole wall away and reading as a wedge
+    env.setdefault("OTPU_LOCK_WAIT_S", str(max(60, int(wall_s / 4))))
     logp = f"/tmp/capture_{name}.log"
     log(f"running {name}: {' '.join(argv)} (wall {wall_s}s, log {logp})")
     t0 = time.time()
     rc: object
     with open(logp, "w") as lf:
+        # new session => own process group, so a wall timeout kills the
+        # WHOLE tree: bench.py's retry-ladder rungs are grandchildren that
+        # would otherwise survive the direct child's death, keep driving
+        # the TPU with the lock already released, and recreate the
+        # two-process collision the lock exists to prevent
+        proc = subprocess.Popen(argv, stdout=subprocess.PIPE, stderr=lf,
+                                text=True, cwd=REPO, env=env,
+                                start_new_session=True)
         try:
-            r = subprocess.run(argv, stdout=subprocess.PIPE, stderr=lf,
-                               text=True, timeout=wall_s, cwd=REPO, env=env)
-            out, rc = r.stdout or "", r.returncode
-        except subprocess.TimeoutExpired as e:
+            out, _ = proc.communicate(timeout=wall_s)
+            rc = proc.returncode
+        except subprocess.TimeoutExpired:
+            import signal
+
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
             # keep whatever the step printed before the wall: multi-line
             # tools (step_ab) flush each measurement as its own complete
             # JSON line precisely so an end-of-run wedge cannot cost the
             # early lines
-            ob = e.stdout or b""
-            out = ob.decode("utf-8", "replace") if isinstance(ob, bytes) \
-                else (ob or "")
+            try:
+                out, _ = proc.communicate(timeout=30)
+            except subprocess.TimeoutExpired as e2:
+                # an escaped descendant can hold the pipe open past the
+                # group kill; the exception still carries what was read —
+                # never discard lines already flushed
+                ob = e2.stdout or ""
+                out = ob.decode("utf-8", "replace") \
+                    if isinstance(ob, bytes) else ob
             rc = "wall-timeout"
+        out = out or ""
     dt = time.time() - t0
     lines = [ln for ln in out.splitlines()
              if ln.startswith("{") and '"metric"' in ln]

@@ -146,6 +146,18 @@ def main() -> None:
     ap.add_argument("--chunk-rows", type=int, default=1 << 18)
     ap.add_argument("--wall-s", type=float, default=420.0)
     args = ap.parse_args()
+
+    # serialize against any other TPU harness for the WHOLE matrix (the
+    # cells are this process's children and take no lock of their own —
+    # see utils/devlock.py)
+    sys.path.insert(0, REPO)
+    from orange3_spark_tpu.utils.devlock import tpu_device_lock
+
+    with tpu_device_lock(name="replay_diag"):
+        _main_locked(args)
+
+
+def _main_locked(args) -> None:
     results = []
     for name, emb, stages in CELLS:
         res = run_cell(name, emb, stages, args.chunk_rows, args.wall_s)

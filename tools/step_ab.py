@@ -30,6 +30,15 @@ def main():
     ap.add_argument("--steps", type=int, default=20)
     args = ap.parse_args()
 
+    # serialize against any other TPU harness (see utils/devlock.py)
+    from orange3_spark_tpu.utils.devlock import tpu_device_lock
+
+    with tpu_device_lock(name="step_ab"):
+        _main_locked(args)
+
+
+def _main_locked(args):
+
     import jax
     import jax.numpy as jnp
     import numpy as np
