@@ -44,6 +44,28 @@ def _assert_lin_identical(a, b):
     assert a.n_steps_ == b.n_steps_
 
 
+def test_replay_granularity_typo_rejected(session, data):
+    """A typo'd granularity must fail loudly at fit entry on every
+    estimator (it would otherwise silently behave as 'all' AND silently
+    disable the defer+checkpointer composition)."""
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+
+    X, y = data
+    with pytest.raises(ValueError, match="replay_granularity"):
+        _fit_lin(_lin(replay_granularity="epochs"), data, session)
+    with pytest.raises(ValueError, match="replay_granularity"):
+        _fit_km(_km(replay_granularity="Epoch"), X, session)
+    est = StreamingHashedLinearEstimator(n_dims=1 << 10, n_dense=4,
+                                         n_cat=6, replay_granularity="EPOCH")
+    with pytest.raises(ValueError, match="replay_granularity"):
+        est.fit_stream(array_chunk_source(X, y, chunk_rows=512),
+                       session=session)
+    with pytest.raises(ValueError, match="replay_granularity"):
+        est.warm_replay(2, session=session)
+
+
 def test_linear_defer_matches_default(session, data):
     base = _fit_lin(_lin(), data, session, cache_device=True)
     deferred = _fit_lin(_lin(defer_epoch1=True), data, session,
