@@ -1,0 +1,107 @@
+"""The cross-process TPU harness lock (utils/devlock.py) — the guard that
+keeps the round-end driver bench and the capture watcher from driving the
+tunneled device concurrently. Tested with a REAL second process holding the
+lock: flock is per-open-file, so a same-process re-acquire would succeed
+and prove nothing."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from orange3_spark_tpu.utils import devlock
+from orange3_spark_tpu.utils.devlock import (
+    TpuDeviceLock,
+    tpu_device_lock,
+    try_tpu_device_lock,
+)
+
+HOLDER_SRC = r"""
+import fcntl, os, sys, time
+fd = os.open(sys.argv[1], os.O_CREAT | os.O_RDWR, 0o666)
+fcntl.flock(fd, fcntl.LOCK_EX)
+print("HELD", flush=True)
+time.sleep(float(sys.argv[2]))
+"""
+
+
+@pytest.fixture()
+def lock_path(tmp_path, monkeypatch):
+    p = str(tmp_path / "dev.lock")
+    monkeypatch.setattr(devlock, "LOCK_PATH", p)
+    return p
+
+
+def _hold_in_subprocess(path: str, seconds: float):
+    proc = subprocess.Popen([sys.executable, "-c", HOLDER_SRC, path,
+                             str(seconds)], stdout=subprocess.PIPE,
+                            text=True)
+    assert proc.stdout.readline().strip() == "HELD"
+    return proc
+
+
+def test_acquire_release_and_holder_metadata(lock_path):
+    with tpu_device_lock(name="t1") as lk:
+        assert lk.held
+        pid, name = open(lock_path).read().split()
+        assert int(pid) == os.getpid() and name == "t1"
+    assert not lk.held
+    # released: a non-blocking acquire now succeeds
+    with try_tpu_device_lock(name="t2") as lk2:
+        assert lk2.held
+
+
+def test_nonblocking_backs_off_while_held(lock_path):
+    proc = _hold_in_subprocess(lock_path, 10.0)
+    try:
+        with try_tpu_device_lock(name="probe") as lk:
+            assert not lk.held
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_blocking_waits_for_holder_exit(lock_path):
+    proc = _hold_in_subprocess(lock_path, 2.0)
+    t0 = time.monotonic()
+    with tpu_device_lock(name="waiter", wait_s=30) as lk:
+        waited = time.monotonic() - t0
+        assert lk.held
+    assert waited >= 1.0, "acquired while the holder still ran"
+    proc.wait()
+
+
+def test_blocking_timeout_raises(lock_path):
+    proc = _hold_in_subprocess(lock_path, 15.0)
+    try:
+        lk = TpuDeviceLock("late")
+        with pytest.raises(TimeoutError, match="still held"):
+            lk.acquire(wait_s=0.5)
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_lock_dies_with_holder(lock_path):
+    """A SIGKILLed holder must leave NO stale lock (the flock releases
+    with the fd) — the property that makes flock safe here at all."""
+    proc = _hold_in_subprocess(lock_path, 60.0)
+    proc.kill()
+    proc.wait()
+    with tpu_device_lock(name="after-kill", wait_s=10) as lk:
+        assert lk.held
+
+
+def test_child_processes_noop(lock_path, monkeypatch):
+    """Retry-ladder children (OTPU_CHILD) skip acquisition — the parent
+    owns the device — even while another process holds the lock."""
+    proc = _hold_in_subprocess(lock_path, 10.0)
+    try:
+        monkeypatch.setenv("OTPU_CHILD", "1")
+        with tpu_device_lock(name="child", wait_s=1) as lk:
+            assert not lk.held     # no fd taken, but no block and no raise
+    finally:
+        proc.kill()
+        proc.wait()
