@@ -26,10 +26,11 @@ def _run(argv, timeout=240):
     env["PYTHONPATH"] = ""
     env["JAX_PLATFORMS"] = "cpu"
     env["OTPU_TUNNEL_WAIT_S"] = "1"
-    # fail fast if another harness holds the real device lock (e.g. the
-    # capture watcher mid-step) instead of eating the whole subprocess
-    # timeout in the lock's 2 s poll loop
-    env["OTPU_LOCK_WAIT_S"] = "5"
+    # bounded lock wait: long enough to sit out a capture-watcher PROBE
+    # (holds the lock ~10-15 s every 150 s — a 5 s wait flaked exactly
+    # there), short enough that a watcher mid-STEP fails this test fast
+    # and diagnosably instead of eating the whole subprocess timeout
+    env["OTPU_LOCK_WAIT_S"] = "60"
     # pin: the 30k-row config must run at full size (no cpu row reduction),
     # whatever the ambient harness environment sets
     env["OTPU_CPU_FALLBACK_ROWS"] = "30000"
