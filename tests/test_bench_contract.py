@@ -17,7 +17,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(argv, timeout=240):
+def _run(argv, timeout=420):
     env = dict(os.environ)
     # CPU-only, fast-fail probe: the contract under test is the fallback
     # path; strip the accelerator plugin so the subprocess cannot wedge
@@ -26,11 +26,13 @@ def _run(argv, timeout=240):
     env["PYTHONPATH"] = ""
     env["JAX_PLATFORMS"] = "cpu"
     env["OTPU_TUNNEL_WAIT_S"] = "1"
-    # bounded lock wait: long enough to sit out a capture-watcher PROBE
-    # (holds the lock ~10-15 s every 150 s — a 5 s wait flaked exactly
-    # there), short enough that a watcher mid-STEP fails this test fast
-    # and diagnosably instead of eating the whole subprocess timeout
-    env["OTPU_LOCK_WAIT_S"] = "60"
+    # bounded lock wait: a capture-watcher PROBE holds the lock up to its
+    # full 90 s subprocess timeout when the tunnel is WEDGED (import jax
+    # hangs), one probe per 150 s cycle — 150 s of waiting therefore
+    # always spans a probe's release, while a watcher mid-STEP (minutes)
+    # still fails this test fast and diagnosably instead of eating the
+    # whole subprocess timeout
+    env["OTPU_LOCK_WAIT_S"] = "150"
     # pin: the 30k-row config must run at full size (no cpu row reduction),
     # whatever the ambient harness environment sets
     env["OTPU_CPU_FALLBACK_ROWS"] = "30000"

@@ -85,29 +85,53 @@ def save_state(st: dict) -> None:
     os.replace(tmp, STATE)
 
 
-def probe() -> bool:
-    """True iff the TPU answers AND executes a matmul (this boot the tunnel
-    answered jax.devices() then wedged real work a minute later).
+def probe() -> str:
+    """'live' | 'down' | 'wedged' | 'busy'.
+
+    'live' iff the TPU answers AND executes a matmul (this boot the tunnel
+    answered jax.devices() then wedged real work a minute later); 'wedged'
+    when the probe subprocess TIMED OUT (the mode where `import jax` hangs
+    at interpreter start) rather than failing fast — the caller backs way
+    off then, because a wedged probe burns its full 90 s holding the
+    device lock and a normal cadence would starve any other harness
+    (observed flaking the bench contract test).
 
     Holds the harness device lock for the probe's duration and reports
-    "down" WITHOUT probing when another harness (e.g. the driver's
+    'busy' WITHOUT probing when another harness (e.g. the driver's
     round-end bench) owns the device — a probe poking a busy tunnel is
-    exactly the two-process collision the lock exists to prevent."""
+    exactly the two-process collision the lock exists to prevent. The
+    probe child runs in its own process group and a timeout kills the
+    GROUP: the wedge spawns tunnel-helper descendants that would
+    otherwise outlive the direct child and keep poking the tunnel
+    lock-less after the lock is released (same reasoning as run_step)."""
     with try_tpu_device_lock(name="watcher-probe") as lk:
         if not lk.held:
             log("device lock held by another harness; deferring probe")
-            return False
+            return "busy"
         code = ("import jax, jax.numpy as jnp; d = jax.devices(); "
                 "x = jnp.ones((256, 256)); jax.block_until_ready(x @ x); "
                 "print('OTPU_LIVE', d[0].platform)")
+        proc = subprocess.Popen([sys.executable, "-c", code],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL, text=True,
+                                cwd=REPO, start_new_session=True)
         try:
-            r = subprocess.run([sys.executable, "-c", code],
-                               capture_output=True, text=True, timeout=90,
-                               cwd=REPO)
+            out, _ = proc.communicate(timeout=90)
         except subprocess.TimeoutExpired:
-            return False
-        return any(ln.startswith("OTPU_LIVE tpu")
-                   for ln in (r.stdout or "").splitlines())
+            import signal
+
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            try:
+                proc.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+            return "wedged"
+        return ("live" if any(ln.startswith("OTPU_LIVE tpu")
+                              for ln in (out or "").splitlines())
+                else "down")
 
 
 def bank(name: str, lines: list, attempt: int, partial: bool) -> int:
@@ -243,10 +267,14 @@ def main() -> None:
         if not pending:
             log("ALL DONE (or attempts exhausted); exiting")
             return
-        if not probe():
-            log(f"tunnel down ({len(pending)} steps pending); "
-                f"sleeping {PROBE_EVERY_S}s")
-            time.sleep(PROBE_EVERY_S)
+        status = probe()
+        if status != "live":
+            # 'wedged' backs off 4x (see probe()); 'busy'/'down' keep the
+            # normal cadence
+            sleep_s = PROBE_EVERY_S * (4 if status == "wedged" else 1)
+            log(f"tunnel {status} ({len(pending)} steps pending); "
+                f"sleeping {sleep_s}s")
+            time.sleep(sleep_s)
             continue
         name, argv, wall_s = pending[0]
         rec = st.setdefault(name, {"attempts": 0, "done": False})
