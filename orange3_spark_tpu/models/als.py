@@ -55,6 +55,13 @@ class ALSParams(Params):
     rating_col: str = "rating"     # MLlib ratingCol
     cold_start_strategy: str = "nan"  # MLlib coldStartStrategy: 'nan' | 'drop'
     chunk_size: int = 1 << 18      # ratings per scan chunk (HBM knob)
+    # 'auto': shard the factor tables over the mesh's model axis whenever
+    # the session has one wider than 1 (the scale-out story for factor
+    # tables wider than one chip's HBM — MLlib's user/item blocks, as
+    # GSPMD shardings + one reduce-scatter instead of a block shuffle);
+    # 'model' demands it (raises without a model axis); 'replicated'
+    # pins the round-3 behavior.
+    factor_sharding: str = "auto"  # 'auto' | 'model' | 'replicated'
 
 
 def _nnls_cd(A, b, x0, sweeps: int):
@@ -277,9 +284,20 @@ class ALS(Estimator):
         else:
             n_items = max_i + 1
         session = table.session
+        if p.factor_sharding not in ("auto", "model", "replicated"):
+            raise ValueError(
+                f"factor_sharding must be 'auto' | 'model' | 'replicated', "
+                f"got {p.factor_sharding!r}")
+        has_model_axis = (session is not None
+                          and session.model_axis is not None
+                          and session.mesh.shape.get(session.model_axis, 1) > 1)
+        if p.factor_sharding == "model" and not has_model_axis:
+            raise ValueError(
+                "factor_sharding='model' needs a session mesh with a model "
+                "axis wider than 1 (e.g. jax.make_mesh((dp, mp), "
+                "('data', 'model')))")
         factor_sharding = None
-        if session is not None and session.model_axis is not None and \
-                session.mesh.shape.get(session.model_axis, 1) > 1:
+        if p.factor_sharding != "replicated" and has_model_axis:
             factor_sharding = session.sharding(session.model_axis, None)
         U, V = _als_fit(
             u, i, r, table.W,

@@ -28,9 +28,39 @@ import contextlib
 import fcntl
 import os
 import sys
+import tempfile
 import time
 
-LOCK_PATH = "/tmp/otpu_tpu.lock"
+def _default_lock_path() -> str:
+    """Per-user lock path (round-4 advisor: a fixed world-writable
+    /tmp/otpu_tpu.lock could be squatted or symlinked by any local user,
+    starving every harness or redirecting the pid write). XDG_RUNTIME_DIR
+    is already per-user and mode-0700 when present; otherwise a private
+    0700 per-uid directory under tmp — with an OWNERSHIP CHECK, because
+    /tmp's sticky bit stops deletion but not pre-creation: a squatter's
+    directory (or file at the path) fails loudly here instead of starving
+    every harness at acquire time."""
+    run_dir = os.environ.get("XDG_RUNTIME_DIR")
+    if run_dir and os.path.isdir(run_dir):
+        return os.path.join(run_dir, "otpu_tpu.lock")
+    d = os.path.join(tempfile.gettempdir(), f"otpu_{os.getuid()}")
+    try:
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        st = os.stat(d)
+    except OSError as e:
+        raise RuntimeError(
+            f"cannot create private lock dir {d}: {e} — another user may "
+            "have squatted the path; remove it or set XDG_RUNTIME_DIR"
+        ) from e
+    if st.st_uid != os.getuid() or not os.path.isdir(d):
+        raise RuntimeError(
+            f"lock dir {d} exists but is not ours (uid {st.st_uid}) — "
+            "squatted; remove it or set XDG_RUNTIME_DIR"
+        )
+    return os.path.join(d, "otpu_tpu.lock")
+
+
+LOCK_PATH = _default_lock_path()
 
 
 class TpuDeviceLock:
@@ -50,14 +80,22 @@ class TpuDeviceLock:
         ``blocking=False`` returns False immediately when contended;
         blocking mode raises TimeoutError past ``wait_s`` (default:
         OTPU_LOCK_WAIT_S or 5400) — proceeding lock-less would
-        reintroduce the collision this exists to prevent."""
-        if os.environ.get("OTPU_CHILD"):
+        reintroduce the collision this exists to prevent.
+
+        The OTPU_CHILD no-op applies to BLOCKING acquires only (the
+        retry-ladder children whose parent owns the device). A
+        non-blocking try from a child still contends for real: if
+        OTPU_CHILD ever leaked into the capture watcher's environment, a
+        no-op'd try would leave ``held`` False forever and the watcher
+        would silently defer every probe (round-4 advisor finding)."""
+        if os.environ.get("OTPU_CHILD") and blocking:
             return True
         if self._fd is not None:
             return True
         if wait_s is None:
             wait_s = float(os.environ.get("OTPU_LOCK_WAIT_S", "5400"))
-        fd = os.open(LOCK_PATH, os.O_CREAT | os.O_RDWR, 0o666)
+        flags = os.O_CREAT | os.O_RDWR | getattr(os, "O_NOFOLLOW", 0)
+        fd = os.open(LOCK_PATH, flags, 0o600)
         t0 = time.monotonic()
         logged = False
         while True:
@@ -113,8 +151,9 @@ def tpu_device_lock(*, wait_s: float | None = None, name: str = ""):
 def try_tpu_device_lock(*, name: str = ""):
     """Non-blocking variant: yields the lock; ``lock.held`` is False when
     another harness owns the device (callers should then back off — e.g.
-    the capture watcher defers its probe). Not for OTPU_CHILD processes
-    (``held`` stays False there even though acquire no-op-succeeds)."""
+    the capture watcher defers its probe). Contends for real even under
+    OTPU_CHILD (the no-op is blocking-only), so a leaked OTPU_CHILD can
+    no longer livelock a try-based caller."""
     lock = TpuDeviceLock(name)
     lock.acquire(blocking=False)
     try:

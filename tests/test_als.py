@@ -180,3 +180,36 @@ def test_als_implicit_negative_feedback_stays_finite(session):
     U = np.asarray(model.user_factors)
     V = np.asarray(model.item_factors)
     assert np.isfinite(U).all() and np.isfinite(V).all()
+
+
+def test_als_factor_sharding_flag(session):
+    """The explicit factor_sharding knob: 'replicated' must keep the
+    factors unsharded even on a model-axis mesh (and match the sharded
+    numbers — same algorithm, different layout); 'model' must raise
+    without a model axis; a bogus value must raise."""
+    import jax
+    from orange3_spark_tpu.core.session import TpuSession
+
+    ratings = make_ratings(48, 32, 2000, rank=3, seed=9)
+    with pytest.raises(ValueError, match="model axis"):
+        ALS(rank=3, max_iter=2, factor_sharding="model").fit(
+            ratings_table(ratings, session))
+    with pytest.raises(ValueError, match="factor_sharding"):
+        ALS(rank=3, max_iter=2, factor_sharding="bogus").fit(
+            ratings_table(ratings, session))
+
+    devs = np.asarray(jax.devices()).reshape(4, 2)
+    sess2 = TpuSession(jax.sharding.Mesh(devs, ("data", "model")))
+    with sess2.use():
+        t2 = ratings_table(ratings, sess2)
+        repl = ALS(rank=3, max_iter=3, seed=2,
+                   factor_sharding="replicated").fit(t2)
+        shard = ALS(rank=3, max_iter=3, seed=2,
+                    factor_sharding="model").fit(t2)
+    spec = shard.user_factors.sharding.spec
+    assert len(spec) >= 1 and spec[0] == "model"
+    assert repl.user_factors.sharding.spec[0] is None \
+        if len(repl.user_factors.sharding.spec) else True
+    np.testing.assert_allclose(
+        np.asarray(repl.user_factors), np.asarray(shard.user_factors),
+        rtol=2e-4, atol=2e-4)

@@ -105,3 +105,22 @@ def test_child_processes_noop(lock_path, monkeypatch):
     finally:
         proc.kill()
         proc.wait()
+
+
+def test_child_nonblocking_contends_for_real(lock_path, monkeypatch):
+    """A leaked OTPU_CHILD must NOT no-op a try-acquire (round-4 advisor:
+    the capture watcher's probe would defer forever on a false
+    'contended'). Uncontended, the child's try really takes the lock;
+    contended, it really backs off."""
+    monkeypatch.setenv("OTPU_CHILD", "1")
+    with try_tpu_device_lock(name="try-child") as lk:
+        assert lk.held
+        pid, name = open(lock_path).read().split()
+        assert int(pid) == os.getpid() and name == "try-child"
+    proc = _hold_in_subprocess(lock_path, 10.0)
+    try:
+        with try_tpu_device_lock(name="try-child2") as lk2:
+            assert not lk2.held
+    finally:
+        proc.kill()
+        proc.wait()

@@ -395,3 +395,90 @@ def test_streaming_kmeans_cache_preseed_and_overflow(session):
     np.testing.assert_array_equal(
         np.asarray(m_o.centers), np.asarray(m_s.centers)
     )
+
+
+def test_negative_row_weights_rejected_at_ingest():
+    """_rechunk is the single ingest choke point: negative weights would
+    silently break the global 'w == 0 means dead row' invariant (e.g. the
+    KMeans replay's pre-seed-batches-are-no-ops property) — reject loudly
+    (round-4 advisor finding)."""
+    from orange3_spark_tpu.io.streaming import _rechunk
+
+    X = np.ones((8, 3), np.float32)
+    y = np.ones((8,), np.float32)
+    w = np.ones((8,), np.float32)
+    w[3] = -0.5
+
+    with pytest.raises(ValueError, match="negative row weights"):
+        list(_rechunk(iter([(X, y, w)]), rows=4))
+    # non-negative weights (incl. zeros) pass untouched
+    w[3] = 0.0
+    out = list(_rechunk(iter([(X, y, w)]), rows=4))
+    assert len(out) == 2 and out[0][2].shape == (4,)
+
+
+def _write_parquet(path, Xall, y, row_group_size=600):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    cols = {"label": y}
+    for j in range(Xall.shape[1]):
+        cols[f"f{j}"] = Xall[:, j]
+    pq.write_table(pa.table(cols), str(path), row_group_size=row_group_size)
+
+
+def test_parquet_chunk_source_streams_row_groups(tmp_path):
+    """Round-group-at-a-time parquet ingest (SURVEY §2b "Data ingest" —
+    the out-of-core regime was CSV-only through round 4): chunks must
+    reassemble the exact data, split the class column, respect chunk_rows
+    across row-group boundaries, and re-iterate for multi-epoch fits."""
+    from orange3_spark_tpu.io.streaming import (
+        parquet_chunk_source, parquet_raw_chunk_source,
+    )
+
+    Xall, y = _criteo_shaped(5000, seed=3)
+    p = tmp_path / "d.parquet"
+    _write_parquet(p, Xall, y)   # 600-row groups: 1000-row chunks cross them
+
+    src = parquet_chunk_source(str(p), class_col="label", chunk_rows=1000)
+    for _ in range(2):           # re-iterable (epochs restart the stream)
+        chunks = list(src())
+        assert [len(c[0]) for c in chunks] == [1000] * 5
+        np.testing.assert_allclose(
+            np.concatenate([c[0] for c in chunks]), Xall, rtol=1e-6)
+        np.testing.assert_array_equal(
+            np.concatenate([c[1] for c in chunks]), y)
+
+    raw = list(parquet_raw_chunk_source(str(p), chunk_rows=1000)())
+    full = np.column_stack([y] + [Xall[:, j] for j in range(Xall.shape[1])])
+    np.testing.assert_allclose(np.concatenate(raw), full, rtol=1e-6)
+
+    with pytest.raises(ValueError, match="class_col"):
+        next(parquet_chunk_source(str(p), class_col="nope")())
+
+
+def test_parquet_fit_stream_matches_array_source(session, tmp_path):
+    """A fit_stream fed from parquet must produce bit-identical parameters
+    to the same data fed from memory — including through the DISK-SPILL
+    replay path (cache too small to hold the dataset), closing the last
+    ingest gap vs SURVEY §2b (round-4 verdict item 4)."""
+    from orange3_spark_tpu.io.streaming import parquet_raw_chunk_source
+
+    Xall, y = _criteo_shaped(4096, seed=7)
+    p = tmp_path / "d.parquet"
+    _write_parquet(p, Xall, y)
+
+    kw = dict(KW, epochs=3, label_in_chunk=True, fused_replay=False)
+    ref = StreamingHashedLinearEstimator(**kw).fit_stream(
+        _raw_source(Xall, y, 1024), session=session, cache_device=True)
+    st: dict = {}
+    spilled = StreamingHashedLinearEstimator(**kw).fit_stream(
+        parquet_raw_chunk_source(str(p), chunk_rows=1024), session=session,
+        cache_device=True, cache_device_bytes=1 << 16,
+        cache_spill_dir=str(tmp_path), stage_times=st,
+    )
+    assert st.get("replay_source") == "disk"
+    np.testing.assert_array_equal(
+        np.asarray(ref.theta["emb"]), np.asarray(spilled.theta["emb"]))
+    np.testing.assert_array_equal(
+        np.asarray(ref.theta["coef"]), np.asarray(spilled.theta["coef"]))

@@ -1,19 +1,30 @@
-"""Persistent TPU-window capture watcher.
+"""Persistent TPU-window capture watcher (round 5).
 
-The axon tunnel dies and resurrects in short windows (observed rounds 2-4;
-this boot: answered 00:59-01:04, wedged the first full bench mid-fit). This
-watcher probes the backend in a subprocess every few minutes and, the moment
-a probe succeeds, runs the capture ladder below — smallest first, so even a
-two-minute window banks a real hardware number before the full-scale runs
-are attempted. Each step runs with the harness's own stall watchdog armed
-(OTPU_STALL_S) plus a hard wall timeout, so a mid-run tunnel death costs one
-bounded attempt, not the watcher.
+The axon tunnel dies and resurrects in short windows (observed rounds
+2-4). This watcher probes the backend in a subprocess every few minutes
+and, the moment a probe succeeds, runs the capture ladder below. Round-5
+changes over the r4 watcher:
 
-    nohup python tools/capture_watcher.py > /tmp/capture_watcher.log 2>&1 &
+* every probe also measures blocked h2d bandwidth and publishes the
+  verdict to the shared tunnel-status file (utils/tunnel.py) — the
+  round-end bench reads it to skip its probe window when the tunnel has
+  been dead for hours (round-4 verdict item 1);
+* ladder steps carry a minimum window quality (``min_h2d_mbps``): on a
+  HEALTHY window (h2d > 20 MB/s) the 8M config-2 bench runs FIRST (the
+  round's highest-value capture, round-4 verdict item 2); on a degraded
+  window the cheaper diagnostics run instead, and an ungated final 8M
+  attempt backstops the round if no healthy window ever appears;
+* while the round-end driver bench holds the preempt flag
+  (utils/tunnel.py), in-flight steps are killed within ~20 s and probes
+  pause — the driver's budget must never drain behind a 3000 s suite
+  step.
 
-Results append to BENCH_HW_r4.jsonl (one labeled JSON line per success);
+    setsid bash -c 'exec python tools/capture_watcher.py \
+        >> /tmp/capture_watcher.log 2>&1' &
+
+Results append to BENCH_HW_r5.jsonl (one labeled JSON line per success);
 per-step logs land in /tmp/capture_<name>.log; progress/state in
-/tmp/otpu_capture_state.json (attempts survive watcher restarts).
+/tmp/otpu_capture_state_r5.json (attempts survive watcher restarts).
 """
 
 from __future__ import annotations
@@ -28,41 +39,39 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from orange3_spark_tpu.utils.devlock import try_tpu_device_lock  # noqa: E402
+from orange3_spark_tpu.utils.tunnel import (  # noqa: E402
+    preempt_active, write_tunnel_status,
+)
 
-STATE = "/tmp/otpu_capture_state.json"
-OUT = os.path.join(REPO, "BENCH_HW_r4.jsonl")
+STATE = "/tmp/otpu_capture_state_r5.json"
+OUT = os.path.join(REPO, "BENCH_HW_r5.jsonl")
 PROBE_EVERY_S = 150
 MAX_ATTEMPTS = 3
 
-#: (name, argv, wall timeout s) — smallest first; the ladder resumes at the
-#: first uncompleted step each window
+#: (name, argv, wall timeout s, min_h2d_mbps) — the ladder picks the FIRST
+#: pending step whose window-quality gate passes, so priority is list
+#: order restricted to what the current window can carry.
 STEPS = [
-    ("bench_2m", [sys.executable, "bench.py", "--rows", "2000000"], 1200),
-    # the fused-replay fault experiment matrix (tools/replay_fault_diag.py)
-    # — 5 bounded subprocess cells (420 s each, worst case 2100 s); its
-    # verdict decides whether round 5 can re-enable fused replay on
-    # hardware, which improves EVERY later capture (one scan dispatch per
-    # 99 epochs instead of 99) — so it outranks the long benches. Wall
-    # must exceed cells x --wall-s.
-    ("replay_diag", [sys.executable, "tools/replay_fault_diag.py"], 2400),
-    # 3300 s: on a 2 MB/s-h2d window the 8M run is ~600 s of DMA + up to
-    # ~1500 s of per-epoch replay dispatches before eval — 2700 was
-    # borderline (the 08:12 attempt burned 1808 s on two rungs alone)
-    ("bench_8m", [sys.executable, "bench.py"], 3300),
-    # 1500 s: six tunnel compiles (five variants + the in-scan cell's
-    # replay program) plus 140 dispatched steps at up to ~1 s each on a
-    # degraded window
-    ("step_ab", [sys.executable, "tools/step_ab.py"], 1500),
-    # quarter scale on purpose: windows are scarce and degraded (2 MB/s
-    # h2d, ~1 s dispatches on 2026-07-31); a banked TPU line with its row
-    # counts in the JSON beats three full-scale wall timeouts. Full-scale
-    # TPU runs remain a manual follow-up for a long healthy window.
+    # the round's headline ask: a GOOD-window 8M config-2 TPU line
+    # (round-4's only 8M-adjacent number rode a ~2 MB/s dying tunnel).
+    # Gated at 20 MB/s; the ungated *_any twin at the bottom backstops a
+    # round with no healthy window.
+    ("bench_8m", [sys.executable, "bench.py"], 3300, 20.0),
+    # configs 3-5 at quarter scale: trees + the Pallas histogram A/B
+    # (bench_suite emits hist_pallas/xla_ms on TPU), the staged
+    # refit/transform TPU measurement (c5), ALS (c4). In-memory fits are
+    # few-dispatch, so a degraded window mostly costs the dataset DMA —
+    # any live window qualifies (gate 1 MB/s).
     ("suite_c3", [sys.executable, "bench_suite.py", "--config", "3",
-                  "--rows-scale", "0.25"], 3000),
-    ("suite_c4", [sys.executable, "bench_suite.py", "--config", "4",
-                  "--rows-scale", "0.25"], 2400),
+                  "--rows-scale", "0.25"], 3000, 1.0),
     ("suite_c5", [sys.executable, "bench_suite.py", "--config", "5",
-                  "--rows-scale", "0.25"], 2400),
+                  "--rows-scale", "0.25"], 2400, 1.0),
+    ("suite_c4", [sys.executable, "bench_suite.py", "--config", "4",
+                  "--rows-scale", "0.25"], 2400, 1.0),
+    # fused-replay fault mechanism experiment: HLO-dump comparison of the
+    # poisoned vs clean giant-scan execution (round-4 verdict item 6)
+    ("replay_hlo", [sys.executable, "tools/replay_hlo.py"], 1800, 0.0),
+    ("bench_8m_any", [sys.executable, "bench.py"], 3300, 0.0),
 ]
 
 
@@ -85,53 +94,54 @@ def save_state(st: dict) -> None:
     os.replace(tmp, STATE)
 
 
-def probe() -> str:
-    """'live' | 'down' | 'wedged' | 'busy'.
+def probe() -> tuple[str, float]:
+    """('live'|'down'|'wedged'|'busy', h2d_mbps).
 
-    'live' iff the TPU answers AND executes a matmul (this boot the tunnel
-    answered jax.devices() then wedged real work a minute later); 'wedged'
-    when the probe subprocess TIMED OUT (the mode where `import jax` hangs
-    at interpreter start) rather than failing fast — the caller backs way
-    off then, because a wedged probe burns its full 90 s holding the
-    device lock and a normal cadence would starve any other harness
-    (observed flaking the bench contract test).
-
-    Holds the harness device lock for the probe's duration and reports
-    'busy' WITHOUT probing when another harness (e.g. the driver's
-    round-end bench) owns the device — a probe poking a busy tunnel is
-    exactly the two-process collision the lock exists to prevent. The
-    probe child runs in its own process group and a timeout kills the
-    GROUP: the wedge spawns tunnel-helper descendants that would
-    otherwise outlive the direct child and keep poking the tunnel
-    lock-less after the lock is released (same reasoning as run_step)."""
+    'live' iff the TPU answers AND executes a matmul; the probe then also
+    measures one blocked 16 MB device_put — the window-quality number the
+    ladder gates on and the status file publishes. 'wedged' when the
+    probe subprocess TIMED OUT (the mode where ``import jax`` hangs at
+    interpreter start) — the caller backs way off then. Holds the harness
+    device lock for the probe's duration and reports 'busy' WITHOUT
+    probing when another harness owns the device. The probe child runs in
+    its own process group and a timeout kills the GROUP (wedge spawns
+    tunnel-helper descendants that would otherwise keep poking the
+    tunnel lock-less)."""
     with try_tpu_device_lock(name="watcher-probe") as lk:
         if not lk.held:
             log("device lock held by another harness; deferring probe")
-            return "busy"
-        code = ("import jax, jax.numpy as jnp; d = jax.devices(); "
-                "x = jnp.ones((256, 256)); jax.block_until_ready(x @ x); "
-                "print('OTPU_LIVE', d[0].platform)")
+            return "busy", 0.0
+        code = (
+            "import time, jax, jax.numpy as jnp, numpy as np\n"
+            "d = jax.devices()\n"
+            "x = jnp.ones((256, 256)); jax.block_until_ready(x @ x)\n"
+            "buf = np.ones((4_000_000,), np.float32)\n"
+            "t0 = time.perf_counter()\n"
+            "jax.block_until_ready(jax.device_put(buf))\n"
+            "mbps = buf.nbytes / (time.perf_counter() - t0) / 1e6\n"
+            "print('OTPU_LIVE', d[0].platform, round(mbps, 1))"
+        )
         proc = subprocess.Popen([sys.executable, "-c", code],
                                 stdout=subprocess.PIPE,
                                 stderr=subprocess.DEVNULL, text=True,
                                 cwd=REPO, start_new_session=True)
         try:
-            out, _ = proc.communicate(timeout=90)
+            out, _ = proc.communicate(timeout=120)
         except subprocess.TimeoutExpired:
-            import signal
-
-            try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except ProcessLookupError:
-                pass
-            try:
-                proc.communicate(timeout=30)
-            except subprocess.TimeoutExpired:
-                pass
-            return "wedged"
-        return ("live" if any(ln.startswith("OTPU_LIVE tpu")
-                              for ln in (out or "").splitlines())
-                else "down")
+            _kill_group(proc)
+            write_tunnel_status("wedged", source="watcher")
+            return "wedged", 0.0
+        for ln in (out or "").splitlines():
+            parts = ln.split()
+            if ln.startswith("OTPU_LIVE tpu") and len(parts) >= 3:
+                try:
+                    mbps = float(parts[2])
+                except ValueError:
+                    mbps = 0.0
+                write_tunnel_status("live", h2d_mbps=mbps, source="watcher")
+                return "live", mbps
+        write_tunnel_status("down", source="watcher")
+        return "down", 0.0
 
 
 def bank(name: str, lines: list, attempt: int, partial: bool) -> int:
@@ -173,16 +183,39 @@ def bank(name: str, lines: list, attempt: int, partial: bool) -> int:
     return n
 
 
-def run_step(name: str, argv: list, wall_s: int, attempt: int = 0) -> bool:
+def _kill_group(proc) -> str:
+    import signal
+
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+    try:
+        out, _ = proc.communicate(timeout=30)
+    except subprocess.TimeoutExpired as e2:
+        # an escaped descendant can hold the pipe open past the group
+        # kill; the exception still carries what was read — never discard
+        # lines already flushed
+        ob = e2.stdout or ""
+        out = ob.decode("utf-8", "replace") if isinstance(ob, bytes) else ob
+    return out or ""
+
+
+def run_step(name: str, argv: list, wall_s: int, attempt: int = 0) -> str:
+    """Returns 'done' | 'failed' | 'preempted'."""
     env = dict(os.environ)
     # the watcher only launches after a live probe — don't re-probe for
-    # 30 min inside the harness; fail fast and return to the probe loop
+    # long inside the harness; fail fast and return to the probe loop.
     # OTPU_STALL_S stays at the 900 s default: the heartbeat only ticks on
     # dispatch events, so the FIRST tunnel compile of a big suite program
     # (trees/ALS single-dispatch fits, worst observed ~3 min, headroom for
     # worse) must not read as a stall; the wall timeout bounds the step.
     env.pop("OTPU_STALL_S", None)   # pin the documented 900 s default
     env.update({"OTPU_TUNNEL_WAIT_S": "120", "OTPU_TUNNEL_RETRY_S": "60"})
+    # watcher children must not raise the round-end preempt flag (bench.py
+    # gates preemption on this), and get the full wall as their own budget
+    env["OTPU_WATCHER"] = "1"
+    env["OTPU_BENCH_BUDGET_S"] = str(wall_s)
     # the step child acquires the device lock itself; bound its wait well
     # below the wall so lock contention (another harness grabbed the lock
     # in the probe->step gap) fails FAST and visibly instead of idling
@@ -192,6 +225,7 @@ def run_step(name: str, argv: list, wall_s: int, attempt: int = 0) -> bool:
     log(f"running {name}: {' '.join(argv)} (wall {wall_s}s, log {logp})")
     t0 = time.time()
     rc: object
+    out = ""
     with open(logp, "w") as lf:
         # new session => own process group, so a wall timeout kills the
         # WHOLE tree: bench.py's retry-ladder rungs are grandchildren that
@@ -201,30 +235,25 @@ def run_step(name: str, argv: list, wall_s: int, attempt: int = 0) -> bool:
         proc = subprocess.Popen(argv, stdout=subprocess.PIPE, stderr=lf,
                                 text=True, cwd=REPO, env=env,
                                 start_new_session=True)
-        try:
-            out, _ = proc.communicate(timeout=wall_s)
-            rc = proc.returncode
-        except subprocess.TimeoutExpired:
-            import signal
-
+        deadline = t0 + wall_s
+        while True:
             try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except ProcessLookupError:
-                pass
-            # keep whatever the step printed before the wall: multi-line
-            # tools (step_ab) flush each measurement as its own complete
-            # JSON line precisely so an end-of-run wedge cannot cost the
-            # early lines
-            try:
-                out, _ = proc.communicate(timeout=30)
-            except subprocess.TimeoutExpired as e2:
-                # an escaped descendant can hold the pipe open past the
-                # group kill; the exception still carries what was read —
-                # never discard lines already flushed
-                ob = e2.stdout or ""
-                out = ob.decode("utf-8", "replace") \
-                    if isinstance(ob, bytes) else ob
-            rc = "wall-timeout"
+                out, _ = proc.communicate(
+                    timeout=min(20.0, max(deadline - time.time(), 0.1)))
+                rc = proc.returncode
+                break
+            except subprocess.TimeoutExpired:
+                if time.time() >= deadline:
+                    out = _kill_group(proc)
+                    rc = "wall-timeout"
+                    break
+                who = preempt_active()
+                if who:
+                    log(f"{name}: preempted by '{who}' (round-end bench "
+                        f"wants the device); killing step")
+                    out = _kill_group(proc)
+                    rc = "preempted"
+                    break
         out = out or ""
     dt = time.time() - t0
     lines = [ln for ln in out.splitlines()
@@ -251,23 +280,36 @@ def run_step(name: str, argv: list, wall_s: int, attempt: int = 0) -> bool:
                 if ok_lines else 0)
     if rc == 0 and ok_lines:
         log(f"{name}: SUCCESS in {dt:.0f}s — {n_banked} new line(s) banked")
-        return True
+        return "done"
     log(f"{name}: rc={rc}, {n_banked} line(s) banked from partial output, "
         f"{dt:.0f}s — see {logp}")
-    return False
+    return "preempted" if rc == "preempted" else "failed"
 
 
 def main() -> None:
+    # a leaked OTPU_CHILD would no-op the BLOCKING lock paths in our step
+    # children (they'd run lock-less); refuse to start that way
+    assert not os.environ.get("OTPU_CHILD"), \
+        "capture_watcher must not run with OTPU_CHILD set"
     st = load_state()
-    log(f"watcher up; state: {st or 'fresh'}")
+    log(f"watcher up (r5); state: {st or 'fresh'}")
     while True:
         pending = [s for s in STEPS
                    if not st.get(s[0], {}).get("done")
                    and st.get(s[0], {}).get("attempts", 0) < MAX_ATTEMPTS]
+        if st.get("bench_8m", {}).get("done"):
+            # the ungated backstop exists only for a round with NO healthy
+            # window — once the gated 8M line is banked it is redundant
+            pending = [s for s in pending if s[0] != "bench_8m_any"]
         if not pending:
             log("ALL DONE (or attempts exhausted); exiting")
             return
-        status = probe()
+        who = preempt_active()
+        if who:
+            log(f"round-end preempt flag up ('{who}'); pausing probes")
+            time.sleep(60)
+            continue
+        status, h2d = probe()
         if status != "live":
             # 'wedged' backs off 4x (see probe()); 'busy'/'down' keep the
             # normal cadence
@@ -276,11 +318,23 @@ def main() -> None:
                 f"sleeping {sleep_s}s")
             time.sleep(sleep_s)
             continue
-        name, argv, wall_s = pending[0]
+        eligible = [s for s in pending if h2d >= s[3]]
+        if not eligible:
+            log(f"tunnel live but degraded (h2d {h2d:.1f} MB/s); "
+                f"{len(pending)} gated steps pending; sleeping")
+            time.sleep(PROBE_EVERY_S)
+            continue
+        name, argv, wall_s, _gate = eligible[0]
+        log(f"window open (h2d {h2d:.1f} MB/s); step {name}")
         rec = st.setdefault(name, {"attempts": 0, "done": False})
         rec["attempts"] += 1
         save_state(st)
-        rec["done"] = run_step(name, argv, wall_s, attempt=rec["attempts"])
+        outcome = run_step(name, argv, wall_s, attempt=rec["attempts"])
+        if outcome == "preempted":
+            # not the step's fault — don't burn an attempt; resume after
+            # the round-end bench releases the device
+            rec["attempts"] -= 1
+        rec["done"] = outcome == "done"
         save_state(st)
 
 
