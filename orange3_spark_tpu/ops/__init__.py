@@ -1,3 +1,8 @@
 from orange3_spark_tpu.ops.stats import weighted_moments
 
 __all__ = ["weighted_moments"]
+
+# The relational surface (group_by/pivot/rollup/cube/join/join_expand/
+# join_host/sort/sample/union/...) intentionally stays behind
+# `from orange3_spark_tpu.ops import relational as R` — it is a module-sized
+# API (docs/MIGRATING.md maps it to pyspark.sql.DataFrame one-to-one).

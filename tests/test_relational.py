@@ -453,3 +453,114 @@ def test_random_split(session):
         random_split(t, [1.0, 0.0])
     with pytest.raises(ValueError, match="finite"):
         random_split(t, [1.0, float("nan")])
+
+
+def _order_table(session, n=60, seed=3):
+    """Fact table: orders with a discrete customer key + amount."""
+    rng = np.random.default_rng(seed)
+    cust = rng.integers(0, 4, n).astype(np.float32)
+    amount = rng.gamma(2.0, 5.0, n).astype(np.float32)
+    dom = Domain([
+        DiscreteVariable("cust", ("c0", "c1", "c2", "c3")),
+        ContinuousVariable("amount"),
+    ])
+    return (TpuTable.from_numpy(dom, np.stack([cust, amount], 1),
+                                session=session), cust, amount)
+
+
+def _contacts_table(session):
+    """Many rows per key: c0 has 2 contacts, c1 has 3, c2 none, c3 one —
+    plus a key value ('cx') the left side never enumerates."""
+    dom = Domain([
+        DiscreteVariable("cust", ("c1", "c0", "c3", "cx")),  # scrambled order
+        ContinuousVariable("phone"),
+    ])
+    rows = np.array([
+        [1, 100.0],   # c0
+        [0, 200.0],   # c1
+        [0, 201.0],   # c1
+        [1, 101.0],   # c0
+        [0, 202.0],   # c1
+        [2, 300.0],   # c3
+        [3, 900.0],   # cx (left-unknown)
+    ], np.float32)
+    return TpuTable.from_numpy(dom, rows, session=session)
+
+
+def _pd_join(cust, amount, how):
+    import pandas as pd
+
+    left = pd.DataFrame({"cust": cust.astype(int), "amount": amount})
+    right = pd.DataFrame({  # in LEFT key indexing: c0=0, c1=1, c3=3
+        "cust": [0, 1, 1, 0, 1, 3],
+        "phone": [100.0, 200.0, 201.0, 101.0, 202.0, 300.0]})
+    return left.merge(right, on="cust", how=how)
+
+
+def test_join_expand_matches_pandas_inner(session):
+    from orange3_spark_tpu.ops.relational import join_expand
+
+    t, cust, amount = _order_table(session)
+    out = join_expand(t, _contacts_table(session), "cust", max_matches=3)
+    X, _, W = out.to_numpy()
+    live = W > 0
+    got = sorted(map(tuple, X[live]))
+    exp_df = _pd_join(cust, amount, "inner")
+    exp = sorted(zip(exp_df["cust"].astype(float), exp_df["amount"],
+                     exp_df["phone"]))
+    assert len(got) == len(exp)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(exp), rtol=1e-5)
+
+
+def test_join_expand_left_keeps_unmatched_with_nan(session):
+    from orange3_spark_tpu.ops.relational import join_expand
+
+    t, cust, amount = _order_table(session)
+    out = join_expand(t, _contacts_table(session), "cust",
+                      max_matches=3, how="left")
+    X, _, W = out.to_numpy()
+    live = W > 0
+    # every c2 order (no contacts) survives exactly once, phone NaN
+    c2 = X[live][X[live][:, 0] == 2.0]
+    assert len(c2) == int((cust == 2).sum())
+    assert np.isnan(c2[:, 2]).all()
+    # matched rows: same multiset as the inner join
+    matched = X[live][~np.isnan(X[live][:, 2])]
+    exp_df = _pd_join(cust, amount, "inner")
+    assert len(matched) == len(exp_df)
+
+
+def test_join_expand_bound_violation_raises(session):
+    from orange3_spark_tpu.ops.relational import join_expand
+
+    t, *_ = _order_table(session)
+    with pytest.raises(ValueError, match="matches > max_matches"):
+        join_expand(t, _contacts_table(session), "cust", max_matches=2)
+
+
+def test_join_host_matches_pandas_all_hows(session):
+    from orange3_spark_tpu.ops.relational import join_host
+
+    t, cust, amount = _order_table(session)
+    contacts = _contacts_table(session)
+
+    def canon(arr):
+        a = np.where(np.isnan(arr), -1.0, arr)
+        return np.asarray(sorted(map(tuple, a)))
+
+    for how in ("inner", "left", "outer"):
+        out = join_host(t, contacts, "cust", how=how)
+        X, _, W = out.to_numpy()
+        got = canon(X[W > 0])
+        exp_df = _pd_join(cust, amount, how)
+        exp = np.stack([exp_df["cust"].to_numpy(float),
+                        exp_df["amount"].to_numpy(float),
+                        exp_df["phone"].to_numpy(float)], axis=1)
+        if how == "outer":
+            # the right-only 'cx' contact (900.0): its key value is absent
+            # from the left enumeration, so our row carries a NaN key; the
+            # pandas right frame (left-indexed) never contained it
+            exp = np.concatenate([exp, [[np.nan, np.nan, 900.0]]], axis=0)
+        exp = canon(exp)
+        assert got.shape == exp.shape, (how, got.shape, exp.shape)
+        np.testing.assert_allclose(got, exp, rtol=1e-5)

@@ -694,3 +694,44 @@ def test_glm_gmm_mlp_are_refit_in_trace_eligible(session):
         g.connect(src, "data", est, "data")
         staged = stage_graph(g, est, refit=True)
         assert staged.refit_fallbacks == [], (wname, staged.refit_fallbacks)
+
+
+def test_owjoin_routes_all_three_regimes(session):
+    """OWJoin dispatches dimension-gather / bounded-expand / host
+    sort-merge from its params (the round-5 join generalization)."""
+    import numpy as np
+
+    from orange3_spark_tpu.core.domain import (
+        ContinuousVariable, DiscreteVariable, Domain,
+    )
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.widgets.catalog import WIDGET_REGISTRY
+
+    vals = ("k0", "k1")
+    left = TpuTable.from_numpy(
+        Domain([DiscreteVariable("k", vals), ContinuousVariable("x")]),
+        np.array([[0, 1.0], [1, 2.0], [1, 3.0]], np.float32),
+        session=session)
+    right_m2m = TpuTable.from_numpy(
+        Domain([DiscreteVariable("k", vals), ContinuousVariable("r")]),
+        np.array([[0, 10.0], [0, 11.0], [1, 20.0]], np.float32),
+        session=session)
+
+    def run(**params):
+        w = WIDGET_REGISTRY["OWJoin"](**params)
+        out = w.process(left, right_m2m)["data"]
+        X, _, W = out.to_numpy()
+        return X[W > 0]
+
+    # bounded expand: 2+1+1 live pairs
+    got = run(on="k", how="inner", max_matches=2)
+    assert len(got) == 4 and sorted(got[:, 2]) == [10.0, 11.0, 20.0, 20.0]
+    # host path via max_matches=-1
+    got = run(on="k", how="inner", max_matches=-1)
+    assert len(got) == 4
+    # outer forces host even with max_matches=0
+    got = run(on="k", how="outer")
+    assert len(got) == 4
+    # dimension join refuses the duplicate-key right side
+    with pytest.raises(ValueError, match="duplicate keys"):
+        run(on="k", how="left")

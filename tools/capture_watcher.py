@@ -183,22 +183,15 @@ def bank(name: str, lines: list, attempt: int, partial: bool) -> int:
     return n
 
 
-def _kill_group(proc) -> str:
-    import signal
+def _kill_group(proc, grace_s: float = 10.0) -> str:
+    """SIGTERM-with-grace first: a step like tools/replay_hlo.py runs its
+    TPU cells in their OWN sessions (so its wall timeout can group-kill
+    them without suiciding) — only the step itself can reach them, via
+    its SIGTERM handler. A straight SIGKILL would orphan a live cell to
+    keep driving the tunnel lock-less (round-5 review finding)."""
+    from orange3_spark_tpu.utils.procs import kill_process_group
 
-    try:
-        os.killpg(proc.pid, signal.SIGKILL)
-    except ProcessLookupError:
-        pass
-    try:
-        out, _ = proc.communicate(timeout=30)
-    except subprocess.TimeoutExpired as e2:
-        # an escaped descendant can hold the pipe open past the group
-        # kill; the exception still carries what was read — never discard
-        # lines already flushed
-        ob = e2.stdout or ""
-        out = ob.decode("utf-8", "replace") if isinstance(ob, bytes) else ob
-    return out or ""
+    return kill_process_group(proc, grace_s=grace_s)
 
 
 def run_step(name: str, argv: list, wall_s: int, attempt: int = 0) -> str:

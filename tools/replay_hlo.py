@@ -42,6 +42,23 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+#: pgids of in-flight cell subprocesses — killed by the SIGTERM handler so
+#: the watcher's graceful preempt (SIGTERM + grace, then SIGKILL) cannot
+#: orphan a live TPU cell into colliding with the round-end bench
+_LIVE_CELLS: set[int] = set()
+
+
+def _sigterm_handler(signum, frame):  # noqa: ARG001
+    import signal as _signal
+
+    for pid in list(_LIVE_CELLS):
+        try:
+            os.killpg(pid, _signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+    os._exit(143)
+
+
 _CELL_SRC = r"""
 import sys, time
 sys.path.insert(0, __REPO__)
@@ -99,29 +116,37 @@ def run_cell(name: str, stages: list, dump_dir: str, chunk_rows: int,
     # own process group + group kill + bounded second wait: a wedged cell
     # spawns tunnel-helper descendants that inherit the pipes, and a plain
     # subprocess.run would block forever in its post-kill communicate()
-    # while we hold the device lock (the round-4 probe lesson)
+    # while we hold the device lock (the round-4 probe lesson). The cell's
+    # pgid is tracked in _LIVE_CELLS so OUR OWN SIGTERM (the watcher's
+    # graceful preempt kill) can take the cell down with us — otherwise a
+    # preempted replay_hlo would orphan a live TPU cell to collide with
+    # the round-end bench, lock-less.
     proc = subprocess.Popen([sys.executable, "-c", src],
                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                             text=True, cwd=REPO, env=env,
                             start_new_session=True)
+    _LIVE_CELLS.add(proc.pid)
     try:
-        out, err = proc.communicate(timeout=wall_s)
-        rc = proc.returncode
-    except subprocess.TimeoutExpired:
-        import signal
+        try:
+            out, err = proc.communicate(timeout=wall_s)
+            rc = proc.returncode
+        except subprocess.TimeoutExpired:
+            import signal as _signal
 
-        rc = "wall-timeout"
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except ProcessLookupError:
-            pass
-        try:
-            out, err = proc.communicate(timeout=30)
-        except subprocess.TimeoutExpired as e2:
-            def _dec(b):
-                return (b or b"").decode("utf-8", "replace") \
-                    if isinstance(b, bytes) else (b or "")
-            out, err = _dec(e2.stdout), _dec(e2.stderr)
+            rc = "wall-timeout"
+            try:
+                os.killpg(proc.pid, _signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            try:
+                out, err = proc.communicate(timeout=30)
+            except subprocess.TimeoutExpired as e2:
+                def _dec(b):
+                    return (b or b"").decode("utf-8", "replace") \
+                        if isinstance(b, bytes) else (b or "")
+                out, err = _dec(e2.stdout), _dec(e2.stderr)
+    finally:
+        _LIVE_CELLS.discard(proc.pid)
     out, err = out or "", err or ""
     res = {
         "cell": name, "stages": stages,
@@ -172,6 +197,10 @@ def main() -> None:
     ap.add_argument("--wall-s", type=float, default=600.0)
     ap.add_argument("--dump-root", default="/tmp/otpu_hlo")
     args = ap.parse_args()
+
+    import signal
+
+    signal.signal(signal.SIGTERM, _sigterm_handler)
 
     sys.path.insert(0, REPO)
     from orange3_spark_tpu.utils.devlock import tpu_device_lock
