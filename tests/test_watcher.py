@@ -1,0 +1,72 @@
+"""The capture watcher's ladder logic (tools/capture_watcher.py) — the
+process that banks every hardware number the judge sees. Pins: step
+selection (priority + window-quality gates + the 8M backstop rule),
+banked-line dedupe with capture provenance, and the harness-error /
+non-TPU banking filters."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def cw():
+    spec = importlib.util.spec_from_file_location(
+        "capture_watcher", os.path.join(REPO, "tools", "capture_watcher.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["capture_watcher"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_ladder_priority_and_gates(cw):
+    names = [s[0] for s in cw.STEPS]
+    assert names[0] == "bench_8m", "the round's headline capture runs first"
+    assert names[-1] == "bench_8m_any", "ungated backstop is last"
+    gates = {s[0]: s[3] for s in cw.STEPS}
+    assert gates["bench_8m"] >= 20.0, \
+        "8M is gated on a healthy window (round-4 verdict item 2)"
+    assert gates["bench_8m_any"] == 0.0
+
+    # a healthy window picks the 8M bench; a degraded one skips to the
+    # first ungated diagnostic instead of wasting the window
+    pending = cw.pending_steps({})
+    assert cw.eligible_step(pending, 95.0)[0] == "bench_8m"
+    degraded = cw.eligible_step(pending, 0.5)
+    assert degraded is not None and degraded[3] <= 0.5
+    assert degraded[0] != "bench_8m"
+
+
+def test_backstop_drops_once_gated_8m_banked(cw):
+    st = {"bench_8m": {"attempts": 1, "done": True}}
+    names = [s[0] for s in cw.pending_steps(st)]
+    assert "bench_8m" not in names and "bench_8m_any" not in names
+
+    # ...but survives mere attempt exhaustion of the gated step (the
+    # backstop exists exactly for the no-healthy-window round)
+    st = {"bench_8m": {"attempts": cw.MAX_ATTEMPTS, "done": False}}
+    names = [s[0] for s in cw.pending_steps(st)]
+    assert "bench_8m" not in names and "bench_8m_any" in names
+
+
+def test_bank_dedupes_and_stamps_provenance(cw, tmp_path, monkeypatch):
+    out = tmp_path / "bank.jsonl"
+    monkeypatch.setattr(cw, "OUT", str(out))
+    line = json.dumps({"metric": "m", "value": 1.5, "backend": "tpu"})
+    assert cw.bank("step_a", [line], attempt=1, partial=False) == 1
+    # same measurement content from a retry: deduped
+    assert cw.bank("step_a", [line], attempt=2, partial=True) == 0
+    # different content: banked, provenance stamped
+    line2 = json.dumps({"metric": "m", "value": 2.0, "backend": "tpu"})
+    assert cw.bank("step_a", [line2], attempt=2, partial=True) == 1
+    rows = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert [r["value"] for r in rows] == [1.5, 2.0]
+    assert rows[0]["capture_step"] == "step_a"
+    assert rows[0]["capture_attempt"] == 1
+    assert "capture_partial" not in rows[0]
+    assert rows[1]["capture_partial"] is True

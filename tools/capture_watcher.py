@@ -279,6 +279,27 @@ def run_step(name: str, argv: list, wall_s: int, attempt: int = 0) -> str:
     return "preempted" if rc == "preempted" else "failed"
 
 
+def pending_steps(st: dict) -> list:
+    """Steps still worth running: not done, attempts left — and the
+    ungated 8M backstop drops out once the gated 8M line is banked (it
+    exists only for a round with NO healthy window)."""
+    pending = [s for s in STEPS
+               if not st.get(s[0], {}).get("done")
+               and st.get(s[0], {}).get("attempts", 0) < MAX_ATTEMPTS]
+    if st.get("bench_8m", {}).get("done"):
+        pending = [s for s in pending if s[0] != "bench_8m_any"]
+    return pending
+
+
+def eligible_step(pending: list, h2d_mbps: float):
+    """First pending step whose window-quality gate passes, or None —
+    priority is list order restricted to what this window can carry."""
+    for s in pending:
+        if h2d_mbps >= s[3]:
+            return s
+    return None
+
+
 def main() -> None:
     # a leaked OTPU_CHILD would no-op the BLOCKING lock paths in our step
     # children (they'd run lock-less); refuse to start that way
@@ -287,13 +308,7 @@ def main() -> None:
     st = load_state()
     log(f"watcher up (r5); state: {st or 'fresh'}")
     while True:
-        pending = [s for s in STEPS
-                   if not st.get(s[0], {}).get("done")
-                   and st.get(s[0], {}).get("attempts", 0) < MAX_ATTEMPTS]
-        if st.get("bench_8m", {}).get("done"):
-            # the ungated backstop exists only for a round with NO healthy
-            # window — once the gated 8M line is banked it is redundant
-            pending = [s for s in pending if s[0] != "bench_8m_any"]
+        pending = pending_steps(st)
         if not pending:
             log("ALL DONE (or attempts exhausted); exiting")
             return
@@ -311,13 +326,13 @@ def main() -> None:
                 f"sleeping {sleep_s}s")
             time.sleep(sleep_s)
             continue
-        eligible = [s for s in pending if h2d >= s[3]]
-        if not eligible:
+        step = eligible_step(pending, h2d)
+        if step is None:
             log(f"tunnel live but degraded (h2d {h2d:.1f} MB/s); "
                 f"{len(pending)} gated steps pending; sleeping")
             time.sleep(PROBE_EVERY_S)
             continue
-        name, argv, wall_s, _gate = eligible[0]
+        name, argv, wall_s, _gate = step
         log(f"window open (h2d {h2d:.1f} MB/s); step {name}")
         rec = st.setdefault(name, {"attempts": 0, "done": False})
         rec["attempts"] += 1
