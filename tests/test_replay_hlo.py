@@ -74,8 +74,8 @@ def _fake_cells(poison_fault=True, clean_ok=True):
     ]
 
 
-def _verdict_of(rh, tmp_path, capsys, clean_files, poison_files,
-                poison_fault=True, root="hlo"):
+def _verdict_of(rh, tmp_path, capsys, monkeypatch, clean_files,
+                poison_files, poison_fault=True, root="hlo"):
     import argparse
     import json
 
@@ -85,8 +85,10 @@ def _verdict_of(rh, tmp_path, capsys, clean_files, poison_files,
     for name, body in poison_files.items():
         _write_dump(croot + "_poisoned", name, body)
     cells = _fake_cells(poison_fault)
-    rh.run_cell = lambda name, stages, dump_dir, chunk_rows, wall_s: \
-        cells[0] if name == "clean" else cells[1]
+    monkeypatch.setattr(
+        rh, "run_cell",
+        lambda name, stages, dump_dir, chunk_rows, wall_s:
+        cells[0] if name == "clean" else cells[1])
     args = argparse.Namespace(chunk_rows=8, wall_s=1.0, dump_root=croot)
     rh._main_locked(args)
     out = capsys.readouterr().out
@@ -97,8 +99,8 @@ def _verdict_of(rh, tmp_path, capsys, clean_files, poison_files,
 F = "module_0001.jit__hashed_replay_epochs.1.tpu_after_optimizations.txt"
 
 
-def test_verdict_runtime_state(rh, tmp_path, capsys):
-    v = _verdict_of(rh, tmp_path, capsys,
+def test_verdict_runtime_state(rh, tmp_path, capsys, monkeypatch):
+    v = _verdict_of(rh, tmp_path, capsys, monkeypatch,
                     {F: "ROOT %a.1 = f32[] constant(1.25)\n"},
                     {F: "ROOT %a.9 = f32[] constant(1.25)\n"})
     assert v["hlo_identical"] is True
@@ -106,8 +108,8 @@ def test_verdict_runtime_state(rh, tmp_path, capsys):
     assert v["value"] == 1 and v["poisoned_fault"] is True
 
 
-def test_verdict_program_content(rh, tmp_path, capsys):
-    v = _verdict_of(rh, tmp_path, capsys,
+def test_verdict_program_content(rh, tmp_path, capsys, monkeypatch):
+    v = _verdict_of(rh, tmp_path, capsys, monkeypatch,
                     {F: "ROOT %a.1 = f32[] constant(1.25)\n"},
                     {F: "ROOT %a.1 = f32[] constant(1.5)\n"})
     assert v["hlo_identical"] is False
@@ -115,9 +117,9 @@ def test_verdict_program_content(rh, tmp_path, capsys):
     assert v["differing_modules"]
 
 
-def test_verdict_module_set_mismatch_and_inconclusive(rh, tmp_path, capsys):
+def test_verdict_module_set_mismatch_and_inconclusive(rh, tmp_path, capsys, monkeypatch):
     extra = "module_0002.jit_replay_extra.2.tpu_after_optimizations.txt"
-    v = _verdict_of(rh, tmp_path, capsys,
+    v = _verdict_of(rh, tmp_path, capsys, monkeypatch,
                     {F: "ROOT %a.1 = f32[] add\n"},
                     {F: "ROOT %a.7 = f32[] add\n",
                      extra: "ROOT %b.1 = f32[] mul\n"})
@@ -125,6 +127,20 @@ def test_verdict_module_set_mismatch_and_inconclusive(rh, tmp_path, capsys):
     assert v["verdict"].startswith("module-set-mismatch")
     assert v["modules_only_poisoned"]
 
-    v2 = _verdict_of(rh, tmp_path, capsys, {}, {}, root="hlo_empty")
+    v2 = _verdict_of(rh, tmp_path, capsys, monkeypatch, {}, {},
+                     root="hlo_empty")
     assert v2["verdict"].startswith("inconclusive")
     assert v2["value"] == 1, "inconclusive must still bank (nonzero value)"
+
+
+def test_verdict_not_reproduced_still_consistent(rh, tmp_path, capsys,
+                                                 monkeypatch):
+    """A window where the poison cell happens NOT to fault must still bank
+    an interpretable verdict (identical HLO => consistent-with-runtime-state
+    wording), not a false 'runtime-state' claim."""
+    v = _verdict_of(rh, tmp_path, capsys, monkeypatch,
+                    {F: "ROOT %a.1 = f32[] add\n"},
+                    {F: "ROOT %a.5 = f32[] add\n"},
+                    poison_fault=False, root="hlo_norepro")
+    assert v["hlo_identical"] is True and v["poisoned_fault"] is False
+    assert v["verdict"].startswith("fault not reproduced")

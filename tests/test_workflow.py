@@ -735,3 +735,22 @@ def test_owjoin_routes_all_three_regimes(session):
     # dimension join refuses the duplicate-key right side
     with pytest.raises(ValueError, match="duplicate keys"):
         run(on="k", how="left")
+
+
+def test_owparquetreader_loads_table(session, tmp_path):
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from orange3_spark_tpu.widgets.catalog import WIDGET_REGISTRY
+
+    p = str(tmp_path / "d.parquet")
+    pq.write_table(pa.table({
+        "x": np.arange(10, dtype=np.float32),
+        "cls": pa.array(["a", "b"] * 5).dictionary_encode(),
+    }), p)
+    w = WIDGET_REGISTRY["OWParquetReader"](path=p, class_col="cls")
+    t = w.process()["data"]
+    assert t.n_rows == 10
+    assert [v.name for v in t.domain.attributes] == ["x"]
+    assert t.domain.class_vars[0].values == ("a", "b")
