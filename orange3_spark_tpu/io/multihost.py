@@ -18,7 +18,8 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-__all__ = ["put_sharded", "process_row_slice", "shard_paths"]
+__all__ = ["put_sharded", "process_row_slice", "shard_paths",
+           "shard_row_groups"]
 
 
 def put_sharded(local: np.ndarray, sharding, *, force_global: bool = False):
@@ -54,3 +55,15 @@ def shard_paths(paths) -> list[str]:
     reads (round-robin by process index — balanced when file sizes are)."""
     pc, pi = jax.process_count(), jax.process_index()
     return [p for j, p in enumerate(sorted(paths)) if j % pc == pi]
+
+
+def shard_row_groups(path: str) -> list[int]:
+    """SINGLE-file parquet splitting: the row-group indices THIS process
+    should stream — Spark's parquet input splits, reduced to arithmetic.
+    Contiguous ranges (not round-robin) so each process's reads stay
+    sequential on disk. Pass the result to
+    ``io.streaming.parquet_raw_chunk_source(..., row_groups=...)``."""
+    import pyarrow.parquet as pq
+
+    sl = process_row_slice(pq.read_metadata(path).num_row_groups)
+    return list(range(sl.start, sl.stop))

@@ -100,7 +100,7 @@ def csv_raw_chunk_source(
 
 def parquet_chunk_source(
     path: str, class_col: str = "", *, chunk_rows: int = 1 << 20,
-    columns: tuple | None = None,
+    columns: tuple | None = None, row_groups: tuple | None = None,
 ) -> Callable[[], Iterator[Chunk]]:
     """Re-iterable chunk source over a parquet file, read ROW-GROUP-AT-A-
     TIME — the out-of-core ingest regime was CSV-only through round 4
@@ -111,7 +111,10 @@ def parquet_chunk_source(
     memory stays bounded by the row-group size however large the file is;
     ``io/readers.py:read_parquet`` remains the whole-file path for tables
     that fit. Yields ``(X [n,d] f32, y [n] f32 | None)`` with ``class_col``
-    split out; returns a zero-arg callable (epochs restart the stream)."""
+    split out; returns a zero-arg callable (epochs restart the stream).
+    ``row_groups`` restricts the stream to those group indices — pass
+    ``io.multihost.shard_row_groups(path)`` for single-file multihost
+    ingest (Spark's parquet input splits)."""
     import pyarrow.parquet as pq
 
     def open_stream() -> Iterator[Chunk]:
@@ -126,7 +129,10 @@ def parquet_chunk_source(
                         f"class_col {class_col!r} not in {names}")
                 ci = names.index(class_col)
             for batch in pf.iter_batches(batch_size=chunk_rows,
-                                         columns=names):
+                                         columns=names,
+                                         row_groups=list(row_groups)
+                                         if row_groups is not None
+                                         else None):
                 cols = [
                     batch.column(j).to_numpy(zero_copy_only=False)
                     .astype(np.float32, copy=False)
@@ -142,12 +148,14 @@ def parquet_chunk_source(
 
 def parquet_raw_chunk_source(
     path: str, *, chunk_rows: int = 1 << 20, columns: tuple | None = None,
+    row_groups: tuple | None = None,
 ) -> Callable[[], Iterator[np.ndarray]]:
     """Parquet twin of ``csv_raw_chunk_source``: RAW [n, ncols] f32 chunks
     with no host-side label split, for estimators' ``label_in_chunk`` mode
     (the label column is sliced inside the jit). Row-group-at-a-time like
     ``parquet_chunk_source``, so the 1B-row streaming/spill path works
-    from parquet exactly as from CSV."""
+    from parquet exactly as from CSV; ``row_groups`` +
+    ``io.multihost.shard_row_groups`` give single-file multihost ingest."""
     import pyarrow.parquet as pq
 
     def open_stream() -> Iterator[np.ndarray]:
@@ -155,7 +163,10 @@ def parquet_raw_chunk_source(
         try:
             for batch in pf.iter_batches(batch_size=chunk_rows,
                                          columns=list(columns)
-                                         if columns else None):
+                                         if columns else None,
+                                         row_groups=list(row_groups)
+                                         if row_groups is not None
+                                         else None):
                 yield np.column_stack([
                     batch.column(j).to_numpy(zero_copy_only=False)
                     .astype(np.float32, copy=False)
