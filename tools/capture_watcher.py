@@ -126,7 +126,10 @@ def probe() -> tuple[str, float]:
                                 stderr=subprocess.DEVNULL, text=True,
                                 cwd=REPO, start_new_session=True)
         try:
-            out, _ = proc.communicate(timeout=120)
+            # 90 s, not more: a WEDGED probe burns this whole timeout (+30 s
+            # drain) HOLDING the device lock, and the bench contract test's
+            # bounded lock wait (150 s) must always span one probe's release
+            out, _ = proc.communicate(timeout=90)
         except subprocess.TimeoutExpired:
             _kill_group(proc)
             write_tunnel_status("wedged", source="watcher")
