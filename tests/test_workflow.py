@@ -754,3 +754,38 @@ def test_owparquetreader_loads_table(session, tmp_path):
     assert t.n_rows == 10
     assert [v.name for v in t.domain.attributes] == ["x"]
     assert t.domain.class_vars[0].values == ("a", "b")
+
+
+def test_render_svg_and_html(session, tmp_path):
+    """The headless canvas's visual artifact (workflow/render.py): every
+    node and edge appears, params show, both formats save."""
+    from orange3_spark_tpu.workflow.render import (
+        render_svg, save_workflow_view,
+    )
+
+    iris = load_iris(session)
+    g = WorkflowGraph()
+    src = g.add(OWTable(iris))
+    sc = g.add(WIDGET_REGISTRY["OWStandardScaler"]())
+    lr = g.add(WIDGET_REGISTRY["OWLogisticRegression"](max_iter=123))
+    ap = g.add(OWApplyModel())
+    g.connect(src, "data", sc, "data")
+    g.connect(sc, "data", lr, "data")
+    g.connect(lr, "model", ap, "model")
+    g.connect(sc, "data", ap, "data")
+
+    svg = render_svg(g)
+    assert svg.startswith("<svg") and svg.endswith("</svg>")
+    for name in ("OWTable", "OWStandardScaler", "OWLogisticRegression",
+                 "OWApplyModel"):
+        assert name in svg
+    assert "max_iter=123" in svg          # non-default param surfaces
+    assert svg.count('marker-end="url(#arrow)"') == 4  # one curve per edge
+    assert "model" in svg                 # port label
+
+    out_html = tmp_path / "wf.html"
+    save_workflow_view(g, str(out_html), title="demo <wf>")
+    txt = out_html.read_text()
+    assert txt.startswith("<!doctype html>") and "demo &lt;wf&gt;" in txt
+    save_workflow_view(g, str(tmp_path / "wf.svg"))
+    assert (tmp_path / "wf.svg").read_text().startswith("<svg")
