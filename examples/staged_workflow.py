@@ -53,6 +53,13 @@ def main() -> None:
         print(f"{tag}: cluster sizes {sizes.tolist()}")
         assert min(sizes) > 500  # three real clusters were found
 
+    # a picture of what just ran (workflow/render.py — no Qt, no graphviz)
+    from orange3_spark_tpu.workflow.render import save_workflow_view
+
+    save_workflow_view(g, "/tmp/staged_workflow.html",
+                       title="staged_workflow example")
+    print("workflow view written to /tmp/staged_workflow.html")
+
 
 if __name__ == "__main__":
     main()

@@ -247,6 +247,125 @@ def array_chunk_source(X: np.ndarray, y: np.ndarray | None = None,
     return open_stream
 
 
+@partial(jax.jit, static_argnames=("gramian",), donate_argnums=(0,))
+def _feature_stats_step(acc, X, w, *, gramian: bool):
+    """Fold one padded chunk into the running per-column stats (and the
+    weighted Gramian when asked — an MXU matmul per chunk). Moments
+    accumulate on Z = X - shift (shift ≈ the data's column means, taken
+    from the first chunk): the single-pass identity var = E[z²] - E[z]²
+    is catastrophically cancellative in f32 when mean² ≫ var (epoch
+    timestamps: mean ~1.5e9, std ~1e5 — ss would retain ZERO variance
+    bits unshifted), and near-zero-mean Z restores the lost precision.
+    min/max stay on the raw X."""
+    live = (w > 0)[:, None]
+    Z = X - acc["shift"][None, :]
+    wZ = Z * w[:, None]
+    big = jnp.float32(np.finfo(np.float32).max)
+    out = {
+        "shift": acc["shift"],
+        "n": acc["n"] + jnp.sum(w),
+        "s": acc["s"] + jnp.sum(wZ, axis=0),
+        "ss": acc["ss"] + jnp.sum(wZ * Z, axis=0),
+        "mn": jnp.minimum(acc["mn"],
+                          jnp.min(jnp.where(live, X, big), axis=0)),
+        "mx": jnp.maximum(acc["mx"],
+                          jnp.max(jnp.where(live, X, -big), axis=0)),
+    }
+    if gramian:
+        out["g"] = acc["g"] + Z.T @ wZ
+    return out
+
+
+@jax.jit
+def _first_chunk_shift(X, w):
+    """Weighted column means of the first chunk — the accumulation shift
+    (any vector near the data's location works; all-dead chunk -> 0)."""
+    tot = jnp.sum(w)
+    s = jnp.sum(X * w[:, None], axis=0)
+    return jnp.where(tot > 0, s / jnp.maximum(tot, 1e-12), 0.0)
+
+
+def stream_feature_stats(source: Callable[[], Iterator[Chunk]],
+                         *, session: TpuSession | None = None,
+                         chunk_rows: int = 1 << 18,
+                         gramian: bool = False) -> dict:
+    """Single-pass per-column statistics over a chunk stream — the
+    out-of-core fit for the feature transformers and PCA (BASELINE
+    config 5 is KMeans + PCA at 1B TAXI rows: StreamingKMeans existed,
+    but scaler/PCA fits were in-memory only — a 1B-row pipeline could
+    not be fitted end to end before this).
+
+    One jitted fold per chunk (donated accumulator, so the running stats
+    never leave HBM; ``gramian=True`` adds one [chunk,d]ᵀ@[chunk,d] MXU
+    matmul per chunk for PCA); parse/pad/DMA of chunk t+1 overlaps the
+    device fold of chunk t via ``prefetch_map``; accumulation is shifted
+    by the first chunk's column means (see ``_feature_stats_step``) so
+    f32 keeps its precision on large-mean columns. Returns host floats:
+    ``count`` (total weight), ``mean``, ``var`` (population, the MLlib
+    standardization convention — the same quantity
+    ``ops.stats.weighted_moments`` computes), ``min``/``max`` over live
+    rows, and with ``gramian=True`` the population ``cov``
+    (E[(x-μ)(x-μ)ᵀ]) and raw ``second_moment`` (E[x·xᵀ])."""
+    session = session or TpuSession.builder_get_or_create()
+    pad_rows = session.pad_rows(chunk_rows)
+    row_sh = session.row_sharding
+    vec_sh = session.vector_sharding
+
+    def prep(chunk):
+        X_np, _, w_np = chunk
+        n_features = X_np.shape[1]
+        Xp, _, wp = _pad_chunk(X_np, None, w_np, pad_rows, n_features)
+        return put_sharded(Xp, row_sh), put_sharded(wp, vec_sh)
+
+    acc = None
+    for step, (Xd, wd) in enumerate(
+            prefetch_map(prep, _rechunk(source(), pad_rows), depth=2)):
+        if acc is None:
+            n_features = Xd.shape[1]
+            big = np.float32(np.finfo(np.float32).max)
+            acc = {
+                "shift": _first_chunk_shift(Xd, wd),
+                "n": jnp.zeros((), jnp.float32),
+                "s": jnp.zeros((n_features,), jnp.float32),
+                "ss": jnp.zeros((n_features,), jnp.float32),
+                "mn": jnp.full((n_features,), big, jnp.float32),
+                "mx": jnp.full((n_features,), -big, jnp.float32),
+                **({"g": jnp.zeros((n_features, n_features), jnp.float32)}
+                   if gramian else {}),
+            }
+        acc = _feature_stats_step(acc, Xd, wd, gramian=gramian)
+        bound_dispatch(step + 1, acc["n"], period=8)
+    if acc is None:
+        raise ValueError("stream produced no chunks")
+    n = np.maximum(np.float32(jax.device_get(acc["n"])),
+                   np.float32(1e-12))
+    shift = np.asarray(jax.device_get(acc["shift"]), np.float64)
+    mean_z = np.asarray(jax.device_get(acc["s"]), np.float64) / n
+    var = np.maximum(
+        np.asarray(jax.device_get(acc["ss"]), np.float64) / n - mean_z ** 2,
+        0.0)
+    out = {
+        "count": float(n),
+        "mean": (shift + mean_z).astype(np.float32),
+        "var": var.astype(np.float32),
+        "min": np.asarray(jax.device_get(acc["mn"])),
+        "max": np.asarray(jax.device_get(acc["mx"])),
+    }
+    if gramian:
+        # Gz/n = E[z zᵀ]; centered cov is shift-invariant:
+        #   cov = E[z zᵀ] - μz μzᵀ
+        # and the raw second moment restores the shift:
+        #   E[x xᵀ] = E[z zᵀ] + c μzᵀ + μz cᵀ + c cᵀ
+        Ezz = np.asarray(jax.device_get(acc["g"]), np.float64) / n
+        cov = Ezz - np.outer(mean_z, mean_z)
+        out["cov"] = cov.astype(np.float32)
+        out["second_moment"] = (
+            Ezz + np.outer(shift, mean_z) + np.outer(mean_z, shift)
+            + np.outer(shift, shift)
+        ).astype(np.float32)
+    return out
+
+
 @dataclasses.dataclass(frozen=True)
 class StreamingLinearParams(Params):
     loss: str = "logistic"       # 'logistic' | 'squared' | 'squared_hinge'

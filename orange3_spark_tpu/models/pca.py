@@ -80,7 +80,10 @@ class PCA(Estimator):
         if p.k > table.n_attrs:
             raise ValueError(f"k={p.k} exceeds n_features={table.n_attrs}")
         G, mean, tot = distributed_gramian(table.X, table.W, center=p.center)
-        cov = G / tot
+        return self._finalize(G / tot, mean)
+
+    def _finalize(self, cov, mean) -> PCAModel:
+        p = self.params
         eigvals, eigvecs = jnp.linalg.eigh(cov)   # ascending
         order = jnp.argsort(eigvals)[::-1][: p.k]
         components = eigvecs[:, order]
@@ -89,3 +92,22 @@ class PCA(Estimator):
         if not p.center:
             mean = jnp.zeros_like(mean)
         return PCAModel(p, components, mean, explained, total)
+
+    def fit_stream(self, source, *, session=None,
+                   chunk_rows: int = 1 << 18) -> PCAModel:
+        """Out-of-core fit: ONE pass accumulating the (shift-centered)
+        weighted Gramian — one MXU matmul per chunk — plus column means
+        over a chunk stream (io/streaming.stream_feature_stats), then the
+        same eigh finalize as the in-memory path; the 1B-row taxi
+        pipeline's PCA no longer needs the rows in memory."""
+        from orange3_spark_tpu.io.streaming import stream_feature_stats
+
+        st = stream_feature_stats(source, session=session,
+                                  chunk_rows=chunk_rows, gramian=True)
+        if self.params.k > len(st["mean"]):
+            raise ValueError(f"k={self.params.k} exceeds n_features="
+                             f"{len(st['mean'])}")
+        cov = jnp.asarray(
+            st["cov"] if self.params.center else st["second_moment"],
+            jnp.float32)
+        return self._finalize(cov, jnp.asarray(st["mean"], jnp.float32))

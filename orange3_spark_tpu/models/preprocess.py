@@ -100,10 +100,34 @@ class StandardScaler(Estimator):
         idxs = _col_indices(table, p.input_cols)
         Xsel = jnp.take(table.X, idxs, axis=1)
         mean, var, _ = weighted_moments(Xsel, table.W)
-        std = jnp.sqrt(var)
-        scale = jnp.where(std > 1e-12, 1.0 / std, 1.0) if p.with_std else jnp.ones_like(std)
+        return self._finalize(mean, var, jnp.asarray(idxs))
+
+    def _finalize(self, mean, var, idxs) -> StandardScalerModel:
+        p = self.params
+        mean = jnp.asarray(mean, jnp.float32)
+        std = jnp.sqrt(jnp.asarray(var, jnp.float32))
+        scale = jnp.where(std > 1e-12, 1.0 / std, 1.0) if p.with_std \
+            else jnp.ones_like(std)
         shift = mean if p.with_mean else jnp.zeros_like(mean)
-        return StandardScalerModel(p, jnp.asarray(idxs), shift, scale)
+        return StandardScalerModel(p, idxs, shift, scale)
+
+    def fit_stream(self, source, *, session=None,
+                   chunk_rows: int = 1 << 18) -> StandardScalerModel:
+        """Out-of-core fit: ONE pass of per-column moments over a chunk
+        stream (io/streaming.stream_feature_stats) — same population-
+        variance convention as the in-memory fit, at any row count. The
+        stream's columns are the features (``input_cols`` must be unset;
+        select columns in the source)."""
+        if self.params.input_cols is not None:
+            raise ValueError("fit_stream scales every stream column; "
+                             "select columns in the source instead of "
+                             "input_cols")
+        from orange3_spark_tpu.io.streaming import stream_feature_stats
+
+        st = stream_feature_stats(source, session=session,
+                                  chunk_rows=chunk_rows)
+        return self._finalize(st["mean"], st["var"],
+                              jnp.arange(len(st["mean"]), dtype=jnp.int32))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -125,9 +149,29 @@ class MinMaxScaler(Estimator):
         big = jnp.float32(np.finfo(np.float32).max)
         mn = jnp.min(jnp.where(live, Xsel, big), axis=0)
         mx = jnp.max(jnp.where(live, Xsel, -big), axis=0)
-        rng = mx - mn
+        return self._finalize(mn, mx, jnp.asarray(idxs))
+
+    def _finalize(self, mn, mx, idxs) -> "MinMaxScalerModel":
+        p = self.params
+        mn = jnp.asarray(mn, jnp.float32)
+        rng = jnp.asarray(mx, jnp.float32) - mn
         scale = jnp.where(rng > 1e-12, (p.max - p.min) / rng, 0.0)
-        return MinMaxScalerModel(p, jnp.asarray(idxs), mn, scale)
+        return MinMaxScalerModel(p, idxs, mn, scale)
+
+    def fit_stream(self, source, *, session=None,
+                   chunk_rows: int = 1 << 18) -> "MinMaxScalerModel":
+        """Out-of-core fit: one pass of per-column min/max over a chunk
+        stream; see ``StandardScaler.fit_stream`` for the column rule."""
+        if self.params.input_cols is not None:
+            raise ValueError("fit_stream scales every stream column; "
+                             "select columns in the source instead of "
+                             "input_cols")
+        from orange3_spark_tpu.io.streaming import stream_feature_stats
+
+        st = stream_feature_stats(source, session=session,
+                                  chunk_rows=chunk_rows)
+        return self._finalize(st["min"], st["max"],
+                              jnp.arange(len(st["min"]), dtype=jnp.int32))
 
 
 class MinMaxScalerModel(_ColumnScaleModel):

@@ -362,3 +362,67 @@ def test_target_encoder_means_smoothing_and_unseen(session):
     mk = TargetEncoder(input_cols=("c",), handle_invalid="keep").fit(t)
     enc_k = np.asarray(mk.transform(t2).X)[:6, 0]
     np.testing.assert_allclose(enc_k[0], prior, rtol=1e-5)
+
+
+def test_scalers_and_pca_fit_stream_match_in_memory(session):
+    """The out-of-core transformer fits (one-pass moments / min-max /
+    Gramian over a chunk stream) must reproduce the in-memory fits —
+    config 5 at 1B rows needs scaler+PCA fitted without the rows in
+    memory (round-5 addition)."""
+    import numpy as np
+
+    from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.pca import PCA
+    from orange3_spark_tpu.models.preprocess import (
+        MinMaxScaler, StandardScaler,
+    )
+
+    rng = np.random.default_rng(5)
+    X = (rng.standard_normal((5000, 6)) @ rng.standard_normal((6, 6))
+         ).astype(np.float32) + rng.uniform(-2, 3, 6).astype(np.float32)
+    # a large-mean column (timestamp-shaped: mean 1e7, std ~100) — the
+    # single-pass var identity loses ALL variance bits in f32 unless the
+    # accumulation is shifted (round-5 review finding)
+    X[:, 0] = 1e7 + 100.0 * rng.standard_normal(5000).astype(np.float32)
+    w = rng.uniform(0.0, 2.0, 5000).astype(np.float32)
+    w[::17] = 0.0                       # dead rows must not count
+    dom = Domain([ContinuousVariable(f"f{i}") for i in range(6)])
+    t = TpuTable.from_numpy(dom, X, W=w, session=session)
+    src = array_chunk_source(X, None, w, chunk_rows=700)  # odd chunking
+
+    sc_mem = StandardScaler(with_mean=True).fit(t)
+    sc_st = StandardScaler(with_mean=True).fit_stream(
+        src, session=session, chunk_rows=1024)
+    np.testing.assert_allclose(np.asarray(sc_st.shift),
+                               np.asarray(sc_mem.shift), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(sc_st.scale),
+                               np.asarray(sc_mem.scale), rtol=2e-4)
+
+    mm_mem = MinMaxScaler().fit(t)
+    mm_st = MinMaxScaler().fit_stream(src, session=session, chunk_rows=1024)
+    np.testing.assert_allclose(np.asarray(mm_st.shift),
+                               np.asarray(mm_mem.shift), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(mm_st.scale),
+                               np.asarray(mm_mem.scale), rtol=1e-5)
+
+    pca_mem = PCA(k=3).fit(t)
+    pca_st = PCA(k=3).fit_stream(src, session=session, chunk_rows=1024)
+    np.testing.assert_allclose(np.asarray(pca_st.explained_variance),
+                               np.asarray(pca_mem.explained_variance),
+                               rtol=2e-3)
+    # components match up to per-column sign
+    Cm, Cs = np.asarray(pca_mem.components), np.asarray(pca_st.components)
+    sign = np.sign(np.sum(Cm * Cs, axis=0))
+    np.testing.assert_allclose(Cs * sign, Cm, atol=2e-3)
+    # and the projected output agrees on real data (tolerance scaled to
+    # the projection magnitude: the large-mean column makes PC1 span
+    # O(100), and f32 quantization of the 1e7 mean injects O(1) offsets
+    # into BOTH fits' projections)
+    Pm = np.asarray(pca_mem.transform(t).X)
+    Ps = np.asarray(pca_st.transform(t).X) * sign
+    np.testing.assert_allclose(Ps, Pm, atol=3e-3 * float(np.abs(Pm).max()))
+
+    with pytest.raises(ValueError, match="input_cols"):
+        StandardScaler(input_cols=("f0",)).fit_stream(src, session=session)
