@@ -276,6 +276,30 @@ def _feature_stats_step(acc, X, w, *, gramian: bool):
     return out
 
 
+@partial(jax.jit, static_argnames=("nan_missing",), donate_argnums=(0,))
+def _feature_stats_step_missing(acc, X, w, mv, *, nan_missing: bool):
+    """Missing-aware fold (the streaming Imputer fit): per-CELL
+    observation masks — a missing cell drops out of that column's
+    count/sum/min/max without killing the row for other columns. Same
+    shifted accumulation as ``_feature_stats_step``."""
+    miss = jnp.isnan(X) if nan_missing else (X == mv)
+    obs = (~miss) & (w > 0)[:, None]
+    Z = jnp.where(obs, X - acc["shift"][None, :], 0.0)
+    wobs = jnp.where(obs, w[:, None], 0.0)
+    wZ = Z * wobs
+    big = jnp.float32(np.finfo(np.float32).max)
+    return {
+        "shift": acc["shift"],
+        "n": acc["n"] + jnp.sum(wobs, axis=0),
+        "s": acc["s"] + jnp.sum(wZ, axis=0),
+        "ss": acc["ss"] + jnp.sum(wZ * Z, axis=0),
+        "mn": jnp.minimum(acc["mn"],
+                          jnp.min(jnp.where(obs, X, big), axis=0)),
+        "mx": jnp.maximum(acc["mx"],
+                          jnp.max(jnp.where(obs, X, -big), axis=0)),
+    }
+
+
 @jax.jit
 def _first_chunk_shift(X, w):
     """Weighted column means of the first chunk — the accumulation shift
@@ -285,10 +309,24 @@ def _first_chunk_shift(X, w):
     return jnp.where(tot > 0, s / jnp.maximum(tot, 1e-12), 0.0)
 
 
+@partial(jax.jit, static_argnames=("nan_missing",))
+def _first_chunk_shift_missing(X, w, mv, *, nan_missing: bool):
+    """Missing-aware shift: per-column observed means (a NaN missing
+    value would otherwise poison the plain shift, and a sentinel like
+    -999 would drag it far from the data)."""
+    miss = jnp.isnan(X) if nan_missing else (X == mv)
+    obs = (~miss) & (w > 0)[:, None]
+    wobs = jnp.where(obs, w[:, None], 0.0)
+    tot = jnp.sum(wobs, axis=0)
+    s = jnp.sum(jnp.where(obs, X, 0.0) * wobs, axis=0)
+    return jnp.where(tot > 0, s / jnp.maximum(tot, 1e-12), 0.0)
+
+
 def stream_feature_stats(source: Callable[[], Iterator[Chunk]],
                          *, session: TpuSession | None = None,
                          chunk_rows: int = 1 << 18,
-                         gramian: bool = False) -> dict:
+                         gramian: bool = False,
+                         missing_value: float | None = None) -> dict:
     """Single-pass per-column statistics over a chunk stream — the
     out-of-core fit for the feature transformers and PCA (BASELINE
     config 5 is KMeans + PCA at 1B TAXI rows: StreamingKMeans existed,
@@ -305,7 +343,15 @@ def stream_feature_stats(source: Callable[[], Iterator[Chunk]],
     standardization convention — the same quantity
     ``ops.stats.weighted_moments`` computes), ``min``/``max`` over live
     rows, and with ``gramian=True`` the population ``cov``
-    (E[(x-μ)(x-μ)ᵀ]) and raw ``second_moment`` (E[x·xᵀ])."""
+    (E[(x-μ)(x-μ)ᵀ]) and raw ``second_moment`` (E[x·xᵀ]).
+
+    ``missing_value`` (NaN or a sentinel float) switches to per-CELL
+    observation masks — the streaming Imputer fit: a missing cell leaves
+    that column's count/mean/var/min/max, other columns keep the row.
+    ``count`` is then a per-column array; incompatible with ``gramian``
+    (a Gramian over ragged observations is not the covariance)."""
+    if missing_value is not None and gramian:
+        raise ValueError("gramian=True and missing_value are incompatible")
     session = session or TpuSession.builder_get_or_create()
     pad_rows = session.pad_rows(chunk_rows)
     row_sh = session.row_sharding
@@ -324,8 +370,13 @@ def stream_feature_stats(source: Callable[[], Iterator[Chunk]],
             n_features = Xd.shape[1]
             big = np.float32(np.finfo(np.float32).max)
             acc = {
-                "shift": _first_chunk_shift(Xd, wd),
-                "n": jnp.zeros((), jnp.float32),
+                "shift": (_first_chunk_shift_missing(
+                    Xd, wd, jnp.float32(missing_value),
+                    nan_missing=bool(np.isnan(missing_value)))
+                    if missing_value is not None
+                    else _first_chunk_shift(Xd, wd)),
+                "n": jnp.zeros((n_features,) if missing_value is not None
+                               else (), jnp.float32),
                 "s": jnp.zeros((n_features,), jnp.float32),
                 "ss": jnp.zeros((n_features,), jnp.float32),
                 "mn": jnp.full((n_features,), big, jnp.float32),
@@ -333,30 +384,47 @@ def stream_feature_stats(source: Callable[[], Iterator[Chunk]],
                 **({"g": jnp.zeros((n_features, n_features), jnp.float32)}
                    if gramian else {}),
             }
-        acc = _feature_stats_step(acc, Xd, wd, gramian=gramian)
+        if missing_value is not None:
+            acc = _feature_stats_step_missing(
+                acc, Xd, wd, jnp.float32(missing_value),
+                nan_missing=bool(np.isnan(missing_value)))
+        else:
+            acc = _feature_stats_step(acc, Xd, wd, gramian=gramian)
         bound_dispatch(step + 1, acc["n"], period=8)
     if acc is None:
         raise ValueError("stream produced no chunks")
-    n = np.maximum(np.float32(jax.device_get(acc["n"])),
-                   np.float32(1e-12))
-    shift = np.asarray(jax.device_get(acc["shift"]), np.float64)
-    mean_z = np.asarray(jax.device_get(acc["s"]), np.float64) / n
+    host = jax.device_get(acc)          # ONE blocking transfer, not eight
+    # scalar total weight normally; per-column observed weight under
+    # missing_value — the identical formulas broadcast over both
+    n_raw = np.asarray(host["n"], np.float64)
+    n = np.maximum(n_raw, 1e-12)
+    shift = np.asarray(host["shift"], np.float64)
+    mean_z = np.asarray(host["s"], np.float64) / n
     var = np.maximum(
-        np.asarray(jax.device_get(acc["ss"]), np.float64) / n - mean_z ** 2,
-        0.0)
+        np.asarray(host["ss"], np.float64) / n - mean_z ** 2, 0.0)
+    mean = shift + mean_z
+    if n.ndim:
+        # missing mode: an all-missing column has no mean — fill 0, the
+        # in-memory Imputer's convention (sum 0 over eps weight)
+        dead = n_raw <= 0
+        mean[dead] = 0.0
+        var[dead] = 0.0
     out = {
-        "count": float(n),
-        "mean": (shift + mean_z).astype(np.float32),
+        # the UNCLAMPED weight: an all-missing column / empty stream must
+        # report 0, not the division epsilon
+        "count": float(n_raw) if n_raw.ndim == 0
+        else n_raw.astype(np.float32),
+        "mean": mean.astype(np.float32),
         "var": var.astype(np.float32),
-        "min": np.asarray(jax.device_get(acc["mn"])),
-        "max": np.asarray(jax.device_get(acc["mx"])),
+        "min": np.asarray(host["mn"]),
+        "max": np.asarray(host["mx"]),
     }
     if gramian:
         # Gz/n = E[z zᵀ]; centered cov is shift-invariant:
         #   cov = E[z zᵀ] - μz μzᵀ
         # and the raw second moment restores the shift:
         #   E[x xᵀ] = E[z zᵀ] + c μzᵀ + μz cᵀ + c cᵀ
-        Ezz = np.asarray(jax.device_get(acc["g"]), np.float64) / n
+        Ezz = np.asarray(host["g"], np.float64) / n
         cov = Ezz - np.outer(mean_z, mean_z)
         out["cov"] = cov.astype(np.float32)
         out["second_moment"] = (

@@ -102,11 +102,16 @@ class PCA(Estimator):
         pipeline's PCA no longer needs the rows in memory."""
         from orange3_spark_tpu.io.streaming import stream_feature_stats
 
+        # validate k BEFORE the pass — an invalid k must fail in one chunk,
+        # not after a multi-hour out-of-core Gramian sweep
+        first = next(iter(source()), None)
+        if first is not None:
+            X0 = first[0] if isinstance(first, tuple) else first
+            if self.params.k > X0.shape[1]:
+                raise ValueError(f"k={self.params.k} exceeds n_features="
+                                 f"{X0.shape[1]}")
         st = stream_feature_stats(source, session=session,
                                   chunk_rows=chunk_rows, gramian=True)
-        if self.params.k > len(st["mean"]):
-            raise ValueError(f"k={self.params.k} exceeds n_features="
-                             f"{len(st['mean'])}")
         cov = jnp.asarray(
             st["cov"] if self.params.center else st["second_moment"],
             jnp.float32)

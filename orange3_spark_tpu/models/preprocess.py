@@ -273,6 +273,30 @@ class Imputer(Estimator):
             raise ValueError(f"unknown strategy {p.strategy!r}")
         return ImputerModel(p, jnp.asarray(idxs), fill)
 
+    def fit_stream(self, source, *, session=None,
+                   chunk_rows: int = 1 << 18) -> ImputerModel:
+        """Out-of-core mean-imputer fit: one missing-aware stats pass
+        (per-CELL observation masks — a missing cell drops out of its
+        column only). 'median'/'mode' need a sketch or a value table and
+        stay in-memory; column rule as in ``StandardScaler.fit_stream``."""
+        p = self.params
+        if p.strategy != "mean":
+            raise ValueError(
+                f"fit_stream supports strategy='mean' only (got "
+                f"{p.strategy!r}); median/mode need the rows in memory")
+        if p.input_cols is not None:
+            raise ValueError("fit_stream imputes every stream column; "
+                             "select columns in the source instead of "
+                             "input_cols")
+        from orange3_spark_tpu.io.streaming import stream_feature_stats
+
+        st = stream_feature_stats(source, session=session,
+                                  chunk_rows=chunk_rows,
+                                  missing_value=p.missing_value)
+        fill = jnp.asarray(st["mean"], jnp.float32)
+        return ImputerModel(p, jnp.arange(len(st["mean"]), dtype=jnp.int32),
+                            fill)
+
 
 # ---------------------------------------------------------------------------
 # Discretization & encoding

@@ -426,3 +426,47 @@ def test_scalers_and_pca_fit_stream_match_in_memory(session):
 
     with pytest.raises(ValueError, match="input_cols"):
         StandardScaler(input_cols=("f0",)).fit_stream(src, session=session)
+    # invalid k must fail on the FIRST chunk, not after a full pass
+    with pytest.raises(ValueError, match="exceeds n_features"):
+        PCA(k=10).fit_stream(src, session=session)
+
+
+def test_imputer_fit_stream_matches_in_memory(session):
+    """Missing-aware streaming stats: per-cell masks (NaN and sentinel),
+    all-missing column fills 0 like the in-memory path."""
+    import numpy as np
+
+    from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.preprocess import Imputer
+
+    rng = np.random.default_rng(8)
+    X = rng.normal(50.0, 5.0, (3000, 4)).astype(np.float32)
+    X[rng.random((3000, 4)) < 0.3] = np.nan   # 30% missing cells
+    X[:, 3] = np.nan                           # an all-missing column
+    dom = Domain([ContinuousVariable(f"f{i}") for i in range(4)])
+    t = TpuTable.from_numpy(dom, X, session=session)
+    src = array_chunk_source(X, chunk_rows=512)
+
+    mem = Imputer().fit(t)
+    st = Imputer().fit_stream(src, session=session, chunk_rows=1024)
+    np.testing.assert_allclose(np.asarray(st.fill), np.asarray(mem.fill),
+                               rtol=1e-5, atol=1e-5)
+    assert float(st.fill[3]) == 0.0
+    out = st.transform(t)
+    assert not np.isnan(np.asarray(out.X)).any()
+
+    # sentinel missing value (-999): the shift must not be dragged by it
+    Xs = X.copy()
+    Xs[np.isnan(Xs)] = -999.0
+    ts = TpuTable.from_numpy(dom, Xs, session=session)
+    mem2 = Imputer(missing_value=-999.0).fit(ts)
+    st2 = Imputer(missing_value=-999.0).fit_stream(
+        array_chunk_source(Xs, chunk_rows=512), session=session,
+        chunk_rows=1024)
+    np.testing.assert_allclose(np.asarray(st2.fill), np.asarray(mem2.fill),
+                               rtol=1e-5, atol=1e-5)
+
+    with pytest.raises(ValueError, match="strategy='mean'"):
+        Imputer(strategy="median").fit_stream(src, session=session)
