@@ -121,3 +121,57 @@ def test_ranking_with_als_recommendations(session):
     truth = np.argsort(-full, axis=1)[:, :10]
     score = RankingEvaluator(metric_name="ndcgAtK", k=10).evaluate(recs, truth)
     assert score > 0.6, score
+
+
+def test_evaluate_binary_stream_matches_in_memory(session):
+    """Streaming binary metrics (binned AUC + exact logloss/accuracy over
+    chunks) vs the in-memory exact-sort evaluator on the same scores —
+    a 1B-row holdout must be scoreable without residency."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from orange3_spark_tpu.core.domain import (
+        ContinuousVariable, DiscreteVariable, Domain,
+    )
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.evaluation import (
+        BinaryClassificationEvaluator, evaluate_binary_stream,
+    )
+
+    rng = np.random.default_rng(11)
+    n = 20_000
+    X = rng.standard_normal((n, 3)).astype(np.float32)
+    logit = 1.3 * X[:, 0] - 0.7 * X[:, 1]
+    prob = 1.0 / (1.0 + np.exp(-logit))
+    y = (rng.random(n) < prob).astype(np.float32)
+    w = rng.uniform(0.2, 1.8, n).astype(np.float32)
+
+    # in-memory exact evaluator on a table carrying the same scores
+    dom = Domain([ContinuousVariable(f"f{i}") for i in range(3)]
+                 + [ContinuousVariable("probability_1")],
+                 DiscreteVariable("y", ("0", "1")))
+    t = TpuTable.from_numpy(dom, np.column_stack([X, prob]), y, W=w,
+                            session=session)
+    auc_mem = BinaryClassificationEvaluator().evaluate(t)
+
+    w_dense = jnp.asarray([1.3, -0.7, 0.0])
+
+    def score_fn(Xd):
+        return 1.0 / (1.0 + jnp.exp(-(Xd @ w_dense)))
+
+    out = evaluate_binary_stream(
+        score_fn, array_chunk_source(X, y, w, chunk_rows=3000),
+        session=session, chunk_rows=4096)
+    assert abs(out["auc"] - float(auc_mem)) < 2e-3, (out["auc"], auc_mem)
+    assert abs(out["count"] - float(w.sum())) < 1.0
+    # exact sums against numpy
+    ll = float(np.sum(w * -(y * np.log(prob) + (1 - y) * np.log1p(-prob)))
+               / w.sum())
+    assert abs(out["logloss"] - ll) < 1e-3
+    acc = float(np.sum(w * ((prob > 0.5) == (y > 0.5))) / w.sum())
+    assert abs(out["accuracy"] - acc) < 1e-3
+
+    with pytest.raises(ValueError, match="labeled"):
+        evaluate_binary_stream(score_fn, array_chunk_source(X, None, w),
+                               session=session)
