@@ -482,3 +482,93 @@ def test_parquet_fit_stream_matches_array_source(session, tmp_path):
         np.asarray(ref.theta["emb"]), np.asarray(spilled.theta["emb"]))
     np.testing.assert_array_equal(
         np.asarray(ref.theta["coef"]), np.asarray(spilled.theta["coef"]))
+
+
+def test_score_stream_writes_parquet(session, tmp_path):
+    """Streaming transform-and-write: scores a chunk stream row-group-at-
+    a-time to parquet (bounded host memory), trims padding, drops masked
+    rows, matches the in-device scores exactly."""
+    import jax.numpy as jnp
+    import pyarrow.parquet as pq
+
+    from orange3_spark_tpu.io.streaming import score_stream
+
+    rng = np.random.default_rng(13)
+    n, d = 5000, 4
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    w = np.ones(n, np.float32)
+    w[::10] = 0.0                      # masked rows must not be written
+    wv = jnp.asarray([1.0, -0.5, 0.25, 0.0])
+
+    def score_fn(Xd):
+        return jax.nn.sigmoid(Xd @ wv)
+
+    import jax
+
+    out = str(tmp_path / "scored.parquet")
+    total = score_stream(score_fn, array_chunk_source(X, y, w, chunk_rows=900),
+                         out, session=session, chunk_rows=1024)
+    live = w > 0
+    assert total == int(live.sum())
+    t = pq.read_table(out)
+    assert t.column_names == [f"f{j}" for j in range(d)] + ["label",
+                                                            "prediction"]
+    got = t.column("prediction").to_numpy()
+    exp = np.asarray(jax.nn.sigmoid(jnp.asarray(X[live]) @ wv))
+    np.testing.assert_allclose(got, exp, rtol=1e-6)
+    np.testing.assert_array_equal(t.column("label").to_numpy(), y[live])
+    np.testing.assert_allclose(t.column("f0").to_numpy(), X[live][:, 0])
+
+    # [n, k] scores fan out into suffixed columns; features skippable
+    def score2(Xd):
+        z = Xd @ wv
+        return jnp.stack([1 - jax.nn.sigmoid(z), jax.nn.sigmoid(z)], axis=1)
+
+    out2 = str(tmp_path / "scored2.parquet")
+    score_stream(score2, array_chunk_source(X, y, w, chunk_rows=900),
+                 out2, session=session, chunk_rows=1024,
+                 include_features=False, prediction_col="probability")
+    t2 = pq.read_table(out2)
+    assert t2.column_names == ["label", "probability_0", "probability_1"]
+
+
+def test_score_stream_edge_cases(session, tmp_path):
+    """All-masked chunks skip cleanly; conflicting args and failed runs
+    leave no partial file behind."""
+    import glob
+
+    import jax
+    import jax.numpy as jnp
+
+    from orange3_spark_tpu.io.streaming import score_stream
+
+    rng = np.random.default_rng(14)
+    X = rng.standard_normal((3000, 3)).astype(np.float32)
+    w = np.ones(3000, np.float32)
+    w[:1024] = 0.0                        # the FIRST rechunked chunk is dead
+
+    def score_fn(Xd):
+        return jax.nn.sigmoid(Xd @ jnp.asarray([1.0, 0.0, -1.0]))
+
+    out = str(tmp_path / "s.parquet")
+    total = score_stream(score_fn, array_chunk_source(X, None, w,
+                                                      chunk_rows=1024),
+                         out, session=session, chunk_rows=1024)
+    assert total == int((w > 0).sum())
+
+    with pytest.raises(ValueError, match="include_features"):
+        score_stream(score_fn, array_chunk_source(X, None, w), out,
+                     session=session, feature_names=("a", "b", "c"),
+                     include_features=False)
+
+    def boom(Xd):
+        raise RuntimeError("mid-stream death")
+
+    with pytest.raises(RuntimeError, match="mid-stream"):
+        score_stream(boom, array_chunk_source(X, None, None,
+                                              chunk_rows=1024),
+                     str(tmp_path / "dead.parquet"), session=session,
+                     chunk_rows=1024)
+    assert not glob.glob(str(tmp_path / "dead.parquet*")), \
+        "failed run must leave no partial file"
