@@ -175,3 +175,74 @@ def test_evaluate_binary_stream_matches_in_memory(session):
     with pytest.raises(ValueError, match="labeled"):
         evaluate_binary_stream(score_fn, array_chunk_source(X, None, w),
                                session=session)
+
+
+def test_evaluate_multiclass_and_regression_stream(session):
+    """Streaming confusion-matrix and regression-moment evaluators vs the
+    in-memory evaluators on the same predictions."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from orange3_spark_tpu.core.domain import (
+        ContinuousVariable, DiscreteVariable, Domain,
+    )
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.evaluation import (
+        MulticlassClassificationEvaluator, RegressionEvaluator,
+        evaluate_multiclass_stream, evaluate_regression_stream,
+    )
+
+    rng = np.random.default_rng(21)
+    n, k = 12_000, 4
+    X = rng.standard_normal((n, 3)).astype(np.float32)
+    y = rng.integers(0, k, n).astype(np.float32)
+    w = rng.uniform(0.5, 1.5, n).astype(np.float32)
+    W3 = jnp.asarray(rng.standard_normal((3, k)), jnp.float32)
+
+    def predict_fn(Xd):
+        return jnp.argmax(Xd @ W3, axis=1).astype(jnp.float32)
+
+    out = evaluate_multiclass_stream(
+        predict_fn, array_chunk_source(X, y, w, chunk_rows=1700),
+        n_classes=k, session=session, chunk_rows=2048)
+    pred = np.asarray(jax.device_get(predict_fn(jnp.asarray(X))))
+    dom = Domain([ContinuousVariable(f"f{i}") for i in range(3)]
+                 + [ContinuousVariable("prediction")],
+                 DiscreteVariable("y", tuple(str(i) for i in range(k))))
+    t = TpuTable.from_numpy(dom, np.column_stack([X, pred]), y, W=w,
+                            session=session)
+    for m in ("accuracy", "f1", "weightedPrecision", "weightedRecall"):
+        mem = MulticlassClassificationEvaluator(metric_name=m).evaluate(t)
+        assert abs(out[m] - mem) < 1e-4, (m, out[m], mem)
+    assert out["confusion"].shape == (k, k)
+    assert out["dropped_weight"] == 0.0
+    # wrong n_classes surfaces as dropped weight, not silent vanishing
+    out_bad = evaluate_multiclass_stream(
+        predict_fn, array_chunk_source(X, y, w, chunk_rows=1700),
+        n_classes=k - 1, session=session, chunk_rows=2048)
+    assert out_bad["dropped_weight"] > 0
+
+    # regression: large-mean labels (fare/timestamp shape) — r2 must
+    # survive the f32 accumulation
+    yr = (1e6 + 500.0 * X[:, 0] + 40.0 *
+          rng.standard_normal(n)).astype(np.float32)
+    wr = jnp.asarray([480.0, 0.0, 0.0])
+
+    def reg_fn(Xd):
+        return 1e6 + Xd @ wr
+
+    ro = evaluate_regression_stream(
+        reg_fn, array_chunk_source(X, yr, w, chunk_rows=1700),
+        session=session, chunk_rows=2048)
+    predr = np.asarray(jax.device_get(reg_fn(jnp.asarray(X))))
+    domr = Domain([ContinuousVariable(f"f{i}") for i in range(3)]
+                  + [ContinuousVariable("prediction")],
+                  ContinuousVariable("y"))
+    tr = TpuTable.from_numpy(domr, np.column_stack([X, predr]), yr, W=w,
+                             session=session)
+    for m in ("rmse", "mse", "mae", "r2"):
+        mem = RegressionEvaluator(metric_name=m).evaluate(tr)
+        assert abs(ro[m] - mem) / max(abs(mem), 1e-6) < 5e-3, (m, ro[m], mem)
+    assert ro["r2"] > 0.9
