@@ -564,3 +564,56 @@ def test_join_host_matches_pandas_all_hows(session):
         exp = canon(exp)
         assert got.shape == exp.shape, (how, got.shape, exp.shape)
         np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+
+hypothesis = pytest.importorskip(
+    "hypothesis")   # optional: baked into the build image, not a package dep
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    n_left=st.integers(1, 40),
+    n_right=st.integers(0, 40),
+    n_keys=st.integers(1, 5),
+    how=st.sampled_from(["inner", "left", "outer"]),
+    seed=st.integers(0, 10_000),
+)
+def test_join_host_property_vs_pandas(session, n_left, n_right, n_keys,
+                                      how, seed):
+    """join_host == pandas.merge on random key distributions (duplicate
+    keys, dead rows, empty right side), every how."""
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    vals = tuple(f"k{i}" for i in range(n_keys))
+    lk = rng.integers(0, n_keys, n_left).astype(np.float32)
+    lv = rng.normal(0, 1, n_left).astype(np.float32).round(3)
+    lw = (rng.random(n_left) > 0.2).astype(np.float32)
+    rk = rng.integers(0, n_keys, n_right).astype(np.float32)
+    rv = rng.normal(0, 1, n_right).astype(np.float32).round(3)
+    rw = (rng.random(n_right) > 0.2).astype(np.float32)
+
+    left = TpuTable.from_numpy(
+        Domain([DiscreteVariable("k", vals), ContinuousVariable("a")]),
+        np.stack([lk, lv], 1), W=lw, session=session)
+    right = TpuTable.from_numpy(
+        Domain([DiscreteVariable("k", vals), ContinuousVariable("b")]),
+        np.stack([rk, rv], 1), W=rw, session=session)
+
+    from orange3_spark_tpu.ops.relational import join_host
+
+    out = join_host(left, right, "k", how=how)
+    X, _, W = out.to_numpy()
+    got = X[W > 0]
+
+    ldf = pd.DataFrame({"k": lk[lw > 0].astype(int), "a": lv[lw > 0]})
+    rdf = pd.DataFrame({"k": rk[rw > 0].astype(int), "b": rv[rw > 0]})
+    exp = ldf.merge(rdf, on="k", how=how)
+    assert len(got) == len(exp)
+    canon = lambda arr: np.asarray(
+        sorted(map(tuple, np.where(np.isnan(arr), -1e9, arr))))
+    exp_arr = np.stack([exp["k"].to_numpy(float), exp["a"].to_numpy(float),
+                        exp["b"].to_numpy(float)], 1)
+    if len(got):
+        np.testing.assert_allclose(canon(got), canon(exp_arr), rtol=1e-5)
