@@ -617,3 +617,57 @@ def test_join_host_property_vs_pandas(session, n_left, n_right, n_keys,
                         exp["b"].to_numpy(float)], 1)
     if len(got):
         np.testing.assert_allclose(canon(got), canon(exp_arr), rtol=1e-5)
+
+
+@settings(max_examples=20, deadline=None)
+@given(
+    n_left=st.integers(1, 30),
+    n_right=st.integers(0, 24),
+    n_keys=st.integers(1, 4),
+    how=st.sampled_from(["inner", "left"]),
+    seed=st.integers(0, 10_000),
+)
+def test_join_expand_agrees_with_join_host(session, n_left, n_right,
+                                           n_keys, how, seed):
+    """The device bounded-fan-out join and the host sort-merge are two
+    implementations of the same equi-join: on data within the bound they
+    must produce the same live multiset of rows (and the same combined
+    weights)."""
+    from orange3_spark_tpu.ops.relational import join_expand, join_host
+
+    rng = np.random.default_rng(seed)
+    vals = tuple(f"k{i}" for i in range(n_keys))
+    lk = rng.integers(0, n_keys, n_left).astype(np.float32)
+    lv = rng.normal(0, 1, n_left).astype(np.float32).round(3)
+    lw = np.where(rng.random(n_left) > 0.2,
+                  rng.uniform(0.5, 2.0, n_left), 0.0).astype(np.float32)
+    rk = rng.integers(0, n_keys, n_right).astype(np.float32)
+    rv = rng.normal(0, 1, n_right).astype(np.float32).round(3)
+    rw = np.where(rng.random(n_right) > 0.2,
+                  rng.uniform(0.5, 2.0, n_right), 0.0).astype(np.float32)
+
+    left = TpuTable.from_numpy(
+        Domain([DiscreteVariable("k", vals), ContinuousVariable("a")]),
+        np.stack([lk, lv], 1), W=lw, session=session)
+    right = TpuTable.from_numpy(
+        Domain([DiscreteVariable("k", vals), ContinuousVariable("b")]),
+        np.stack([rk, rv], 1), W=rw, session=session)
+
+    # bound = the actual max multiplicity (live right rows per key)
+    live_rk = rk[rw > 0].astype(int)
+    bound = max(1, int(np.bincount(live_rk, minlength=n_keys).max())
+                if len(live_rk) else 1)
+
+    ex = join_expand(left, right, "k", max_matches=bound, how=how)
+    ho = join_host(left, right, "k", how=how)
+
+    def live_rows(t):
+        X, _, W = t.to_numpy()
+        rows = np.column_stack([X, W])[W > 0]
+        return np.asarray(sorted(map(tuple,
+                                     np.where(np.isnan(rows), -1e9, rows))))
+
+    a, b = live_rows(ex), live_rows(ho)
+    assert a.shape == b.shape, (a.shape, b.shape)
+    if len(a):
+        np.testing.assert_allclose(a, b, rtol=1e-5)
