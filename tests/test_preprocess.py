@@ -470,3 +470,46 @@ def test_imputer_fit_stream_matches_in_memory(session):
 
     with pytest.raises(ValueError, match="strategy='mean'"):
         Imputer(strategy="median").fit_stream(src, session=session)
+
+
+def test_stream_feature_stats_chunking_invariance(session):
+    """Property: the streaming stats are independent of source chunking
+    and match the in-memory moments, across random weights/means/sizes."""
+    hypothesis = pytest.importorskip("hypothesis")
+    from hypothesis import given, settings, strategies as st
+
+    from orange3_spark_tpu.io.streaming import (
+        array_chunk_source, stream_feature_stats,
+    )
+    from orange3_spark_tpu.ops.stats import weighted_moments
+
+    import jax.numpy as jnp
+
+    @settings(max_examples=15, deadline=None)
+    @given(n=st.integers(10, 3000), d=st.integers(1, 6),
+           src_chunk=st.integers(7, 700), dev_chunk=st.integers(64, 1024),
+           mean_scale=st.sampled_from([0.0, 1.0, 1e4]),
+           seed=st.integers(0, 9999))
+    def prop(n, d, src_chunk, dev_chunk, mean_scale, seed):
+        rng = np.random.default_rng(seed)
+        X = (rng.standard_normal((n, d)) * rng.uniform(0.5, 3.0, d)
+             + mean_scale * rng.uniform(-1, 1, d)).astype(np.float32)
+        w = np.where(rng.random(n) > 0.15,
+                     rng.uniform(0.1, 2.0, n), 0.0).astype(np.float32)
+        if not (w > 0).any():
+            w[0] = 1.0
+        st_out = stream_feature_stats(
+            array_chunk_source(X, None, w, chunk_rows=src_chunk),
+            session=session, chunk_rows=dev_chunk)
+        mean, var, tot = weighted_moments(jnp.asarray(X), jnp.asarray(w))
+        np.testing.assert_allclose(st_out["count"], float(tot), rtol=1e-5)
+        scale = max(mean_scale, 1.0)
+        np.testing.assert_allclose(st_out["mean"], np.asarray(mean),
+                                   rtol=1e-4, atol=1e-4 * scale)
+        np.testing.assert_allclose(st_out["var"], np.asarray(var),
+                                   rtol=5e-3, atol=1e-5)
+        live = w > 0
+        np.testing.assert_allclose(st_out["min"], X[live].min(0), rtol=1e-6)
+        np.testing.assert_allclose(st_out["max"], X[live].max(0), rtol=1e-6)
+
+    prop()
