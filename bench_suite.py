@@ -307,13 +307,91 @@ def bench_taxi_pipeline(scale: float) -> dict:
     }
 
 
+# ------------------------------------------------- dispatch-overhead bench
+def bench_dispatch_overhead(scale: float) -> dict:
+    """Epoch-batching microbench (exec/ subsystem): the same cached-replay
+    fit at epochs_per_dispatch K in {1, 4, 16} — one ``n_epochs=K`` scan
+    per dispatch, so the replay's dispatch count drops K-fold while the
+    step sequence stays bit-identical — the JSON's theta_max_abs_diff
+    reports the measured cross-K embedding-table divergence (0.0 expected;
+    the hard gate lives in tests/test_exec_pipeline.py's parity test).
+    On tunneled hosts each dispatch costs ~an RTT, so the K=16
+    wall is the amortization ceiling this knob buys; on CPU the deltas
+    bound the pure dispatch overhead. One JSON line, sweep inline."""
+    import jax
+    import numpy as np
+
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+    from orange3_spark_tpu.utils.profiling import (
+        exec_counters, reset_exec_counters,
+    )
+
+    n_rows = max(1 << 17, int((1 << 17) * scale))
+    n_dense, n_cat, dims = 4, 8, 1 << 14
+    chunk = 1 << 14
+    epochs = 33          # 32 replay epochs: divisible by every swept K
+    session = TpuSession.builder_get_or_create()
+    rng = np.random.default_rng(7)
+    dense = rng.standard_normal((n_rows, n_dense)).astype(np.float32)
+    cats = rng.integers(0, 1000, (n_rows, n_cat)).astype(np.float32)
+    y = (dense[:, 0] + 0.3 * rng.standard_normal(n_rows) > 0
+         ).astype(np.float32)
+    Xall = np.concatenate([dense, cats], axis=1)
+
+    sweep = {}
+    theta_ref = None
+    max_diff = 0.0
+    for K in (1, 4, 16):
+        est = StreamingHashedLinearEstimator(
+            n_dims=dims, n_dense=n_dense, n_cat=n_cat, epochs=epochs,
+            step_size=0.05, chunk_rows=chunk,
+            fused_replay=True, replay_granularity="epoch",
+            epochs_per_dispatch=K,
+        )
+        src = array_chunk_source(Xall, y, chunk_rows=chunk)
+        _log(f"[dispatch] warm-up K={K} ...")
+        warm = est.fit_stream(src, session=session, cache_device=True)
+        jax.block_until_ready(warm.theta["emb"])
+        _log(f"[dispatch] timed K={K} ...")
+        reset_exec_counters()
+        t0 = time.perf_counter()
+        model = est.fit_stream(src, session=session, cache_device=True)
+        jax.block_until_ready(model.theta["emb"])
+        wall = time.perf_counter() - t0
+        sweep[str(K)] = {
+            "wall_s": round(wall, 3),
+            "dispatches": exec_counters()["dispatches"],
+        }
+        emb = np.asarray(model.theta["emb"])
+        if theta_ref is None:
+            theta_ref = emb
+        else:
+            max_diff = max(max_diff, float(np.abs(emb - theta_ref).max()))
+    return {
+        "metric": "dispatch_overhead_epochs_per_dispatch", "unit": "s",
+        "value": sweep["1"]["wall_s"], "vs_baseline": None,
+        "rows": n_rows, "epochs": epochs, "chunk_rows": chunk,
+        "sweep": sweep,
+        "k16_speedup_vs_k1": round(
+            sweep["1"]["wall_s"] / max(sweep["16"]["wall_s"], 1e-9), 2),
+        # 0.0 = the swept lowerings are bit-identical (the donation/
+        # batching parity contract, asserted per run)
+        "theta_max_abs_diff": max_diff,
+    }
+
+
 def main():
     from orange3_spark_tpu.io.native import tune_malloc
     from orange3_spark_tpu.utils.devlock import tpu_device_lock
 
     tune_malloc()  # dedicated bench process: keep big buffers resident
     ap = argparse.ArgumentParser()
-    ap.add_argument("--config", default="all", choices=["3", "4", "5", "all"])
+    ap.add_argument("--config", default="all",
+                    choices=["3", "4", "5", "6", "all"])
     ap.add_argument("--rows-scale", type=float, default=1.0)
     args = ap.parse_args()
     # serialize against any other TPU harness (see utils/devlock.py)
@@ -349,8 +427,8 @@ def _main_locked(args, lk):
         # TPU — keep the lock in that case
         lk.release()
     benches = {"3": bench_higgs_trees, "4": bench_movielens_als,
-               "5": bench_taxi_pipeline}
-    keys = ["3", "4", "5"] if args.config == "all" else [args.config]
+               "5": bench_taxi_pipeline, "6": bench_dispatch_overhead}
+    keys = ["3", "4", "5", "6"] if args.config == "all" else [args.config]
     failed = []
     for k in keys:
         try:

@@ -1,0 +1,49 @@
+"""Execution pipeline subsystem — async host/device overlap, uniform buffer
+donation, and persistent compiled-program reuse.
+
+The streaming fits' three systemic costs, each owned by one module here:
+
+* ``pipeline``      — ``PipelinedExecutor``: a bounded background-thread
+  prefetcher that parses/rechunks/``device_put``s chunk t+1 while the device
+  runs step t (double buffering), with MEASURED overlap efficiency
+  (``overlap_pct``) instead of assumed overlap.
+* ``donate``        — ``donating_jit``: the one way every fused training
+  loop declares ``donate_argnums``, with a global ``OTPU_DONATE=0`` switch
+  so donation-on/off parity is testable bit-for-bit.
+* ``compile_cache`` — persistent XLA compilation cache wiring
+  (``jax_compilation_cache_dir``) so re-runs skip the scan/L-BFGS compiles
+  entirely; surfaced through ``TpuSession.enable_compilation_cache``.
+
+Spark lineage: Spark wins on ingest-heavy workloads by pipelining input
+partitions with task compute; this package is that idea at the TPU host
+boundary, measured end to end in ``bench.py``'s ``overlap_pct`` /
+``dispatches`` / ``cache_hit`` fields.
+"""
+
+# Lazy re-exports (PEP 562): model modules import ``exec.donate`` at their
+# own import time, and an eager ``exec.pipeline`` import here would pull in
+# utils -> workflow -> widgets -> models — a circular-import magnet. Each
+# submodule loads only when its symbol is first touched.
+_EXPORTS = {
+    "cache_entries": "compile_cache",
+    "cache_report": "compile_cache",
+    "default_cache_dir": "compile_cache",
+    "enable_compilation_cache": "compile_cache",
+    "donating_jit": "donate",
+    "donation_enabled": "donate",
+    "PipelinedExecutor": "pipeline",
+    "PipelineStats": "pipeline",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(
+        importlib.import_module(f"orange3_spark_tpu.exec.{mod}"), name
+    )

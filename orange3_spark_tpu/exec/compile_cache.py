@@ -1,0 +1,99 @@
+"""Persistent XLA compilation cache wiring.
+
+The bench's hot programs (the fused replay scan, the L-BFGS while_loop, the
+eval fold) each cost seconds-to-minutes of XLA compile per PROCESS — paid
+again on every bench run, every retry-ladder rung, every tunnel window.
+``jax_compilation_cache_dir`` persists compiled executables keyed by
+(program, backend, flags): the first run pays the compile and writes an
+entry; every later process with the same shapes loads the binary instead.
+
+One wiring point (``enable_compilation_cache``, surfaced as
+``TpuSession.enable_compilation_cache``) so the thresholds are set once:
+the min-compile-time and min-entry-size gates are zeroed because this
+workload has few, large, endlessly re-used programs — exactly what the
+cache is for. ``OTPU_COMPILE_CACHE`` overrides the directory ("0"
+disables). ``cache_report`` turns a pre-run snapshot into the bench line's
+``cache_hit``/``cache_entries`` fields.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import jax
+
+
+def default_cache_dir() -> str:
+    """Per-user cache dir (compiled programs are user data; a shared
+    world-writable dir would be the devlock squatting story again)."""
+    env = os.environ.get("OTPU_COMPILE_CACHE", "")
+    if env and env != "0":
+        return env
+    return os.path.join(tempfile.gettempdir(),
+                        f"otpu_compile_cache_{os.getuid()}")
+
+
+def cache_entries(cache_dir: str) -> int:
+    """Number of persisted executables under ``cache_dir`` (0 if absent)."""
+    n = 0
+    for _root, _dirs, files in os.walk(cache_dir):
+        n += len(files)
+    return n
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> dict:
+    """Point jax's persistent compilation cache at ``cache_dir`` (default:
+    ``default_cache_dir()``; ``OTPU_COMPILE_CACHE=0`` disables).
+
+    Returns ``{"enabled", "dir", "pre_entries"}`` — keep the dict and hand
+    it to ``cache_report`` after the measured work to learn whether the run
+    compiled anything new. Failures to configure (an old jax without the
+    option, an unwritable dir) degrade to ``enabled: False`` rather than
+    raising: the cache is an accelerator, never a correctness dependency.
+    """
+    if os.environ.get("OTPU_COMPILE_CACHE", "") == "0":
+        return {"enabled": False, "dir": None, "pre_entries": 0,
+                "reason": "disabled by OTPU_COMPILE_CACHE=0"}
+    d = cache_dir or default_cache_dir()
+    try:
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        pre = cache_entries(d)
+        jax.config.update("jax_compilation_cache_dir", d)
+        # few, large, endlessly re-used programs: cache everything
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception:  # noqa: BLE001 - option absent on older jax
+            pass
+        # the cache module LATCHES its initialized/disabled state at the
+        # process's first compile — if anything compiled before this call
+        # (a probe, a warm-up), the new dir would silently never be used;
+        # reset so the next compile re-initializes against the configured
+        # dir (private API, hence guarded)
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # noqa: BLE001 - best-effort on jax internals
+            pass
+    except Exception as e:  # noqa: BLE001 - cache is best-effort
+        return {"enabled": False, "dir": None, "pre_entries": 0,
+                "reason": f"{type(e).__name__}: {e}"}
+    return {"enabled": True, "dir": d, "pre_entries": pre}
+
+
+def cache_report(info: dict) -> dict:
+    """``{"cache_hit", "cache_entries"}`` for the bench JSON line.
+
+    ``cache_hit`` is True when the run found a warm cache AND wrote no new
+    entries (every program it compiled was served from disk); False when it
+    had to compile something (first run, or changed shapes/flags); None
+    when the cache is disabled/unavailable.
+    """
+    if not info.get("enabled"):
+        return {"cache_hit": None, "cache_entries": None}
+    post = cache_entries(info["dir"])
+    pre = info.get("pre_entries", 0)
+    return {"cache_hit": bool(pre > 0 and post <= pre),
+            "cache_entries": post}

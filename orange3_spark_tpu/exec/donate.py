@@ -1,0 +1,86 @@
+"""Uniform buffer donation for the fused training loops.
+
+``donate_argnums`` tells XLA an input buffer may be aliased to an output —
+for a training step whose ``(params, opt_state)`` round-trip through every
+dispatch, donation removes one full parameter copy per step and halves the
+peak parameter footprint on backends that implement aliasing (TPU does;
+XLA:CPU accepts the annotation and ignores it, so CPU tests exercise the
+same code path at zero risk). Donation is pure aliasing — it must never
+change a single bit of the result, and ``tests/test_donation.py`` pins that
+by fitting every swept model donation-on and donation-off.
+
+``donating_jit`` is the ONE way loops declare donation, with a global
+switch (``OTPU_DONATE=0``) that disables every donation at once: the
+parity tests flip it, and it is the escape hatch if a backend ever
+miscompiles an aliased program.
+
+Sweep record (which loop donates what, and why the exceptions are
+exceptions):
+
+* ``models/hashed_linear._hashed_step`` / ``_hashed_replay_epochs``
+  (per-chunk step, fused/epoch/disk-group replay) — donate
+  ``(theta, opt_state)``.
+* ``io/streaming._stream_step`` / ``_stream_replay_epochs`` — donate
+  ``(theta, opt_state)``; ``_kmeans_stream_step`` /
+  ``_kmeans_replay_epochs`` — donate ``(centers, counts)``.
+* ``io/streaming._feature_stats_step[_missing]`` (the scaler/Imputer/PCA
+  ``fit_stream`` accumulator) — donate the running stats dict.
+* ``models/kmeans._lloyd`` — donate ``centers0`` (every caller builds the
+  seed centers fresh); the ``n_init>1`` restart path calls the undonated
+  twin because donation inside ``vmap`` tracing is a no-op.
+* ``models/evaluation`` streaming folds — donate the accumulator.
+* ``models/_linear.fit_linear`` — inputs are table-BORROWED (``table.X`` /
+  ``table.W`` outlive the fit), so donation is opt-in via
+  ``donate_data=True`` for callers that own transient batches.
+* ``workflow/staging`` — staged-program inputs default to the cached eager
+  tables (reused across calls), so donation is opt-in via
+  ``donate_inputs=True`` for one-shot/refit-loop executions feeding fresh
+  tables each call.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+
+
+def donation_enabled() -> bool:
+    """Global donation switch — ``OTPU_DONATE=0`` disables every
+    ``donating_jit`` donation at once (read per call, so a test can flip
+    it mid-process)."""
+    return os.environ.get("OTPU_DONATE", "1") != "0"
+
+
+def donating_jit(fn=None, *, donate_argnums=(), static_argnames=(),
+                 static_argnums=()):
+    """``jax.jit`` with donation declared the uniform way.
+
+    Returns a wrapper that dispatches to the donating compilation when
+    ``donation_enabled()`` and to an undonated twin otherwise. Both are
+    exposed (``wrapper.donated`` / ``wrapper.plain``) for call sites that
+    must force one — e.g. under ``vmap`` tracing, where an inner jit's
+    donation is silently dropped, the ``.plain`` twin avoids compiling a
+    donating executable that can never donate.
+    """
+
+    def deco(f):
+        kw = {}
+        if static_argnames:
+            kw["static_argnames"] = static_argnames
+        if static_argnums:
+            kw["static_argnums"] = static_argnums
+        donated = jax.jit(f, donate_argnums=tuple(donate_argnums), **kw)
+        plain = jax.jit(f, **kw)
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            return (donated if donation_enabled() else plain)(*args, **kwargs)
+
+        wrapper.donated = donated
+        wrapper.plain = plain
+        wrapper.donate_argnums = tuple(donate_argnums)
+        return wrapper
+
+    return deco(fn) if fn is not None else deco

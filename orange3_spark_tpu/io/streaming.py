@@ -28,8 +28,6 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import queue
-import threading
 import uuid
 import warnings
 from functools import partial
@@ -41,8 +39,11 @@ import numpy as np
 import optax
 
 from orange3_spark_tpu.core.session import TpuSession
+from orange3_spark_tpu.exec.donate import donating_jit
+from orange3_spark_tpu.exec.pipeline import PipelineStats, prefetch_iter
 from orange3_spark_tpu.io.multihost import put_sharded
-from orange3_spark_tpu.utils.dispatch import beat, bound_dispatch
+from orange3_spark_tpu.utils.dispatch import bound_dispatch
+from orange3_spark_tpu.utils.profiling import count_dispatch
 from orange3_spark_tpu.models.base import Estimator, Params
 
 # (X [n,d], y [n] or None) or (X, y, w) — sources may carry row weights
@@ -178,10 +179,8 @@ def parquet_raw_chunk_source(
     return open_stream
 
 
-_PREFETCH_EOF = object()
-
-
-def prefetch_map(fn: Callable, items: Iterator, *, depth: int = 2) -> Iterator:
+def prefetch_map(fn: Callable, items: Iterator, *, depth: int = 2,
+                 stats_into: PipelineStats | None = None) -> Iterator:
     """Run ``fn`` over ``items`` on a daemon thread, yielding results in
     order through a bounded queue.
 
@@ -191,45 +190,13 @@ def prefetch_map(fn: Callable, items: Iterator, *, depth: int = 2) -> Iterator:
     release the GIL, so the worker genuinely overlaps the main thread's
     dispatch work even on a single-core host (the transfer's wait-on-DMA
     time is free CPU for the parser). Worker exceptions re-raise at the
-    consuming ``next()``; closing the generator early stops the worker."""
-    q: queue.Queue = queue.Queue(maxsize=max(1, depth))
-    stop = threading.Event()
+    consuming ``next()``; closing the generator early stops the worker.
 
-    def worker():
-        try:
-            for item in items:
-                out = fn(item)
-                beat()  # parse/DMA progress feeds the stall watchdog
-                while not stop.is_set():
-                    try:
-                        q.put(out, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-                if stop.is_set():
-                    return
-            payload = (_PREFETCH_EOF, None)
-        except BaseException as e:  # noqa: BLE001 - re-raised on the consumer
-            payload = (_PREFETCH_EOF, e)
-        while not stop.is_set():
-            try:
-                q.put(payload, timeout=0.1)
-                return
-            except queue.Full:
-                continue
-
-    t = threading.Thread(target=worker, daemon=True, name="chunk-prefetch")
-    t.start()
-    try:
-        while True:
-            got = q.get()
-            if isinstance(got, tuple) and len(got) == 2 and got[0] is _PREFETCH_EOF:
-                if got[1] is not None:
-                    raise got[1]
-                return
-            yield got
-    finally:
-        stop.set()
+    Thin delegate over ``exec.pipeline.PipelinedExecutor`` — the one
+    overlap engine, now with MEASURED overlap (``stats_into`` receives the
+    stream's counters; every stream also folds into the process aggregate
+    read by ``utils.profiling.exec_counters``)."""
+    return prefetch_iter(fn, items, depth=depth, stats_into=stats_into)
 
 
 def array_chunk_source(X: np.ndarray, y: np.ndarray | None = None,
@@ -247,7 +214,7 @@ def array_chunk_source(X: np.ndarray, y: np.ndarray | None = None,
     return open_stream
 
 
-@partial(jax.jit, static_argnames=("gramian",), donate_argnums=(0,))
+@donating_jit(static_argnames=("gramian",), donate_argnums=(0,))
 def _feature_stats_step(acc, X, w, *, gramian: bool):
     """Fold one padded chunk into the running per-column stats (and the
     weighted Gramian when asked — an MXU matmul per chunk). Moments
@@ -276,7 +243,7 @@ def _feature_stats_step(acc, X, w, *, gramian: bool):
     return out
 
 
-@partial(jax.jit, static_argnames=("nan_missing",), donate_argnums=(0,))
+@donating_jit(static_argnames=("nan_missing",), donate_argnums=(0,))
 def _feature_stats_step_missing(acc, X, w, mv, *, nan_missing: bool):
     """Missing-aware fold (the streaming Imputer fit): per-CELL
     observation masks — a missing cell drops out of that column's
@@ -326,7 +293,8 @@ def stream_feature_stats(source: Callable[[], Iterator[Chunk]],
                          *, session: TpuSession | None = None,
                          chunk_rows: int = 1 << 18,
                          gramian: bool = False,
-                         missing_value: float | None = None) -> dict:
+                         missing_value: float | None = None,
+                         stage_times: dict | None = None) -> dict:
     """Single-pass per-column statistics over a chunk stream — the
     out-of-core fit for the feature transformers and PCA (BASELINE
     config 5 is KMeans + PCA at 1B TAXI rows: StreamingKMeans existed,
@@ -349,7 +317,11 @@ def stream_feature_stats(source: Callable[[], Iterator[Chunk]],
     observation masks — the streaming Imputer fit: a missing cell leaves
     that column's count/mean/var/min/max, other columns keep the row.
     ``count`` is then a per-column array; incompatible with ``gramian``
-    (a Gramian over ragged observations is not the covariance)."""
+    (a Gramian over ragged observations is not the covariance).
+
+    ``stage_times``: optional dict receiving the pass's pipeline metrics —
+    ``overlap_pct`` (measured host-prep/device-fold overlap, see
+    ``exec.pipeline``) and ``dispatches`` (fold programs dispatched)."""
     if missing_value is not None and gramian:
         raise ValueError("gramian=True and missing_value are incompatible")
     session = session or TpuSession.builder_get_or_create()
@@ -364,8 +336,11 @@ def stream_feature_stats(source: Callable[[], Iterator[Chunk]],
         return put_sharded(Xp, row_sh), put_sharded(wp, vec_sh)
 
     acc = None
+    pstats = PipelineStats()
+    n_folds = 0
     for step, (Xd, wd) in enumerate(
-            prefetch_map(prep, _rechunk(source(), pad_rows), depth=2)):
+            prefetch_map(prep, _rechunk(source(), pad_rows), depth=2,
+                         stats_into=pstats)):
         if acc is None:
             n_features = Xd.shape[1]
             big = np.float32(np.finfo(np.float32).max)
@@ -390,9 +365,13 @@ def stream_feature_stats(source: Callable[[], Iterator[Chunk]],
                 nan_missing=bool(np.isnan(missing_value)))
         else:
             acc = _feature_stats_step(acc, Xd, wd, gramian=gramian)
-        bound_dispatch(step + 1, acc["n"], period=8)
+        n_folds = step + 1
+        bound_dispatch(n_folds, acc["n"], period=8)
     if acc is None:
         raise ValueError("stream produced no chunks")
+    if stage_times is not None:
+        stage_times["overlap_pct"] = round(pstats.overlap_pct, 1)
+        stage_times["dispatches"] = n_folds
     host = jax.device_get(acc)          # ONE blocking transfer, not eight
     # scalar total weight normally; per-column observed weight under
     # missing_value — the identical formulas broadcast over both
@@ -403,12 +382,20 @@ def stream_feature_stats(source: Callable[[], Iterator[Chunk]],
     var = np.maximum(
         np.asarray(host["ss"], np.float64) / n - mean_z ** 2, 0.0)
     mean = shift + mean_z
+    mn = np.asarray(host["mn"])
+    mx = np.asarray(host["mx"])
     if n.ndim:
         # missing mode: an all-missing column has no mean — fill 0, the
-        # in-memory Imputer's convention (sum 0 over eps weight)
+        # in-memory Imputer's convention (sum 0 over eps weight). min/max
+        # get the SAME dead-column fill: without it the ±FLT_MAX
+        # accumulator init sentinels (3.4e38) would leak into the result
         dead = n_raw <= 0
         mean[dead] = 0.0
         var[dead] = 0.0
+        mn = mn.copy()
+        mx = mx.copy()
+        mn[dead] = 0.0
+        mx[dead] = 0.0
     out = {
         # the UNCLAMPED weight: an all-missing column / empty stream must
         # report 0, not the division epsilon
@@ -416,8 +403,8 @@ def stream_feature_stats(source: Callable[[], Iterator[Chunk]],
         else n_raw.astype(np.float32),
         "mean": mean.astype(np.float32),
         "var": var.astype(np.float32),
-        "min": np.asarray(host["mn"]),
-        "max": np.asarray(host["mx"]),
+        "min": mn,
+        "max": mx,
     }
     if gramian:
         # Gz/n = E[z zᵀ]; centered cov is shift-invariant:
@@ -481,6 +468,7 @@ def score_stream(score_fn, source: Callable[[], Iterator[Chunk]],
     tmp = f"{out_path}.tmp{os.getpid()}"
     total = 0
     ok = False
+    label_in_schema = False
     try:
         for step, (Xd, X_np, y_np, w_np, n) in enumerate(prefetch_map(
                 prep, _rechunk(source(), pad_rows), depth=2)):
@@ -491,6 +479,16 @@ def score_stream(score_fn, source: Callable[[], Iterator[Chunk]],
                 X_np, scores = X_np[live], scores[live]
                 y_np = None if y_np is None else y_np[live]
                 n = len(X_np)
+            if writer is not None and (y_np is None) == label_in_schema:
+                # the parquet schema is fixed by the FIRST chunk; a source
+                # whose label presence flips mid-stream would otherwise
+                # die inside pa.table with a names/columns length mismatch
+                raise ValueError(
+                    f"chunk {step} is {'un' if y_np is None else ''}labeled "
+                    f"but the schema-defining first chunk was "
+                    f"{'' if label_in_schema else 'un'}labeled — a stream's "
+                    "label presence must be uniform across chunks"
+                )
             if writer is None:
                 d = X_np.shape[1]
                 names = list(feature_names) if feature_names else \
@@ -498,6 +496,7 @@ def score_stream(score_fn, source: Callable[[], Iterator[Chunk]],
                 if include_features and len(names) != d:
                     raise ValueError(
                         f"{len(names)} feature_names for {d} columns")
+                label_in_schema = y_np is not None
                 if y_np is not None:
                     names.append("label")
                 if scores.ndim == 2:
@@ -563,6 +562,13 @@ class StreamingLinearParams(Params):
     # per chunk, the granularity that has never faulted on hardware, and
     # the one that admits epoch-boundary checkpointing.
     replay_granularity: str = "all"   # 'all' | 'epoch'
+    # With replay_granularity='epoch': fold K epochs into each scan
+    # dispatch (n_replay/K dispatches instead of n_replay) — the
+    # dispatch-amortization dial between 'epoch' (K=1) and 'all'
+    # (K=n_replay). Identical step sequence at any K; checkpoint cadence
+    # is preserved by clamping groups at snapshot boundaries
+    # (run_epoch_replay). Ignored under granularity 'all'.
+    epochs_per_dispatch: int = 1
 
 
 class _DeviceCache:
@@ -768,7 +774,7 @@ def _pad_chunk(X_np, y_np, w_np, pad_rows: int, n_features: int):
 _ADAM_UNIT = optax.adam(1.0)
 
 
-@partial(jax.jit, static_argnames=("loss_kind",), donate_argnums=(0, 1))
+@donating_jit(static_argnames=("loss_kind",), donate_argnums=(0, 1))
 def _stream_step(theta, opt_state, X, y, w, reg, lr, *, loss_kind: str):
     # ONE loss implementation for in-memory and streaming fits: the row
     # losses come from _linear._make_objective (col_scale=1 — streaming
@@ -811,10 +817,13 @@ class StreamingKMeansParams(Params):
     # n_epochs=1 dispatch per pass (the hardware-robust granularity — see
     # StreamingLinearParams.replay_granularity).
     replay_granularity: str = "all"   # 'all' | 'epoch'
+    # K replay epochs per scan dispatch under granularity 'epoch' — see
+    # StreamingLinearParams.epochs_per_dispatch.
+    epochs_per_dispatch: int = 1
 
 
-@partial(jax.jit, static_argnames=("loss_kind", "n_epochs"),
-         donate_argnums=(0, 1))
+@donating_jit(static_argnames=("loss_kind", "n_epochs"),
+              donate_argnums=(0, 1))
 def _stream_replay_epochs(theta, opt_state, Xs, ys, ws, reg, lr, *,
                           loss_kind: str, n_epochs: int):
     """Epochs 2+ over the HBM batch cache as ONE XLA program — an
@@ -852,38 +861,54 @@ def check_replay_granularity(value: str) -> None:
 
 
 def run_epoch_replay(n_replay, spe, n_steps, resume_from, checkpointer,
-                     dispatch_one, snapshot, ckpt_meta):
+                     dispatch_epochs, snapshot, ckpt_meta,
+                     epochs_per_dispatch: int = 1):
     """The per-epoch replay protocol shared by the streaming estimators
     (linear, hashed, kmeans): fast-forward whole checkpointed epochs
-    without dispatching them, dispatch one n_epochs=1 scan per remaining
-    epoch, bound the in-flight dispatch queue (each dispatch pins the full
-    chunk stack, so period=2 keeps one executing + one queued), and
-    snapshot at epoch boundaries every ~``checkpointer.every_steps`` steps
-    rounded to whole epochs. ONE implementation so the three estimators'
-    checkpoint/resume semantics cannot drift.
+    without dispatching them, dispatch the remaining epochs in groups of
+    ``epochs_per_dispatch`` scans (K=1 is the hardware-robust per-epoch
+    granularity; larger K folds K epochs into ONE ``lax.scan`` dispatch —
+    the dispatch-amortization lever between 'epoch' and 'all'), bound the
+    in-flight dispatch queue (each dispatch pins the full chunk stack, so
+    period=2 keeps one executing + one queued), and snapshot at epoch
+    boundaries every ~``checkpointer.every_steps`` steps rounded to whole
+    epochs. Groups never cross a snapshot boundary — they are clamped so
+    checkpoint cadence is IDENTICAL at every K (resume compatibility: a
+    snapshot written at K=4 resumes correctly under K=1 and vice versa).
+    ONE implementation so the three estimators' checkpoint/resume
+    semantics cannot drift.
 
-    ``dispatch_one()`` runs one epoch and returns the value to block on;
-    ``snapshot()`` returns the state dict to checkpoint. Returns
-    ``(n_steps, last, n_dispatched)`` — ``last`` is None when every epoch
-    was fast-forwarded (resume-at-completion)."""
+    ``dispatch_epochs(k)`` runs k epochs in one dispatch and returns the
+    value to block on; ``snapshot()`` returns the state dict to
+    checkpoint. Returns ``(n_steps, last, n_dispatched)`` — ``last`` is
+    None when every epoch was fast-forwarded (resume-at-completion)."""
     save_every = (max(1, checkpointer.every_steps // spe)
                   if checkpointer is not None else 0)
+    group = max(1, int(epochs_per_dispatch))
     last = None
     n_disp = 0
-    for rep in range(n_replay):
+    rep = 0
+    while rep < n_replay:
         if n_steps + spe <= resume_from:
             n_steps += spe          # checkpointed epoch: skip, no dispatch
+            rep += 1
             continue
-        last = dispatch_one()
-        n_steps += spe
+        k = min(group, n_replay - rep)
+        if save_every:
+            # clamp to the next snapshot boundary: snapshots land BETWEEN
+            # dispatches, so a group spanning one would silently skip it
+            k = min(k, save_every - (rep % save_every))
+        last = dispatch_epochs(k)
+        n_steps += k * spe
+        rep += k
         n_disp += 1
         bound_dispatch(n_disp, last, period=2)
-        if save_every and (rep + 1) % save_every == 0:
+        if save_every and rep % save_every == 0:
             checkpointer.save(n_steps, snapshot(), meta=ckpt_meta)
     return n_steps, last, n_disp
 
 
-@partial(jax.jit, static_argnames=("k", "n_epochs"), donate_argnums=(0, 1))
+@donating_jit(static_argnames=("k", "n_epochs"), donate_argnums=(0, 1))
 def _kmeans_replay_epochs(centers, counts, Xs, ws, decay, *,
                           k: int, n_epochs: int):
     """Replay epochs over the HBM batch cache as ONE XLA program — the
@@ -911,7 +936,7 @@ def _kmeans_replay_epochs(centers, counts, Xs, ws, decay, *,
     return centers, counts, costs
 
 
-@partial(jax.jit, static_argnames=("k",), donate_argnums=(0, 1))
+@donating_jit(static_argnames=("k",), donate_argnums=(0, 1))
 def _kmeans_stream_step(centers, counts, X, w, decay, *, k: int):
     """One aggregated mini-batch update (Sculley 2010 / MLlib StreamingKMeans):
     per-center sums from this chunk fold into running counts with decay."""
@@ -1066,23 +1091,25 @@ class StreamingKMeans(Estimator):
                 Xs = jnp.stack([b[0] for b in cache.batches])
                 ws = jnp.stack([b[1] for b in cache.batches])
                 if p.replay_granularity == "epoch":
-                    def _disp_km():
+                    def _disp_km(n_ep):
                         nonlocal centers, counts
                         centers, counts, _c = _kmeans_replay_epochs(
                             centers, counts, Xs, ws, decay, k=p.k,
-                            n_epochs=1,
+                            n_epochs=n_ep,
                         )
                         return centers
 
                     n_steps, _, _ = run_epoch_replay(
                         n_replay, spe, n_steps, 0, None, _disp_km,
                         None, None,
+                        epochs_per_dispatch=p.epochs_per_dispatch,
                     )
                 else:
                     centers, counts, _costs = _kmeans_replay_epochs(
                         centers, counts, Xs, ws, decay, k=p.k,
                         n_epochs=n_replay,
                     )
+                    count_dispatch()   # one-shot fused scan: no loop ticks
                     n_steps += n_replay * spe
                 del Xs, ws
                 break
@@ -1316,11 +1343,11 @@ class StreamingLinearEstimator(Estimator):
                     for i in range(3)
                 )
                 if p.replay_granularity == "epoch":
-                    def _disp_lin():
+                    def _disp_lin(n_ep):
                         nonlocal theta, opt_state
                         theta, opt_state, losses = _stream_replay_epochs(
                             theta, opt_state, *stacks, reg, lr,
-                            loss_kind=p.loss, n_epochs=1,
+                            loss_kind=p.loss, n_epochs=n_ep,
                         )
                         return losses[-1, -1]
 
@@ -1329,6 +1356,7 @@ class StreamingLinearEstimator(Estimator):
                         _disp_lin,
                         lambda: {"theta": theta, "opt_state": opt_state},
                         ckpt_meta,
+                        epochs_per_dispatch=p.epochs_per_dispatch,
                     )
                     if last is not None:
                         last_loss = last
@@ -1337,6 +1365,7 @@ class StreamingLinearEstimator(Estimator):
                         theta, opt_state, *stacks, reg, lr,
                         loss_kind=p.loss, n_epochs=n_replay,
                     )
+                    count_dispatch()   # one-shot fused scan: no loop ticks
                     n_steps += n_replay * spe
                     last_loss = losses[-1, -1]
                 del stacks

@@ -16,13 +16,18 @@ onto the MXU; optionally computed in bfloat16 with f32 accumulation.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 import optax
 import optax.tree_utils as otu
+
+from orange3_spark_tpu.exec.donate import donating_jit, donation_enabled
+
+# optax 0.2.4 renamed tree_l2_norm -> tree_norm; container pins vary, so
+# accept either (same quantity: the global L2 norm of the pytree)
+_tree_norm = getattr(otu, "tree_norm", None) or otu.tree_l2_norm
 
 
 class LinearFitResult(NamedTuple):
@@ -56,7 +61,7 @@ def lbfgs_minimize(value_fn, theta0, tol, max_iter, *, memory_size: int = 10):
         _, state = carry
         count = otu.tree_get(state, "count")
         grad = otu.tree_get(state, "grad")
-        gnorm = otu.tree_norm(grad)
+        gnorm = _tree_norm(grad)
         # first iteration always runs (grad in fresh state is zero), but
         # max_iter=0 must return the zero init, matching MLlib maxIter=0
         return (max_iter > 0) & ((count == 0) | ((count < max_iter) & (gnorm > tol)))
@@ -260,19 +265,13 @@ def per_row_loss(loss_kind: str, logits, y):
     raise ValueError(loss_kind)  # pragma: no cover
 
 
-@partial(
-    jax.jit,
-    static_argnames=("loss_kind", "k", "fit_intercept", "memory_size", "compute_dtype"),
+@donating_jit(
+    static_argnames=("loss_kind", "k", "fit_intercept", "memory_size",
+                     "compute_dtype"),
+    donate_argnums=(0, 1, 2),
 )
-def fit_linear(
-    X,             # f32[N_pad, d]  sharded P('data', None)
-    y,             # f32[N_pad]     labels (class index, ±target, or regression y)
-    w,             # f32[N_pad]     weights; 0 on padding
-    reg_l2,        # f32[] L2 regParam
-    tol,           # f32[] gradient-norm tolerance
-    max_iter,      # i32[]
-    col_scale=None,  # f32[d] standardization scale folded into the matmul
-    reg_l1=None,     # f32[] L1 strength (elasticNet); None -> pure-L2 L-BFGS
+def _fit_linear_jit(
+    X, y, w, reg_l2, tol, max_iter, col_scale, reg_l1,
     *,
     loss_kind: str,
     k: int,
@@ -280,17 +279,6 @@ def fit_linear(
     memory_size: int = 10,
     compute_dtype=jnp.float32,
 ):
-    """One fused XLA program: full L-BFGS (or OWLQN when reg_l1 is given)
-    fit of a linear model.
-
-    MLlib's regParam/elasticNetParam split maps to
-    ``reg_l2 = regParam*(1-alpha), reg_l1 = regParam*alpha``; with
-    standardization the L1 applies in the SCALED space, matching MLlib.
-
-    Note: with ``col_scale`` the optimization runs in the scaled space; the
-    returned coef is the SCALED-space coefficient — callers multiply by the
-    scale to return to original feature space (MLlib does the same rescale).
-    """
     d = X.shape[1]
     if col_scale is None:
         col_scale = jnp.ones((d,), jnp.float32)
@@ -327,6 +315,51 @@ def fit_linear(
         intercept=theta["intercept"] if fit_intercept else jnp.zeros((k,)),
         n_iter=n_iter,
         final_loss=final_loss,
+    )
+
+
+def fit_linear(
+    X,             # f32[N_pad, d]  sharded P('data', None)
+    y,             # f32[N_pad]     labels (class index, ±target, or regression y)
+    w,             # f32[N_pad]     weights; 0 on padding
+    reg_l2,        # f32[] L2 regParam
+    tol,           # f32[] gradient-norm tolerance
+    max_iter,      # i32[]
+    col_scale=None,  # f32[d] standardization scale folded into the matmul
+    reg_l1=None,     # f32[] L1 strength (elasticNet); None -> pure-L2 L-BFGS
+    *,
+    loss_kind: str,
+    k: int,
+    fit_intercept: bool = True,
+    memory_size: int = 10,
+    compute_dtype=jnp.float32,
+    donate_data: bool = False,
+):
+    """One fused XLA program: full L-BFGS (or OWLQN when reg_l1 is given)
+    fit of a linear model.
+
+    MLlib's regParam/elasticNetParam split maps to
+    ``reg_l2 = regParam*(1-alpha), reg_l1 = regParam*alpha``; with
+    standardization the L1 applies in the SCALED space, matching MLlib.
+
+    Note: with ``col_scale`` the optimization runs in the scaled space; the
+    returned coef is the SCALED-space coefficient — callers multiply by the
+    scale to return to original feature space (MLlib does the same rescale).
+
+    ``donate_data=True`` donates the (X, y, w) buffers to the fit (the
+    exec/donate.py sweep): the estimator entry points pass table-BORROWED
+    arrays that must survive for transform/evaluate, so donation is opt-in
+    for callers feeding one-shot transient batches (tuning folds, staged
+    refit loops) — it frees the batch's HBM the moment the fit consumes
+    it. Bit-identical either way (donation is pure buffer aliasing).
+    """
+    jitted = (_fit_linear_jit.donated
+              if donate_data and donation_enabled()
+              else _fit_linear_jit.plain)
+    return jitted(
+        X, y, w, reg_l2, tol, max_iter, col_scale, reg_l1,
+        loss_kind=loss_kind, k=k, fit_intercept=fit_intercept,
+        memory_size=memory_size, compute_dtype=compute_dtype,
     )
 
 

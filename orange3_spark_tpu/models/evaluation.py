@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.exec.donate import donating_jit
 from orange3_spark_tpu.models.base import Params
 from orange3_spark_tpu.ops.stats import EPS_TOTAL_WEIGHT
 
@@ -140,7 +141,7 @@ def _bound(steps, token):
     bound_dispatch(steps, token, period=8)
 
 
-@_partial(jax.jit, static_argnames=("n_bins",), donate_argnums=(0,))
+@donating_jit(static_argnames=("n_bins",), donate_argnums=(0,))
 def _binary_stream_fold(acc, s, y, w, *, n_bins: int):
     """Fold one scored chunk into the per-class score histograms (binned
     AUC, error O(1/n_bins)) and return the chunk's weighted
@@ -184,9 +185,12 @@ def evaluate_binary_stream(score_fn, source, *, session=None,
                                         n_bins=n_bins)
         chunk_sums.append(sums)
         _bound(steps, sums[2])
+    if not chunk_sums:
+        # match the multiclass/regression evaluators: a misconfigured
+        # source must fail loudly, not return plausible-looking zeros
+        raise ValueError("stream produced no chunks")
     host = jax.device_get(acc)
-    sums = np.asarray(jax.device_get(chunk_sums), np.float64) \
-        if chunk_sums else np.zeros((0, 3))
+    sums = np.asarray(jax.device_get(chunk_sums), np.float64)
     ll_tot, ok_tot, n_tot = (float(sums[:, j].sum()) for j in range(3))
     hp = np.asarray(host["hp"], np.float64)
     hn = np.asarray(host["hn"], np.float64)

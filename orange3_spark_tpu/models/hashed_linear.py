@@ -55,11 +55,14 @@ import numpy as np
 import optax
 
 from orange3_spark_tpu.core.session import TpuSession
+from orange3_spark_tpu.exec.donate import donating_jit
+from orange3_spark_tpu.exec.pipeline import PipelineStats
 from orange3_spark_tpu.io.multihost import put_sharded
 from orange3_spark_tpu.models._linear import EPS_TOTAL_WEIGHT, per_row_loss
 from orange3_spark_tpu.models.base import Estimator, Model, Params
 from orange3_spark_tpu.ops.hashing import column_salts, hash_columns
 from orange3_spark_tpu.utils.dispatch import bound_dispatch
+from orange3_spark_tpu.utils.profiling import count_dispatch
 
 # unit-lr adam; the traced lr scales its updates (see io/streaming.py)
 _ADAM_UNIT = optax.adam(1.0)
@@ -95,6 +98,13 @@ class HashedLinearParams(Params):
     # per RPC) would dominate the wall: 99 epoch dispatches cost seconds,
     # 2900 chunk dispatches cost minutes.
     replay_granularity: str = "all"   # 'all' | 'epoch'
+    # With replay_granularity='epoch': fold K epochs into each scan
+    # dispatch — ceil(n_replay/K) dispatches instead of n_replay, the
+    # amortization dial between 'epoch' (K=1, most robust, most RPCs) and
+    # 'all' (one giant program, the round-4 fault's shape). Step sequence
+    # is identical at every K, and checkpoint cadence is preserved (groups
+    # clamp at snapshot boundaries — io/streaming.run_epoch_replay).
+    epochs_per_dispatch: int = 1
     # Defer epoch-1 training into the replay program: the streaming pass
     # becomes pure ingest (parse -> pad -> DMA -> cache/spill, NO step
     # dispatches) and the replay then runs ``epochs`` full passes instead
@@ -334,8 +344,7 @@ def _step_core(
     return optax.apply_updates(theta, updates), opt_state, loss
 
 
-@partial(
-    jax.jit,
+@donating_jit(
     static_argnames=(
         "loss_kind", "n_dims", "n_dense", "compute_dtype", "label_in_chunk",
         "emb_update", "value_weighted", "impute_missing",
@@ -357,8 +366,7 @@ def _hashed_step(
     )
 
 
-@partial(
-    jax.jit,
+@donating_jit(
     static_argnames=(
         "loss_kind", "n_dims", "n_dense", "compute_dtype", "label_in_chunk",
         "emb_update", "value_weighted", "impute_missing", "n_epochs",
@@ -566,6 +574,7 @@ class HashedLinearModel(Model):
         kind = _row_loss_kind(p)
         tot = None
         for Xd, n_valid, yd, wd in device_chunks:
+            count_dispatch()
             out = _hashed_eval_chunk(
                 self.theta, Xd, n_valid, yd, wd, salts,
                 loss_kind=kind, n_dims=p.n_dims, n_dense=p.n_dense,
@@ -747,7 +756,10 @@ class StreamingHashedLinearEstimator(Estimator):
         theta, opt, losses = _hashed_replay_epochs(
             theta, opt, *stacks, salts,
             jnp.float32(p.reg_param), jnp.float32(p.step_size),
-            n_epochs=(1 if p.replay_granularity == "epoch" else n_rep),
+            # 'epoch' granularity dispatches n_epochs=K scans (the
+            # epochs_per_dispatch group size, clamped to the replay span)
+            n_epochs=(min(max(1, p.epochs_per_dispatch), n_rep)
+                      if p.replay_granularity == "epoch" else n_rep),
             **kw)
         jax.block_until_ready(losses)
         return theta, np.asarray(salts)
@@ -830,6 +842,10 @@ class StreamingHashedLinearEstimator(Estimator):
         reg = jnp.float32(p.reg_param)
         lr = jnp.float32(p.step_size)
         times = {"parse_s": 0.0, "h2d_s": 0.0} if stage_times is not None else None
+        # fit-level pipeline counters: every prefetch stream (live ingest,
+        # disk replay, grouped disk replay) folds in, so overlap_pct is the
+        # measured host-prep/device-compute overlap of the WHOLE fit
+        pipe_stats = PipelineStats()
 
         def to_device(host_chunk):
             """parse-thread side: pad + device_put one chunk."""
@@ -900,7 +916,8 @@ class StreamingHashedLinearEstimator(Estimator):
 
             if p.prefetch_depth > 0:
                 yield from prefetch_map(
-                    to_device, host_chunks(), depth=p.prefetch_depth
+                    to_device, host_chunks(), depth=p.prefetch_depth,
+                    stats_into=pipe_stats,
                 )
             else:
                 for c in host_chunks():
@@ -948,6 +965,14 @@ class StreamingHashedLinearEstimator(Estimator):
         n_steps = 0
         last_loss = None
 
+        # dispatch-queue depth coupled to the staging depth: queueing more
+        # steps than the prefetcher can stage starves nothing and lets the
+        # consumer sprint arbitrarily far ahead of the device — which both
+        # un-bounds in-flight memory and blinds the overlap measurement
+        # (queue-wait only reflects device pace while the consumer is
+        # paced by the device)
+        step_period = max(2, 2 * p.prefetch_depth)
+
         def run_step(dev_chunk):
             nonlocal theta, opt_state, n_steps, last_loss
             Xd, n_valid, yd, wd = dev_chunk
@@ -957,7 +982,7 @@ class StreamingHashedLinearEstimator(Estimator):
             )
             n_steps += 1
             last_loss = loss
-            bound_dispatch(n_steps, loss)  # utils/dispatch.py: queue cap
+            bound_dispatch(n_steps, loss, period=step_period)
             if checkpointer is not None:
                 checkpointer.maybe_save(
                     n_steps, {"theta": theta, "opt_state": opt_state},
@@ -1007,7 +1032,8 @@ class StreamingHashedLinearEstimator(Estimator):
             idxs = iter(range(start, spill.n_records - holdout_chunks))
             if p.prefetch_depth > 0:
                 yield from prefetch_map(rec_to_device, idxs,
-                                        depth=p.prefetch_depth)
+                                        depth=p.prefetch_depth,
+                                        stats_into=pipe_stats)
             else:
                 for i in idxs:
                     yield rec_to_device(i)
@@ -1047,7 +1073,8 @@ class StreamingHashedLinearEstimator(Estimator):
 
             starts = iter(range(0, n_full, group))
             if p.prefetch_depth > 0:
-                yield from prefetch_map(grp_to_device, starts, depth=1)
+                yield from prefetch_map(grp_to_device, starts, depth=1,
+                                        stats_into=pipe_stats)
             else:
                 for s in starts:
                     yield grp_to_device(s)
@@ -1193,12 +1220,12 @@ class StreamingHashedLinearEstimator(Estimator):
                         run_epoch_replay,
                     )
 
-                    def _disp():
+                    def _disp(n_ep):
                         nonlocal theta, opt_state
                         theta, opt_state, chunk_losses = \
                             _hashed_replay_epochs(
                                 theta, opt_state, *stacks, salts, reg, lr,
-                                n_epochs=1, **static_kw,
+                                n_epochs=n_ep, **static_kw,
                             )
                         return chunk_losses[-1, -1]
 
@@ -1207,6 +1234,7 @@ class StreamingHashedLinearEstimator(Estimator):
                         _disp,
                         lambda: {"theta": theta, "opt_state": opt_state},
                         ckpt_meta,
+                        epochs_per_dispatch=p.epochs_per_dispatch,
                     )
                     if last is not None:
                         last_loss = last
@@ -1215,6 +1243,7 @@ class StreamingHashedLinearEstimator(Estimator):
                         theta, opt_state, *stacks, salts, reg, lr,
                         n_epochs=n_rep, **static_kw,
                     )
+                    count_dispatch()   # one-shot fused scan: no loop ticks
                     last_loss = chunk_losses[-1, -1]
                     n_steps += n_rep * spe
                 del stacks
@@ -1229,6 +1258,12 @@ class StreamingHashedLinearEstimator(Estimator):
         if stage_times is not None and times is not None:
             stage_times.update(times)
             stage_times["epoch_s"] = [round(t, 3) for t in epoch_walls]
+            if pipe_stats.items:
+                # measured prefetch overlap (exec/pipeline.py): 100% = all
+                # host prep hidden behind device work, 0% = serial
+                stage_times["overlap_pct"] = round(pipe_stats.overlap_pct, 1)
+                stage_times["prefetch_prep_s"] = round(pipe_stats.prep_s, 3)
+                stage_times["prefetch_wait_s"] = round(pipe_stats.wait_s, 3)
             if replay_fused_s is not None:
                 # one wall for ALL replay epochs (single fused dispatch)
                 stage_times["replay_fused_s"] = round(replay_fused_s, 3)

@@ -28,6 +28,7 @@ import numpy as np
 
 from orange3_spark_tpu.core.domain import DiscreteVariable, Domain
 from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.exec.donate import donating_jit
 from orange3_spark_tpu.models.base import concrete_or_none, Estimator, Model, Params
 
 
@@ -67,8 +68,14 @@ def _assign(X, centers, w, compute_dtype=jnp.float32):
     return assign, cost
 
 
-@partial(jax.jit, static_argnames=("k", "max_iter", "compute_dtype"))
+@donating_jit(static_argnames=("k", "max_iter", "compute_dtype"),
+              donate_argnums=(2,))
 def _lloyd(X, w, centers0, tol, *, k: int, max_iter: int, compute_dtype=jnp.float32):
+    """Fused Lloyd loop. ``centers0`` is DONATED — every caller builds the
+    seed centers fresh (host kmeans++ / device D² sampling), and the loop
+    round-trips a same-shaped centers array, so XLA reuses the buffer. The
+    vmapped restart path calls ``_lloyd.plain`` (donation under vmap
+    tracing is a no-op)."""
     def body(carry):
         centers, _, it, _ = carry
         assign, cost = _assign(X, centers, w, compute_dtype)
@@ -291,23 +298,24 @@ class KMeans(Estimator):
 
     def _fit(self, table: TpuTable) -> KMeansModel:
         p = self.params
-        lloyd = partial(
-            _lloyd, k=p.k, max_iter=p.max_iter,
-            compute_dtype=jnp.dtype(p.compute_dtype),
-        )
+        lloyd_kw = dict(k=p.k, max_iter=p.max_iter,
+                        compute_dtype=jnp.dtype(p.compute_dtype))
         tol = jnp.float32(p.tol)
         if p.n_init <= 1:
-            centers, assign, cost, n_iter = lloyd(
-                table.X, table.W, self._init_centers(table), tol)
+            centers, assign, cost, n_iter = _lloyd(
+                table.X, table.W, self._init_centers(table), tol, **lloyd_kw)
         else:
             # all restarts advance in lockstep inside one vmapped while_loop —
-            # n_init independent Lloyd runs for roughly the cost of one
+            # n_init independent Lloyd runs for roughly the cost of one.
+            # Donation under a vmap trace is a silent no-op, so call the
+            # undonated twin rather than compile a donating executable
+            # whose aliasing can never engage.
             inits = jnp.stack([
                 self.replace_seed(s)._init_centers(table)
                 for s in range(p.seed, p.seed + p.n_init)
             ])
             centers_v, assign_v, cost_v, iter_v = jax.vmap(
-                lambda c0: lloyd(table.X, table.W, c0, tol)
+                lambda c0: _lloyd.plain(table.X, table.W, c0, tol, **lloyd_kw)
             )(inits)
             best = jnp.argmin(cost_v)
             centers, cost, n_iter = centers_v[best], cost_v[best], iter_v[best]

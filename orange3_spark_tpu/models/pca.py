@@ -94,12 +94,19 @@ class PCA(Estimator):
         return PCAModel(p, components, mean, explained, total)
 
     def fit_stream(self, source, *, session=None,
-                   chunk_rows: int = 1 << 18) -> PCAModel:
+                   chunk_rows: int = 1 << 18,
+                   stage_times: dict | None = None) -> PCAModel:
         """Out-of-core fit: ONE pass accumulating the (shift-centered)
         weighted Gramian — one MXU matmul per chunk — plus column means
         over a chunk stream (io/streaming.stream_feature_stats), then the
         same eigh finalize as the in-memory path; the 1B-row taxi
-        pipeline's PCA no longer needs the rows in memory."""
+        pipeline's PCA no longer needs the rows in memory.
+
+        The Gramian fold donates its accumulator (exec/donate.py sweep:
+        the running [d, d] stats never leave HBM and the fold reuses the
+        buffer) and the parse/DMA of chunk t+1 overlaps the fold of chunk
+        t; ``stage_times`` receives the pass's measured ``overlap_pct``
+        and ``dispatches`` (exec/pipeline.py)."""
         from orange3_spark_tpu.io.streaming import stream_feature_stats
 
         # validate k BEFORE the pass — an invalid k must fail in one chunk,
@@ -111,7 +118,8 @@ class PCA(Estimator):
                 raise ValueError(f"k={self.params.k} exceeds n_features="
                                  f"{X0.shape[1]}")
         st = stream_feature_stats(source, session=session,
-                                  chunk_rows=chunk_rows, gramian=True)
+                                  chunk_rows=chunk_rows, gramian=True,
+                                  stage_times=stage_times)
         cov = jnp.asarray(
             st["cov"] if self.params.center else st["second_moment"],
             jnp.float32)

@@ -595,8 +595,17 @@ int fcsv_write(const char* path, const float* data, long nrows, int ncols,
       if (std::isnan(v)) {
         // empty cell: the reader's parse_float returns NaN for it
       } else {
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+        // shortest round-trip float repr (needs the FULL to_chars, i.e.
+        // floating-point support — libstdc++ 10 ships only the integral
+        // overloads and leaves __cpp_lib_to_chars undefined)
         auto res = std::to_chars(tmp, tmp + sizeof tmp, v);
         buf.insert(buf.end(), tmp, res.ptr);
+#else
+        // %.9g is round-trip-exact for float32 (9 significant digits)
+        int len = std::snprintf(tmp, sizeof tmp, "%.9g", (double)v);
+        buf.insert(buf.end(), tmp, tmp + len);
+#endif
       }
     }
     buf.push_back('\n');

@@ -21,6 +21,7 @@ table's rows — compose with ``relational.with_column`` to append them.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from orange3_spark_tpu.core.domain import DiscreteVariable
@@ -65,7 +66,9 @@ class Window:
         is_start = jnp.concatenate(
             [jnp.asarray([True]), self._part_s[1:] != self._part_s[:-1]]
         )
-        self._seg_start = jnp.maximum.accumulate(jnp.where(is_start, pos, 0))
+        # lax.cummax, not jnp.maximum.accumulate: the ufunc .accumulate
+        # methods don't exist on every pinned jax (absent in 0.4.x)
+        self._seg_start = jax.lax.cummax(jnp.where(is_start, pos, 0))
         self._pos = pos
 
     # ------------------------------------------------------------- queries

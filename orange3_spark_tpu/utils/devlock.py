@@ -20,6 +20,12 @@ Child processes MUST NOT re-acquire (bench.py's retry-ladder rungs re-exec
 bench.py as children while the parent conceptually owns the device) —
 acquisition no-ops when OTPU_CHILD is set, and the flock being
 per-open-file (not per-process-tree) makes the child's skip safe.
+
+SCOPE: the lock is PER-USER only (XDG_RUNTIME_DIR or a 0700 per-uid tmp
+dir). Two harnesses run by DIFFERENT users on the same host do not see
+each other's lock — the old world-readable /tmp path gave cross-user
+exclusion but was squattable/symlinkable by any local user. Single-TPU
+hosts shared between users need external coordination.
 """
 
 from __future__ import annotations
@@ -60,7 +66,25 @@ def _default_lock_path() -> str:
     return os.path.join(d, "otpu_tpu.lock")
 
 
-LOCK_PATH = _default_lock_path()
+# LOCK_PATH is computed LAZILY on first use (module __getattr__ /
+# _get_lock_path): _default_lock_path raises loudly on a squatted dir, and
+# that failure must land where the lock is actually needed — merely
+# importing this module (e.g. bench's CPU-fallback path, which never takes
+# the lock) must stay side-effect-free.
+
+
+def _get_lock_path() -> str:
+    lp = globals().get("LOCK_PATH")
+    if lp is None:
+        lp = _default_lock_path()
+        globals()["LOCK_PATH"] = lp
+    return lp
+
+
+def __getattr__(name: str):
+    if name == "LOCK_PATH":
+        return _get_lock_path()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 class TpuDeviceLock:
@@ -94,8 +118,9 @@ class TpuDeviceLock:
             return True
         if wait_s is None:
             wait_s = float(os.environ.get("OTPU_LOCK_WAIT_S", "5400"))
+        lock_path = _get_lock_path()
         flags = os.O_CREAT | os.O_RDWR | getattr(os, "O_NOFOLLOW", 0)
-        fd = os.open(LOCK_PATH, flags, 0o600)
+        fd = os.open(lock_path, flags, 0o600)
         t0 = time.monotonic()
         logged = False
         while True:
@@ -115,7 +140,7 @@ class TpuDeviceLock:
                 if time.monotonic() - t0 > wait_s:
                     os.close(fd)
                     raise TimeoutError(
-                        f"TPU device lock {LOCK_PATH} still held after "
+                        f"TPU device lock {lock_path} still held after "
                         f"{wait_s:.0f}s — another harness is wedged? "
                         "(kill it or raise OTPU_LOCK_WAIT_S)"
                     )

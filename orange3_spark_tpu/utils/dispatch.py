@@ -16,6 +16,8 @@ import time
 
 import jax
 
+from orange3_spark_tpu.utils.profiling import count_dispatch
+
 #: steps between synchronizations; small enough to cap rendezvous pressure,
 #: large enough that the sync cost vanishes against real step times
 DISPATCH_SYNC_PERIOD = 16
@@ -39,8 +41,15 @@ def last_beat() -> float:
 
 
 def bound_dispatch(step: int, token, period: int = DISPATCH_SYNC_PERIOD) -> None:
-    """Block on ``token`` every ``period``-th ``step`` (1-based count)."""
+    """Block on ``token`` every ``period``-th ``step`` (1-based count).
+
+    Also ticks the process-wide dispatch counter (utils/profiling.py):
+    every sequential step loop calls this once per dispatched program, so
+    the counter is the bench line's ``dispatches`` field for free — only
+    the one-shot fused-scan sites (which never loop) tick it explicitly.
+    """
     beat()
+    count_dispatch()
     if step % period == 0:
         jax.block_until_ready(token)
         beat()

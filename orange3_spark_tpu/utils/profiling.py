@@ -8,18 +8,70 @@
   the "debug mode running the whole graph un-jitted" SURVEY §5 calls for
   (XLA is deterministic, so this replaces a race detector: divergence between
   jitted and unjitted runs localizes compiler-boundary bugs).
+* execution-pipeline counters — process-wide aggregates for the exec/
+  subsystem: ``count_dispatch`` ticks once per device dispatch (wired into
+  ``utils.dispatch.bound_dispatch``, which every step loop already calls,
+  plus the one-shot fused-scan sites), ``record_pipeline`` folds each
+  ``exec.pipeline.PipelinedExecutor`` stream's overlap counters in, and
+  ``exec_counters()`` snapshots both — the source of the bench line's
+  ``dispatches`` and ``overlap_pct`` fields.
 """
 
 from __future__ import annotations
 
 import contextlib
 import logging
+import threading
 import time
 from functools import wraps
 
 import jax
 
 log = logging.getLogger("orange3_spark_tpu")
+
+# ------------------------------------------------------- exec/ counters
+_exec_lock = threading.Lock()
+_exec_counts = {
+    "dispatches": 0,        # device dispatches ticked via count_dispatch
+    "prefetch_items": 0,    # items through PipelinedExecutor streams
+    "prefetch_prep_s": 0.0,  # producer busy seconds (parse/pad/device_put)
+    "prefetch_wait_s": 0.0,  # consumer blocked seconds
+}
+
+
+def count_dispatch(n: int = 1) -> None:
+    """Tick the process-wide device-dispatch counter."""
+    with _exec_lock:
+        _exec_counts["dispatches"] += n
+
+
+def record_pipeline(stats) -> None:
+    """Fold one finished ``PipelineStats`` into the process aggregate."""
+    with _exec_lock:
+        _exec_counts["prefetch_items"] += stats.items
+        _exec_counts["prefetch_prep_s"] += stats.prep_s
+        _exec_counts["prefetch_wait_s"] += stats.wait_s
+
+
+def exec_counters() -> dict:
+    """Snapshot of the exec counters, plus the derived ``overlap_pct``
+    (share of total producer time hidden behind consumer compute across
+    every recorded pipeline — see ``exec.pipeline.PipelineStats``)."""
+    with _exec_lock:
+        out = dict(_exec_counts)
+    prep = out["prefetch_prep_s"]
+    out["overlap_pct"] = (
+        100.0 * min(max(1.0 - out["prefetch_wait_s"] / prep, 0.0), 1.0)
+        if prep > 0 else 0.0
+    )
+    return out
+
+
+def reset_exec_counters() -> None:
+    """Zero the counters (benches bracket their timed window with this)."""
+    with _exec_lock:
+        for k in _exec_counts:
+            _exec_counts[k] = type(_exec_counts[k])()
 
 
 @contextlib.contextmanager

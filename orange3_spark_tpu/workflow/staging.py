@@ -28,14 +28,32 @@ from orange3_spark_tpu.workflow.graph import WorkflowGraph
 
 
 class StagedTransform:
-    """A single jitted XLA program covering a workflow's data path."""
+    """A single jitted XLA program covering a workflow's data path.
 
-    def __init__(self, fn, in_domain, out_domain, session, template: TpuTable):
-        self._jitted = jax.jit(fn)
+    ``donate_inputs=True`` donates the (X, Y, W) buffers of each call to
+    the fused program (exec/donate.py sweep) — sound ONLY for serving
+    loops that feed a fresh table per call and never touch it again (the
+    donated buffers are dead after the call). The default keeps inputs
+    intact because the eager graph's cached tables are reused."""
+
+    def __init__(self, fn, in_domain, out_domain, session, template: TpuTable,
+                 donate_inputs: bool = False):
+        # donating and plain compilations both available; picked per call
+        # so OTPU_DONATE=0 disables donation on an already-built program
+        # (the donating_jit contract — the switch is read per call)
+        self._plain = jax.jit(fn)
+        self._donating = (jax.jit(fn, donate_argnums=(0, 1, 2))
+                          if donate_inputs else self._plain)
         self.in_domain = in_domain
         self.out_domain = out_domain
         self.session = session
         self._template = template  # shape/domain reference for validation
+
+    @property
+    def _jitted(self):
+        from orange3_spark_tpu.exec.donate import donation_enabled
+
+        return self._donating if donation_enabled() else self._plain
 
     def __call__(self, table: TpuTable) -> TpuTable:
         if table.domain != self.in_domain:
@@ -70,13 +88,15 @@ def _staged_step(node) -> Callable[[TpuTable], TpuTable] | None:
 
 
 def stage_transform_path(
-    graph: WorkflowGraph, source: int, sink: int
+    graph: WorkflowGraph, source: int, sink: int,
+    donate_inputs: bool = False,
 ) -> StagedTransform:
     """Fuse the data path source→sink of an already-run graph into one jit.
 
     ``source`` must be a data-emitting node (its cached 'data' output is the
     template); every node along the 'data' edges to ``sink`` must be a
-    transformer/fitted-estimator/apply widget.
+    transformer/fitted-estimator/apply widget. ``donate_inputs`` — see
+    ``StagedTransform``.
     """
     outputs = graph.run()
     # walk the unique 'data'-port chain from source to sink
@@ -120,7 +140,8 @@ def stage_transform_path(
             t = step(t)
         return t.X, t.Y, t.W
 
-    return StagedTransform(fused, in_domain, out_domain, session, template)
+    return StagedTransform(fused, in_domain, out_domain, session, template,
+                           donate_inputs=donate_inputs)
 
 
 class StagedGraph:
@@ -137,8 +158,20 @@ class StagedGraph:
     """
 
     def __init__(self, fn, input_keys, templates, out_domain, out_meta,
-                 session, frontier, refit_fallbacks=()):
-        self._jitted = jax.jit(fn)
+                 session, frontier, refit_fallbacks=(),
+                 donate_inputs: bool = False):
+        # donate_inputs: each boundary input's (X, Y, W) buffers are
+        # consumed by the call — for the refit-loop case (fresh batches
+        # through replacements= every call, staged fit+transform in one
+        # dispatch) the spent batch's HBM frees immediately. Unsound with
+        # the default template-fed call, hence opt-in (see StagedTransform).
+        # Both compilations stay available; picked per call so OTPU_DONATE=0
+        # disables donation on an already-built program.
+        self._plain = jax.jit(fn)
+        self._donating = (
+            jax.jit(fn, donate_argnums=tuple(range(len(input_keys))))
+            if donate_inputs else self._plain
+        )
         self.input_keys = input_keys            # [(nid, port), ...] arg order
         self.templates = templates              # {(nid, port): TpuTable}
         self.out_domain = out_domain
@@ -148,6 +181,12 @@ class StagedGraph:
         # estimator nodes that stayed on closed-over fitted state under
         # refit=True because their fit would not trace
         self.refit_fallbacks = list(refit_fallbacks)
+
+    @property
+    def _jitted(self):
+        from orange3_spark_tpu.exec.donate import donation_enabled
+
+        return self._donating if donation_enabled() else self._plain
 
     def _flat_args(self, replacements=None):
         args = []
@@ -168,7 +207,23 @@ class StagedGraph:
         """Execute the fused program; ``replacements`` substitutes new tables
         for boundary input nodes (same domains/shapes — the compiled program
         is reused)."""
-        X, Y, W = self._jitted(*self._flat_args(replacements))
+        jitted = self._jitted
+        if jitted is self._donating and jitted is not self._plain:
+            # donating call: every input buffer is consumed. Any input not
+            # covered by replacements would come from the cached templates,
+            # whose deletion breaks every later call — fail NOW with the
+            # reason instead of later with 'Array has been deleted'
+            missing = [k for k in self.input_keys
+                       if not replacements or k[0] not in replacements]
+            if missing:
+                raise ValueError(
+                    "donate_inputs=True staged call must pass replacements "
+                    f"for every boundary input (missing nodes "
+                    f"{sorted({k[0] for k in missing})}); the cached "
+                    "template tables cannot be donated — they are reused "
+                    "by later calls"
+                )
+        X, Y, W = jitted(*self._flat_args(replacements))
         if replacements:
             # every staged widget is row-preserving, so the output's LOGICAL
             # row count follows the (row-aligned) inputs of THIS call — the
@@ -271,7 +326,7 @@ def _fit_traces(widget, template: TpuTable) -> tuple[bool, str | None]:
 
 def stage_graph(
     graph: WorkflowGraph, sink: int, sink_port: str = "data",
-    refit: bool = False,
+    refit: bool = False, donate_inputs: bool = False,
 ) -> StagedGraph:
     """Fuse the whole stageable DAG feeding ``sink`` into one jitted program.
 
@@ -293,6 +348,10 @@ def stage_graph(
     ``refit_fallbacks``. OWApplyModel always applies its eagerly-fitted
     upstream model (models do not flow through the staged region as
     signals).
+
+    ``donate_inputs=True`` (exec/donate.py sweep): every call consumes its
+    input tables' buffers — pair with ``refit=True`` serving/refit loops
+    that pass fresh ``replacements`` each call and never reuse them.
     """
     outputs = graph.run()
     sink_fn, reason = _node_stage_fn(graph, sink, outputs)
@@ -427,7 +486,7 @@ def stage_graph(
     return StagedGraph(
         fused, input_keys, in_templates, sink_table.domain,
         (sink_table.metas, sink_table.n_rows), session, frontier,
-        refit_fallbacks,
+        refit_fallbacks, donate_inputs=donate_inputs,
     )
 
 

@@ -15,12 +15,77 @@ import sys
 # timeouts here give slow-but-progressing collectives minutes instead of the
 # default seconds — while still ABORTING (visibly) on a genuine deadlock
 # rather than hanging CI forever.
+#
+# XLA aborts the PROCESS on any flag it does not know (parse_flags_from_env
+# is a fatal check, not a warning), and the collective-timeout flags do not
+# exist in every jaxlib this repo runs against — passing them blindly turned
+# the whole suite into a collection-time SIGABRT. Probe flag support ONCE in
+# a subprocess (the abort is uncatchable in-process) and cache the verdict
+# per jaxlib version, so every later pytest run pays zero probe cost.
+_COLLECTIVE_FLAGS = (
+    " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+    " --xla_cpu_collective_call_terminate_timeout_seconds=900"
+    " --xla_cpu_collective_timeout_seconds=900"
+)
+
+
+def _collective_flags_supported() -> bool:
+    import hashlib
+    import json
+    import subprocess
+    import tempfile
+
+    try:
+        from jaxlib import version as _jlv  # does not init any backend
+
+        ver = _jlv.__version__
+    except Exception:  # noqa: BLE001 - fall back to a shared cache key
+        ver = "unknown"
+    # the flag set is part of the key: a cached verdict for an OLD flag
+    # list must never vouch for an edited one (an unknown flag is an
+    # uncatchable SIGABRT — the exact failure this probe prevents)
+    fhash = hashlib.sha256(_COLLECTIVE_FLAGS.encode()).hexdigest()[:12]
+    cache = os.path.join(
+        tempfile.gettempdir(),
+        f"otpu_xla_flags_{os.getuid()}_{ver}_{fhash}.json"
+    )
+    try:
+        # trust the cache only if WE wrote it (a squatter's pre-created
+        # file could claim support and re-introduce the collection abort —
+        # the devlock.py /tmp lesson), and only a positive verdict: a
+        # cached transient failure would silently drop the deadlock
+        # timeouts forever, while re-probing costs a few seconds
+        if os.stat(cache).st_uid == os.getuid():
+            with open(cache) as f:
+                if bool(json.load(f)["collective_flags_ok"]):
+                    return True
+    except (OSError, ValueError, KeyError):
+        pass
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=2"
+                        + _COLLECTIVE_FLAGS)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        ok = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=120,
+        ).returncode == 0
+    except Exception:  # noqa: BLE001 - treat a wedged probe as unsupported
+        ok = False
+    if ok:
+        try:
+            with open(cache, "w") as f:
+                json.dump({"collective_flags_ok": ok}, f)
+        except OSError:
+            pass
+    return ok
+
+
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
     + " --xla_force_host_platform_device_count=8"
-    + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-    + " --xla_cpu_collective_call_terminate_timeout_seconds=900"
-    + " --xla_cpu_collective_timeout_seconds=900"
+    + (_COLLECTIVE_FLAGS if _collective_flags_supported() else "")
 )
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

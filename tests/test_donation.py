@@ -1,0 +1,250 @@
+"""Donation sweep parity (exec/donate.py): donation is pure buffer
+aliasing, so every swept training loop must produce BIT-identical results
+with donation on (default) and off (OTPU_DONATE=0). One fit per mode per
+model; np.testing.assert_array_equal, no tolerances."""
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.datasets import make_classification
+from orange3_spark_tpu.exec.donate import donating_jit, donation_enabled
+from orange3_spark_tpu.io.streaming import (
+    StreamingKMeans,
+    StreamingLinearEstimator,
+    array_chunk_source,
+    stream_feature_stats,
+)
+from orange3_spark_tpu.models.hashed_linear import (
+    StreamingHashedLinearEstimator,
+)
+
+
+def _fit_both_ways(monkeypatch, fit):
+    """Run ``fit()`` donation-on then donation-off, return both results."""
+    monkeypatch.delenv("OTPU_DONATE", raising=False)
+    assert donation_enabled()
+    on = fit()
+    monkeypatch.setenv("OTPU_DONATE", "0")
+    assert not donation_enabled()
+    off = fit()
+    return on, off
+
+
+def _criteo_shaped(n, n_dense=4, n_cat=6, card=50, seed=0):
+    rng = np.random.default_rng(seed)
+    dense = rng.standard_normal((n, n_dense)).astype(np.float32)
+    cats = rng.integers(0, card, size=(n, n_cat)).astype(np.float32)
+    y = (dense[:, 0] + 0.3 * rng.standard_normal(n) > 0).astype(np.float32)
+    return np.concatenate([dense, cats], axis=1), y
+
+
+def test_donating_jit_switch_and_twins():
+    import jax.numpy as jnp
+
+    @donating_jit(donate_argnums=(0,))
+    def inc(acc, x):
+        return acc + x
+
+    a = jnp.zeros((8,))
+    out = inc(a, jnp.ones((8,)))
+    np.testing.assert_array_equal(np.asarray(out), np.ones(8))
+    assert inc.donate_argnums == (0,)
+    # the undonated twin never invalidates its input
+    b = jnp.zeros((8,))
+    inc.plain(b, jnp.ones((8,)))
+    np.testing.assert_array_equal(np.asarray(b), np.zeros(8))
+
+
+def test_hashed_linear_donation_parity(session, monkeypatch):
+    Xall, y = _criteo_shaped(4096, seed=1)
+
+    def fit():
+        return StreamingHashedLinearEstimator(
+            n_dims=1 << 12, n_dense=4, n_cat=6, epochs=3, step_size=0.05,
+            chunk_rows=1024,
+        ).fit_stream(array_chunk_source(Xall, y, chunk_rows=1024),
+                     session=session, cache_device=True)
+
+    on, off = _fit_both_ways(monkeypatch, fit)
+    assert on.n_steps_ == off.n_steps_
+    np.testing.assert_array_equal(
+        np.asarray(on.theta["emb"]), np.asarray(off.theta["emb"]))
+    np.testing.assert_array_equal(
+        np.asarray(on.theta["coef"]), np.asarray(off.theta["coef"]))
+
+
+def test_streaming_linear_donation_parity(session, monkeypatch):
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((3000, 6)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+
+    def fit():
+        return StreamingLinearEstimator(
+            loss="logistic", epochs=3, chunk_rows=512,
+        ).fit_stream(array_chunk_source(X, y, chunk_rows=512),
+                     n_features=6, session=session, cache_device=True)
+
+    on, off = _fit_both_ways(monkeypatch, fit)
+    np.testing.assert_array_equal(np.asarray(on.coef), np.asarray(off.coef))
+    np.testing.assert_array_equal(
+        np.asarray(on.intercept), np.asarray(off.intercept))
+
+
+def test_streaming_kmeans_donation_parity(session, monkeypatch):
+    rng = np.random.default_rng(3)
+    X = np.concatenate([
+        rng.normal(0, 1, (1500, 5)), rng.normal(6, 1, (1500, 5))
+    ]).astype(np.float32)
+
+    def fit():
+        return StreamingKMeans(
+            k=4, epochs=3, chunk_rows=512, seed=0,
+        ).fit_stream(array_chunk_source(X, chunk_rows=512),
+                     n_features=5, session=session, cache_device=True)
+
+    on, off = _fit_both_ways(monkeypatch, fit)
+    np.testing.assert_array_equal(
+        np.asarray(on.centers), np.asarray(off.centers))
+
+
+def test_inmemory_kmeans_lloyd_donation_parity(session, monkeypatch):
+    from orange3_spark_tpu.models.kmeans import KMeans
+
+    t = make_classification(2048, 5, n_classes=3, seed=4, session=session)
+
+    def fit():
+        return KMeans(k=3, max_iter=15, seed=0).fit(t)
+
+    on, off = _fit_both_ways(monkeypatch, fit)
+    np.testing.assert_array_equal(
+        np.asarray(on.centers), np.asarray(off.centers))
+
+
+def test_feature_stats_gramian_donation_parity(session, monkeypatch):
+    """The scaler/Imputer/PCA fit_stream accumulator (donated dict)."""
+    rng = np.random.default_rng(5)
+    X = rng.standard_normal((4000, 6)).astype(np.float32)
+
+    def fit():
+        return stream_feature_stats(
+            array_chunk_source(X, chunk_rows=512), session=session,
+            chunk_rows=512, gramian=True)
+
+    on, off = _fit_both_ways(monkeypatch, fit)
+    for key in ("count", "mean", "var", "min", "max", "cov"):
+        np.testing.assert_array_equal(np.asarray(on[key]),
+                                      np.asarray(off[key]))
+
+
+def test_fit_linear_donate_data_parity(session, monkeypatch):
+    """fit_linear's opt-in data donation: callers owning transient batches
+    may donate (X, y, w); results match the borrowing call bit-for-bit."""
+    import jax.numpy as jnp
+
+    from orange3_spark_tpu.models._linear import fit_linear
+
+    rng = np.random.default_rng(6)
+    Xn = rng.standard_normal((1024, 5)).astype(np.float32)
+    yn = (Xn[:, 0] > 0).astype(np.float32)
+    wn = np.ones((1024,), np.float32)
+
+    def run(donate):
+        r = fit_linear(
+            jnp.asarray(Xn), jnp.asarray(yn), jnp.asarray(wn),
+            jnp.float32(1e-4), jnp.float32(1e-6), jnp.int32(25),
+            loss_kind="logistic", k=2, donate_data=donate,
+        )
+        return np.asarray(r.coef), np.asarray(r.intercept)
+
+    coef_d, int_d = run(True)
+    coef_p, int_p = run(False)
+    np.testing.assert_array_equal(coef_d, coef_p)
+    np.testing.assert_array_equal(int_d, int_p)
+    # and the global switch turns donate_data into a no-op
+    monkeypatch.setenv("OTPU_DONATE", "0")
+    coef_o, int_o = run(True)
+    np.testing.assert_array_equal(coef_o, coef_p)
+
+
+def test_staged_graph_donate_inputs_parity(session):
+    """Staged-program input donation (workflow/staging.py): a donating
+    staged graph fed FRESH tables per call matches the non-donating one."""
+    from orange3_spark_tpu.widgets.catalog import WIDGET_REGISTRY, OWTable
+    from orange3_spark_tpu.workflow.graph import WorkflowGraph
+    from orange3_spark_tpu.workflow.staging import stage_graph
+
+    t = make_classification(512, 6, n_classes=2, seed=7, session=session)
+
+    def build():
+        g = WorkflowGraph()
+        src = g.add(OWTable(t))
+        sc = g.add(WIDGET_REGISTRY["OWStandardScaler"]())
+        g.connect(src, "data", sc, "data")
+        return g, src, sc
+
+    g1, src1, sc1 = build()
+    plain = stage_graph(g1, sc1)
+    g2, src2, sc2 = build()
+    donating = stage_graph(g2, sc2, donate_inputs=True)
+
+    fresh_a = make_classification(512, 6, n_classes=2, seed=8,
+                                  session=session)
+    fresh_b = make_classification(512, 6, n_classes=2, seed=8,
+                                  session=session)
+    out_p = plain(replacements={src1: fresh_a})
+    out_d = donating(replacements={src2: fresh_b})  # consumes fresh_b
+    np.testing.assert_array_equal(np.asarray(out_p.X), np.asarray(out_d.X))
+
+
+def test_empty_binary_stream_raises(session):
+    """ADVICE r5 #3: the binary streaming evaluator must fail loudly on an
+    empty stream like its multiclass/regression siblings."""
+    from orange3_spark_tpu.models.evaluation import evaluate_binary_stream
+
+    def empty_source():
+        return iter(())
+
+    with pytest.raises(ValueError, match="stream produced no chunks"):
+        evaluate_binary_stream(lambda X: X[:, 0], empty_source,
+                               session=session, chunk_rows=256)
+
+
+def test_all_missing_column_minmax_masked(session):
+    """ADVICE r5 #4: an all-missing column's min/max get the dead-column
+    fill (0), not the ±FLT_MAX accumulator sentinels."""
+    rng = np.random.default_rng(9)
+    X = rng.standard_normal((1000, 3)).astype(np.float32)
+    X[:, 1] = np.nan
+    st = stream_feature_stats(
+        array_chunk_source(X, chunk_rows=256), session=session,
+        chunk_rows=256, missing_value=float("nan"))
+    assert st["count"][1] == 0.0
+    assert st["mean"][1] == 0.0
+    assert st["min"][1] == 0.0
+    assert st["max"][1] == 0.0
+    # live columns unaffected
+    assert abs(st["min"][0] - X[:, 0].min()) < 1e-5
+    assert abs(st["max"][2] - X[:, 2].max()) < 1e-5
+
+
+def test_score_stream_label_presence_flip_raises(session, tmp_path):
+    """ADVICE r5 #5: a stream whose label presence flips after the
+    schema-defining first chunk dies with a descriptive error, not a
+    pyarrow names/columns mismatch."""
+    from orange3_spark_tpu.io.streaming import score_stream
+
+    rng = np.random.default_rng(10)
+    X1 = rng.standard_normal((512, 3)).astype(np.float32)
+    X2 = rng.standard_normal((512, 3)).astype(np.float32)
+    y2 = (X2[:, 0] > 0).astype(np.float32)
+
+    def mixed_source():
+        yield X1, None        # unlabeled: schema fixed WITHOUT 'label'
+        yield X2, y2          # labeled: presence flip mid-stream
+
+    out = str(tmp_path / "scored.parquet")
+    with pytest.raises(ValueError, match="label presence"):
+        score_stream(lambda Xd: Xd[:, 0], lambda: mixed_source(), out,
+                     session=session, chunk_rows=512)
+    assert not any(p.name.startswith("scored.parquet.tmp")
+                   for p in tmp_path.iterdir())
