@@ -711,6 +711,194 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
     }
 
 
+def bench_serving(n_rows: int, *, dims: int = 1 << 18,
+                  backend: str = "") -> dict:
+    """Serving bench (serve/ subsystem): the predict hot path on the Criteo
+    CTR model under a MIXED-batch-size request trace.
+
+    Three phases over the same deterministic trace of request sizes
+    (log-uniform 16..8192 rows — the "millions of users" shape: many
+    concurrent small/medium batches, few analytical ones):
+
+      raw       no ServingContext — every distinct request size compiles
+                its own XLA program (the pathology this PR removes);
+      bucketed  ServingContext with the default pow2 ladder, warmed —
+                requests pad to a handful of bucket shapes sharing AOT
+                executables (warmup compiles COUNT toward its recompile
+                total: the claim is fewer compiles, not hidden ones);
+      coalesced bucketed + micro-batcher, the trace's small requests
+                submitted from a thread pool — measures the merge factor
+                and the coalesced throughput.
+
+    Headline value = bucketed serving rows/sec/chip; `recompiles` vs
+    `recompiles_unbucketed` carries the ISSUE's >=5x acceptance criterion;
+    p50_ms/p99_ms are per-request latencies (the raw p99 shows the
+    compile spikes, the bucketed p99 shows none after warmup)."""
+    import concurrent.futures
+
+    import jax
+    import numpy as np
+
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.io.streaming import csv_raw_chunk_source
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+    from orange3_spark_tpu.serve import BucketLadder, ServingContext
+    from orange3_spark_tpu.utils.profiling import (
+        install_compile_counter, reset_serve_counters, serve_counters,
+        xla_compile_count,
+    )
+
+    path = ensure_criteo_csv(n_rows)
+    session = TpuSession.builder_get_or_create()
+    n_chips = session.n_devices
+    compile_counter_live = install_compile_counter()
+
+    # quick fit on the CSV head — the model under serve is the bench's
+    # REAL CTR model (hashed-sparse logreg), just not fitted to convergence
+    # (serving latency does not depend on fit quality)
+    fit_chunks = 4
+    def head_source():
+        it = csv_raw_chunk_source(path, chunk_rows=CHUNK_ROWS)()
+        for i, c in enumerate(it):
+            if i >= fit_chunks:
+                break
+            yield c
+    est = StreamingHashedLinearEstimator(
+        n_dims=dims, n_dense=N_DENSE, n_cat=N_CAT, epochs=1,
+        step_size=STEP_SIZE, chunk_rows=CHUNK_ROWS, label_in_chunk=True,
+    )
+    _log(f"[serving] fitting the CTR model on {fit_chunks} chunks ...")
+    model = est.fit_stream(head_source, session=session)
+
+    # request pool: 512k parsed rows, label column stripped (raw chunks
+    # are plain [n, 1+39] f32 arrays, label first — label_in_chunk layout)
+    pool = []
+    for chunk in head_source():
+        pool.append(np.asarray(chunk)[:, 1:])
+        if sum(p.shape[0] for p in pool) >= (1 << 19):
+            break
+    pool = np.ascontiguousarray(
+        np.concatenate(pool)[: 1 << 19].astype(np.float32))
+
+    # deterministic mixed-size trace: log-uniform over [16, 8192] — many
+    # distinct sizes (the raw path compiles one program per distinct size)
+    rng = np.random.default_rng(11)
+    n_requests = int(os.environ.get("OTPU_SERVE_REQUESTS", "120"))
+    max_req = min(8192, pool.shape[0])
+    if max_req < 16:
+        raise SystemExit(
+            f"--rows {n_rows} leaves only a {pool.shape[0]}-row request "
+            "pool; the serving trace needs at least 16 rows")
+    sizes = np.exp(
+        rng.uniform(np.log(16), np.log(max_req), n_requests)).astype(np.int64)
+    offs = rng.integers(0, pool.shape[0] - int(sizes.max()) + 1, len(sizes))
+    trace = [(int(o), int(s)) for o, s in zip(offs, sizes)]
+    _log(f"[serving] trace: {len(trace)} requests, "
+         f"{len(set(s for _, s in trace))} distinct sizes, "
+         f"{sum(s for _, s in trace)} total rows")
+
+    def run_trace() -> tuple[list, float]:
+        lat = []
+        t0 = time.perf_counter()
+        for off, sz in trace:
+            t1 = time.perf_counter()
+            out = model.predict(pool[off:off + sz])
+            assert out.shape[0] == sz
+            lat.append((time.perf_counter() - t1) * 1e3)
+        return lat, time.perf_counter() - t0
+
+    def pctl(lat, q):
+        return round(float(np.percentile(np.asarray(lat), q)), 3)
+
+    total_rows = sum(s for _, s in trace)
+
+    # ---- phase 1: raw (unbucketed) — per-shape jit compiles ----
+    _log("[serving] raw (unbucketed) trace ...")
+    c0 = xla_compile_count()
+    lat_raw, wall_raw = run_trace()
+    recompiles_raw = xla_compile_count() - c0
+
+    # ---- phase 2: bucketed + warmed AOT cache ----
+    ladder = BucketLadder(min_bucket=256, max_bucket=1 << 14)
+    reset_serve_counters()
+    ctx = ServingContext(ladder)
+    with ctx:
+        _log("[serving] warmup (AOT-compiling the bucket ladder) ...")
+        c0 = xla_compile_count()
+        t0 = time.perf_counter()
+        warm = ctx.warmup(model, n_cols=pool.shape[1],
+                          kinds=("array",), session=session)
+        warmup_s = time.perf_counter() - t0
+        _log(f"[serving] bucketed trace (warmed {warm['compiled']} "
+             f"buckets in {warmup_s:.1f}s) ...")
+        lat_b, wall_b = run_trace()
+        recompiles_b = xla_compile_count() - c0   # warmup compiles INCLUDED
+        sc = serve_counters()
+
+    # ---- phase 3: bucketed + micro-batch, concurrent small requests ----
+    small = [(o, s) for o, s in trace if s <= 1024] * 2
+    mb_rows = sum(s for _, s in small)
+    with ServingContext(ladder, micro_batch=True, max_batch=8192,
+                        max_wait_ms=2.0) as ctx_mb:
+        ctx_mb.warmup(model, n_cols=pool.shape[1], kinds=("array",),
+                      session=session)
+        reset_serve_counters()
+        _log(f"[serving] coalesced trace ({len(small)} concurrent "
+             f"requests) ...")
+        with concurrent.futures.ThreadPoolExecutor(16) as ex:
+            t0 = time.perf_counter()
+            futs = [ex.submit(model.predict, pool[o:o + s]) for o, s in small]
+            for f in futs:
+                f.result()
+            wall_mb = time.perf_counter() - t0
+    mb = serve_counters()
+
+    rate = total_rows / wall_b / n_chips
+    return {
+        "metric": "criteo_serving_predict_rows_per_sec_per_chip",
+        "value": round(rate, 1),
+        "unit": "rows/s/chip",
+        "vs_baseline": None,   # no published serving reference (BASELINE.md)
+        "backend": backend or jax.default_backend(),
+        "rows": n_rows,
+        "requests": len(trace),
+        "distinct_sizes": len(set(s for _, s in trace)),
+        "trace_rows": total_rows,
+        # ---- the acceptance-criterion pair ----
+        "recompiles": recompiles_b,
+        "recompiles_unbucketed": recompiles_raw,
+        "compile_reduction": (round(recompiles_raw / recompiles_b, 2)
+                              if recompiles_b else None),
+        "compile_counter": ("jax.monitoring" if compile_counter_live
+                            else "unavailable"),
+        # ---- latency/throughput, bucketed serving path ----
+        "p50_ms": pctl(lat_b, 50),
+        "p99_ms": pctl(lat_b, 99),
+        "wall_s": round(wall_b, 3),
+        "warmup_s": round(warmup_s, 2),
+        "warmup_buckets": warm["compiled"],
+        "bucket_hits": sc["bucket_hits"],
+        "bucket_misses": sc["bucket_misses"],
+        "aot_hits": sc["aot_hits"],
+        "pad_overhead": (round(sc["pad_overhead"], 3)
+                         if sc["pad_overhead"] else None),
+        # ---- raw-path comparison ----
+        "p50_ms_unbucketed": pctl(lat_raw, 50),
+        "p99_ms_unbucketed": pctl(lat_raw, 99),
+        "wall_s_unbucketed": round(wall_raw, 3),
+        "unbucketed_rows_per_sec_per_chip": round(
+            total_rows / wall_raw / n_chips, 1),
+        # ---- micro-batcher phase ----
+        "mb_requests": mb["mb_requests"],
+        "mb_batches": mb["mb_batches"],
+        "mb_merge_factor": (round(mb["mb_merge_factor"], 2)
+                            if mb["mb_merge_factor"] else None),
+        "mb_rows_per_sec_per_chip": round(mb_rows / wall_mb / n_chips, 1),
+    }
+
+
 def bench_dense_logreg() -> dict:
     """Round-1 secondary bench: dense in-memory L-BFGS LogReg (kept for
     continuity with BENCH_r01.json)."""
@@ -760,10 +948,12 @@ def bench_dense_logreg() -> dict:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="criteo",
-                    choices=["criteo", "dense_logreg"])
+                    choices=["criteo", "dense_logreg", "serving"])
     ap.add_argument("--rows", type=int, default=N_ROWS)
     ap.add_argument("--epochs", type=int, default=EPOCHS)
-    ap.add_argument("--dims", type=int, default=N_DIMS)
+    # None = per-config default (criteo N_DIMS, serving's lighter 1<<18 —
+    # serving measures dispatch latency, not table capacity)
+    ap.add_argument("--dims", type=int, default=None)
     ap.add_argument("--step-size", type=float, default=STEP_SIZE)
     ap.add_argument("--reg", type=float, default=REG_PARAM)
     ap.add_argument("--cache-bytes", type=int, default=8 << 30,
@@ -818,7 +1008,8 @@ def main():
 
 
 def _main_locked(args, rows, cpu_rows, lk, t_budget0, force_cpu=False):
-    if args.config == "criteo":
+    csv_config = args.config in ("criteo", "serving")
+    if csv_config:
         # BEFORE the first probe: an open tunnel window must be spent
         # measuring, never generating (pure numpy/pyarrow — cannot wedge
         # on the accelerator plugin)
@@ -826,7 +1017,7 @@ def _main_locked(args, rows, cpu_rows, lk, t_budget0, force_cpu=False):
     # probe outages also pre-generate the reduced CPU-fallback CSV, so
     # even the fallback path starts measuring immediately
     waiting = (lambda: ensure_criteo_csv(min(rows, cpu_rows))) \
-        if args.config == "criteo" else None
+        if csv_config else None
     platform = "" if force_cpu else backend_guard(while_waiting=waiting)
     fell_back = not platform
     mid_run_death = ""  # non-empty: the cause string for backend_note
@@ -1013,7 +1204,7 @@ def _main_locked(args, rows, cpu_rows, lk, t_budget0, force_cpu=False):
         # may open during exactly this stretch; lk is None on the
         # lock-timeout force_cpu path — nothing to release)
         lk.release()
-    if platform == "cpu" and args.config == "criteo" and rows > cpu_rows:
+    if platform == "cpu" and csv_config and rows > cpu_rows:
         # whether probed-as-cpu or fallen back: the full-scale config on a
         # host CPU is a multi-hour run nobody asked for — cap it (raise
         # OTPU_CPU_FALLBACK_ROWS to override)
@@ -1026,16 +1217,23 @@ def _main_locked(args, rows, cpu_rows, lk, t_budget0, force_cpu=False):
         # can legitimately out-sleep any sane threshold (the criteo
         # streaming path beats constantly, but gate uniformly with
         # bench_suite for one rule)
-        start_stall_watchdog("criteo_hashed_logreg_rows_per_sec_per_chip"
-                             if args.config == "criteo"
-                             else "logreg_fit_rows_per_sec_per_chip")
+        start_stall_watchdog(
+            {"criteo": "criteo_hashed_logreg_rows_per_sec_per_chip",
+             "serving": "criteo_serving_predict_rows_per_sec_per_chip"}
+            .get(args.config, "logreg_fit_rows_per_sec_per_chip"))
 
     def run():
         if args.config == "criteo":
-            return bench_criteo(rows, args.epochs, dims=args.dims,
+            return bench_criteo(rows, args.epochs,
+                                dims=(N_DIMS if args.dims is None
+                                      else args.dims),
                                 step_size=args.step_size, reg=args.reg,
                                 backend=platform,
                                 cache_bytes=args.cache_bytes)
+        if args.config == "serving":
+            return bench_serving(
+                rows, backend=platform,
+                **({} if args.dims is None else {"dims": args.dims}))
         return bench_dense_logreg()
 
     if args.profile:

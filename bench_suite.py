@@ -384,6 +384,101 @@ def bench_dispatch_overhead(scale: float) -> dict:
     }
 
 
+# --------------------------------------------------- serving-ladder bench
+def bench_serving_ladders(scale: float) -> dict:
+    """Bucket-ladder sweep (serve/ subsystem): the same mixed-size predict
+    trace through three ServingContext ladders —
+
+      none      identity ladder: every request size is its own bucket (the
+                unbucketed baseline, but THROUGH the serve path so cache/
+                counters behave identically);
+      pow2      the default log-ladder (compile count ~log of size range);
+      fixed-64  64-row steps: tightest padding waste, linearly many
+                executables.
+
+    Per ladder: XLA compile count over the sweep (warmup is on-demand
+    here — first touch of each bucket), p50/p99 request latency, wall,
+    and padding overhead. The expected shape: compiles none >> fixed-64 >
+    pow2, pad_overhead pow2 > fixed-64 > none = 1.0."""
+    import numpy as np
+
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+    from orange3_spark_tpu.serve import BucketLadder, ServingContext
+    from orange3_spark_tpu.utils.profiling import (
+        install_compile_counter, reset_serve_counters, serve_counters,
+        xla_compile_count,
+    )
+
+    n_rows = max(1 << 15, int((1 << 17) * scale))
+    n_dense, n_cat, dims = 4, 8, 1 << 14
+    session = TpuSession.builder_get_or_create()
+    install_compile_counter()
+    rng = np.random.default_rng(13)
+    dense = rng.standard_normal((n_rows, n_dense)).astype(np.float32)
+    cats = rng.integers(0, 1000, (n_rows, n_cat)).astype(np.float32)
+    y = (dense[:, 0] + 0.3 * rng.standard_normal(n_rows) > 0
+         ).astype(np.float32)
+    Xall = np.concatenate([dense, cats], axis=1)
+    _log("[serving-ladders] fitting the hashed model ...")
+    model = StreamingHashedLinearEstimator(
+        n_dims=dims, n_dense=n_dense, n_cat=n_cat, epochs=2,
+        step_size=0.05, chunk_rows=1 << 14,
+    ).fit_stream(array_chunk_source(Xall, y, chunk_rows=1 << 14),
+                 session=session)
+
+    n_requests = 48
+    sizes = np.exp(rng.uniform(np.log(16), np.log(4096), n_requests)
+                   ).astype(np.int64)
+    offs = rng.integers(0, n_rows - int(sizes.max()), n_requests)
+    trace = [(int(o), int(s)) for o, s in zip(offs, sizes)]
+    total_rows = sum(s for _, s in trace)
+
+    ladders = {
+        "none": BucketLadder(mode="none", max_bucket=1 << 13),
+        "pow2": BucketLadder(min_bucket=64, max_bucket=1 << 13),
+        "fixed64": BucketLadder(mode="fixed", fixed_step=64,
+                                max_bucket=1 << 13),
+    }
+    sweep = {}
+    for name, ladder in ladders.items():
+        _log(f"[serving-ladders] ladder {name} ...")
+        reset_serve_counters()
+        c0 = xla_compile_count()
+        lat = []
+        with ServingContext(ladder, max_entries=256):
+            t0 = time.perf_counter()
+            for off, sz in trace:
+                t1 = time.perf_counter()
+                out = model.predict(Xall[off:off + sz])
+                assert out.shape[0] == sz
+                lat.append((time.perf_counter() - t1) * 1e3)
+            wall = time.perf_counter() - t0
+        sc = serve_counters()
+        sweep[name] = {
+            "recompiles": xla_compile_count() - c0,
+            "p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "p99_ms": round(float(np.percentile(lat, 99)), 3),
+            "wall_s": round(wall, 3),
+            "pad_overhead": (round(sc["pad_overhead"], 3)
+                             if sc["pad_overhead"] else None),
+            "bucket_hits": sc["bucket_hits"],
+        }
+    return {
+        "metric": "serving_bucket_ladder_sweep", "unit": "s",
+        "value": sweep["pow2"]["wall_s"], "vs_baseline": None,
+        "requests": n_requests, "trace_rows": total_rows,
+        "distinct_sizes": len(set(s for _, s in trace)),
+        "sweep": sweep,
+        "pow2_compile_reduction": round(
+            sweep["none"]["recompiles"]
+            / max(sweep["pow2"]["recompiles"], 1), 2),
+    }
+
+
 def main():
     from orange3_spark_tpu.io.native import tune_malloc
     from orange3_spark_tpu.utils.devlock import tpu_device_lock
@@ -391,7 +486,7 @@ def main():
     tune_malloc()  # dedicated bench process: keep big buffers resident
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="all",
-                    choices=["3", "4", "5", "6", "all"])
+                    choices=["3", "4", "5", "6", "7", "all"])
     ap.add_argument("--rows-scale", type=float, default=1.0)
     args = ap.parse_args()
     # serialize against any other TPU harness (see utils/devlock.py)
@@ -427,8 +522,10 @@ def _main_locked(args, lk):
         # TPU — keep the lock in that case
         lk.release()
     benches = {"3": bench_higgs_trees, "4": bench_movielens_als,
-               "5": bench_taxi_pipeline, "6": bench_dispatch_overhead}
-    keys = ["3", "4", "5", "6"] if args.config == "all" else [args.config]
+               "5": bench_taxi_pipeline, "6": bench_dispatch_overhead,
+               "7": bench_serving_ladders}
+    keys = (["3", "4", "5", "6", "7"] if args.config == "all"
+            else [args.config])
     failed = []
     for k in keys:
         try:

@@ -150,25 +150,39 @@ def _solve_side(idx, other_idx, rating, w, other_factors, n_entities: int,
     return x
 
 
+def _als_init(seed: int, n_users: int, n_items: int, rank: int):
+    """Factor init, EAGER on purpose: generated inside ``_als_fit`` the
+    GSPMD sharding constraint on the factors propagates backward into the
+    ``jax.random.normal`` lowering, and with this jaxlib's default
+    non-partitionable threefry the generated BITS then depend on the
+    factor sharding — a model-axis-sharded fit started from a different
+    random init than the replicated fit and diverged wholesale (the
+    round-5 'ALS-sharding drift' failures, root-caused this round).
+    Outside any jit the generation is never partitioned, so every layout
+    starts from identical factors."""
+    key_u, key_v = jax.random.split(jax.random.PRNGKey(seed))
+    # MLlib init: abs(normal)/sqrt(rank) keeps initial predictions positive
+    U = jnp.abs(jax.random.normal(key_u, (n_users, rank))) / jnp.sqrt(rank)
+    V = jnp.abs(jax.random.normal(key_v, (n_items, rank))) / jnp.sqrt(rank)
+    return U, V
+
+
 @partial(
     jax.jit,
     static_argnames=("n_users", "n_items", "rank", "max_iter", "implicit",
                      "chunk", "nonnegative", "nnls_sweeps", "factor_sharding"),
 )
-def _als_fit(user_idx, item_idx, rating, w, *, n_users: int, n_items: int,
-             rank: int, max_iter: int, reg: float, implicit: bool,
-             alpha: float, chunk: int, seed: int = 0,
+def _als_fit(user_idx, item_idx, rating, w, U, V, *, n_users: int,
+             n_items: int, rank: int, max_iter: int, reg: float,
+             implicit: bool, alpha: float, chunk: int,
              nonnegative: bool = False, nnls_sweeps: int = 48,
              factor_sharding=None):
     """factor_sharding: optional NamedSharding (hashable, static) pinning the
     factor tables over the mesh's 'model' axis — entities shard, so each
     half-step's batched Cholesky/NNLS solves run model-parallel and GSPMD
     reduce-scatters the segment-summed normal equations (MLlib's rating-block
-    shuffle, as one collective over ICI)."""
-    key_u, key_v = jax.random.split(jax.random.PRNGKey(seed))
-    # MLlib init: abs(normal)/sqrt(rank) keeps initial predictions positive
-    U = jnp.abs(jax.random.normal(key_u, (n_users, rank))) / jnp.sqrt(rank)
-    V = jnp.abs(jax.random.normal(key_v, (n_items, rank))) / jnp.sqrt(rank)
+    shuffle, as one collective over ICI). ``U``/``V`` arrive pre-initialized
+    (``_als_init`` — see its docstring for why init must stay eager)."""
 
     def pin(F):
         if factor_sharding is None:
@@ -299,11 +313,12 @@ class ALS(Estimator):
         factor_sharding = None
         if p.factor_sharding != "replicated" and has_model_axis:
             factor_sharding = session.sharding(session.model_axis, None)
+        U0, V0 = _als_init(p.seed, n_users, n_items, p.rank)
         U, V = _als_fit(
-            u, i, r, table.W,
+            u, i, r, table.W, U0, V0,
             n_users=n_users, n_items=n_items, rank=p.rank, max_iter=p.max_iter,
             reg=p.reg_param, implicit=p.implicit_prefs, alpha=p.alpha,
-            chunk=min(p.chunk_size, table.n_pad), seed=p.seed,
+            chunk=min(p.chunk_size, table.n_pad),
             nonnegative=p.nonnegative, nnls_sweeps=p.nnls_sweeps,
             factor_sharding=factor_sharding,
         )

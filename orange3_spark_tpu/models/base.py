@@ -15,6 +15,7 @@ can be checkpointed, donated, and passed through staged workflow graphs.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 from typing import Any, Sequence
 
@@ -22,6 +23,23 @@ import jax
 import numpy as np
 
 from orange3_spark_tpu.core.table import TpuTable
+
+
+def _serve_routed(kind: str, raw_fn):
+    """Route a subclass-defined ``transform``/``predict`` through the
+    serving path (serve/context.py) when a ServingContext is active.
+    With no active context this is one None-check of overhead; inside a
+    serving trace the per-thread reentrancy guard short-circuits straight
+    to the raw method."""
+
+    @functools.wraps(raw_fn)
+    def wrapper(self, *args, **kwargs):
+        from orange3_spark_tpu.serve.context import route
+
+        return route(kind, raw_fn, self, *args, **kwargs)
+
+    wrapper.__serve_raw__ = raw_fn
+    return wrapper
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,7 +102,21 @@ class Transformer(HasParams):
     Subclasses that declare ``ParamsCls`` get the standard params-dataclass
     constructor from HasParams; ones with custom state define their own
     __init__.
+
+    Every subclass-defined ``transform``/``predict`` is wrapped at class
+    creation to route through the serving subsystem (serve/) when a
+    ``ServingContext`` is active — shape-bucketed padding, AOT executable
+    cache, optional micro-batching. Without a context the raw method runs
+    untouched.
     """
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        for kind in ("transform", "predict"):
+            fn = cls.__dict__.get(kind)
+            if fn is not None and callable(fn) \
+                    and not hasattr(fn, "__serve_raw__"):
+                setattr(cls, kind, _serve_routed(kind, fn))
 
     def transform(self, table: TpuTable) -> TpuTable:
         raise NotImplementedError
@@ -121,9 +153,25 @@ class Model(Transformer):
     def state_pytree(self) -> dict[str, Any]:
         raise NotImplementedError
 
+    def _touch_serving_state(self) -> None:
+        """Move the serving fingerprint after an in-place state change:
+        the AOT cache bakes fitted state into compiled programs
+        (serve/context folds this version into the model fingerprint), so
+        every ``load_state_pytree`` — base or override — must call this."""
+        self._serve_state_version = (
+            getattr(self, "_serve_state_version", 0) + 1)
+
+    def _serve_state_token(self):
+        """The version token serve/context folds into the fingerprint.
+        Containers (PipelineModel, OneVsRestModel) include their
+        children's tokens: reloading a NESTED sub-model must move the
+        container's key too — its executables bake the child state in."""
+        return getattr(self, "_serve_state_version", 0)
+
     def load_state_pytree(self, state: dict[str, Any]) -> None:
         for k, v in state.items():
             setattr(self, k, v)
+        self._touch_serving_state()
 
 
 class Estimator:
@@ -214,6 +262,14 @@ class PipelineModel(Model):
             if not isinstance(stage, Model):
                 raise ValueError(f"checkpoint has state for non-model stage {idx}")
             stage.load_state_pytree(sub)
+        # the pipeline itself can be the served object (its executables
+        # bake STAGE state), so its fingerprint must move too
+        self._touch_serving_state()
+
+    def _serve_state_token(self):
+        return (getattr(self, "_serve_state_version", 0),
+                tuple(s._serve_state_token() for s in self.stages
+                      if isinstance(s, Model)))
 
 
 def infer_class_values(table: TpuTable) -> tuple[str, ...]:
@@ -234,6 +290,26 @@ def infer_class_values(table: TpuTable) -> tuple[str, ...]:
 
 
 def predictions_to_numpy(table: TpuTable, column: str = "prediction") -> np.ndarray:
-    """Collect one prediction column to host, stripping padding."""
-    col = table.column(column)
-    return np.asarray(col)[: table.n_rows]
+    """Collect one prediction column to host, stripping padding.
+
+    Padding is stripped from the VALIDITY MASK, not just ``n_rows``: a
+    serving-bucketed table whose caller did not track the logical row
+    count (``n_rows == n_pad``) still carries W == 0 on every pad row, so
+    the trailing zero-weight run is trimmed too. Interior zero-weight
+    rows (``filter()``ed) are logical rows and are kept.
+
+    Carve-out: on an exactly pad-aligned table a trailing zero-weight run
+    is INDISTINGUISHABLE from trailing ``filter()``ed logical rows, and
+    this function treats it as padding. Callers that filter trailing rows
+    and need them back must track the logical row count (``n_rows <
+    n_pad``) — that branch returns every logical row unconditionally."""
+    col = np.asarray(jax.device_get(table.column(column)))[: table.n_rows]
+    if table.n_rows < table.n_pad:
+        # caller tracked the row count; pads already sliced away above —
+        # every logical row is returned even if filter() zeroed them all
+        return col
+    W = np.asarray(jax.device_get(table.W))[: table.n_rows]
+    live = np.flatnonzero(W > 0)
+    if live.size == 0:
+        return col[:0]
+    return col[: int(live[-1]) + 1]

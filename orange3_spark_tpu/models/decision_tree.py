@@ -67,6 +67,7 @@ class DecisionTreeClassifierModel(Model):
 
     def load_state_pytree(self, state):
         self.tree = Tree(**{k: state[k] for k in Tree._fields})
+        self._touch_serving_state()
 
     def _probs(self, X):
         leaves = tree_apply(X, self.tree)                    # [N]
@@ -120,6 +121,7 @@ class DecisionTreeRegressorModel(Model):
 
     def load_state_pytree(self, state):
         self.tree = Tree(**{k: state[k] for k in Tree._fields})
+        self._touch_serving_state()
 
     def predict(self, table: TpuTable) -> np.ndarray:
         leaves = tree_apply(table.X, self.tree)

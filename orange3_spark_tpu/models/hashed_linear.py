@@ -499,7 +499,33 @@ class HashedLinearModel(Model):
     def _binary(self) -> bool:
         return _row_loss_kind(self.params) == "binary_logistic"
 
+    def _serve_array_state(self):
+        """Serving hook (serve/context.py served_array): the state pytree
+        the AOT executable takes as ARGUMENTS — the embedding table is the
+        big-state case where closing over constants would duplicate it
+        into every bucket's executable."""
+        return {"theta": self.theta, "salts": np.asarray(self.salts)}
+
+    def _serve_array_fn(self, state, Xp):
+        """Device fn for the bucketed logits executable: row-wise (hash +
+        gather + matmul), so bucket padding cannot perturb live rows."""
+        p = self.params
+        return _hashed_predict(
+            state["theta"], Xp, state["salts"], n_dims=p.n_dims,
+            n_dense=p.n_dense, value_weighted=p.value_weighted,
+            impute_missing=_impute_flag(p),
+        )
+
     def _logits(self, Xall: np.ndarray) -> np.ndarray:
+        from orange3_spark_tpu.serve.context import (
+            _reentrant, active_serving_context,
+        )
+
+        ctx = active_serving_context()
+        if ctx is not None and not _reentrant():
+            out = ctx.served_array(self, np.asarray(Xall, np.float32))
+            if out is not None:
+                return out
         p = self.params
         out = _hashed_predict(
             self.theta, jnp.asarray(Xall, jnp.float32),

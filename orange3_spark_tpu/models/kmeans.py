@@ -155,6 +155,13 @@ class KMeansModel(Model):
         assign, _ = _assign(table.X, self.centers, table.W)
         return np.asarray(assign)[: table.n_rows]
 
+    def _device_predict(self, table: TpuTable):
+        """Serving hook (serve/context.py): per-row cluster ids, device-pure
+        — assignment is row-wise (argmin over centers), so bucket padding
+        cannot perturb live rows."""
+        assign, _ = _assign(table.X, self.centers, table.W)
+        return assign
+
     def compute_cost(self, table: TpuTable) -> float:
         _, cost = _assign(table.X, self.centers, table.W)
         return float(cost)

@@ -52,8 +52,9 @@ class LogisticRegressionModel(Model):
         return {"coef": self.coef, "intercept": self.intercept}
 
     @staticmethod
-    @jax.jit
-    def _predict_kernel(X, coef, intercept, threshold):
+    def _prob_pred(X, coef, intercept, threshold):
+        """Shared (unjitted) decision body — the single copy of the
+        threshold semantics both jitted kernels trace through."""
         logits = X @ coef + intercept
         prob = jax.nn.softmax(logits, axis=-1)
         if coef.shape[1] == 2:
@@ -63,20 +64,48 @@ class LogisticRegressionModel(Model):
             pred = jnp.argmax(logits, axis=-1).astype(jnp.float32)
         return prob, pred
 
+    @staticmethod
+    @jax.jit
+    def _predict_kernel(X, coef, intercept, threshold):
+        return LogisticRegressionModel._prob_pred(X, coef, intercept,
+                                                  threshold)
+
     def _predict(self, X):
         return self._predict_kernel(
             X, self.coef, self.intercept, jnp.float32(self.params.threshold)
         )
 
+    def _device_predict(self, table: TpuTable):
+        """Serving hook (serve/context.py): device-pure per-row predictions
+        — what the AOT bucketed executable compiles for ``predict``."""
+        _, pred = self._predict(table.X)
+        return pred
+
+    @staticmethod
+    @jax.jit
+    def _transform_kernel(X, coef, intercept, threshold):
+        """The WHOLE transform as one program (kernel + column concat).
+        One dispatch instead of two — and, load-bearing for serving: the
+        AOT bucketed executable traces transform into a single fused
+        module, so the eager path must fuse identically or XLA's
+        fusion-dependent transcendental codegen drifts the probability
+        columns by an ulp across the two paths (observed on this jaxlib;
+        pinned bitwise in tests/test_serving.py)."""
+        prob, pred = LogisticRegressionModel._prob_pred(X, coef, intercept,
+                                                        threshold)
+        return jnp.concatenate([X, prob, pred[:, None]], axis=1)
+
     def transform(self, table: TpuTable) -> TpuTable:
         """Append probability_<c> and prediction columns (Spark's
         probability/prediction output columns on the transformed DataFrame)."""
-        prob, pred = self._predict(table.X)
+        X = self._transform_kernel(
+            table.X, self.coef, self.intercept,
+            jnp.float32(self.params.threshold),
+        )
         new_attrs = list(table.domain.attributes) + [
             ContinuousVariable(f"probability_{c}") for c in self.class_values
         ] + [DiscreteVariable("prediction", self.class_values)]
         new_domain = Domain(new_attrs, table.domain.class_vars, table.domain.metas)
-        X = jnp.concatenate([table.X, prob, pred[:, None]], axis=1)
         return table.with_X(X, new_domain)
 
     def predict(self, table: TpuTable) -> np.ndarray:

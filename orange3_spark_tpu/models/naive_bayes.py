@@ -105,6 +105,7 @@ class NaiveBayesModel(Model):
         state = dict(state)
         self.pi = state.pop("pi")
         self.factors = state
+        self._touch_serving_state()
 
     def _scores(self, X):
         return _log_joint(X, self.pi, self.factors,

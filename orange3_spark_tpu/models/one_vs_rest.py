@@ -72,6 +72,11 @@ class OneVsRestModel(Model):
     def load_state_pytree(self, state):
         for key, sub in state.items():
             self.models[int(key.removeprefix("class"))].load_state_pytree(sub)
+        self._touch_serving_state()
+
+    def _serve_state_token(self):
+        return (getattr(self, "_serve_state_version", 0),
+                tuple(m._serve_state_token() for m in self.models))
 
     def _scores(self, table: TpuTable) -> np.ndarray:
         return np.stack(

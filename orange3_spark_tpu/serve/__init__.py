@@ -1,0 +1,27 @@
+"""serve/ — the bucketed AOT inference path (docs/serving.md).
+
+Public surface::
+
+    from orange3_spark_tpu.serve import ServingContext, BucketLadder
+
+    ctx = ServingContext(BucketLadder(min_bucket=256, max_bucket=1 << 14),
+                         micro_batch=True)
+    with ctx:
+        ctx.warmup(model, template)      # pre-compile the ladder
+        model.predict(batch)             # bucketed + cached + coalesced
+
+Counters: ``orange3_spark_tpu.utils.profiling.serve_counters()``.
+"""
+
+from orange3_spark_tpu.serve.bucketing import BucketLadder
+from orange3_spark_tpu.serve.cache import ExecutableCache
+from orange3_spark_tpu.serve.context import (
+    ServingContext, active_serving_context,
+)
+
+__all__ = [
+    "BucketLadder",
+    "ExecutableCache",
+    "ServingContext",
+    "active_serving_context",
+]

@@ -1,0 +1,142 @@
+"""AOT executable cache — compiled-once programs for the serving path.
+
+``jax.jit`` caches compiled programs too, but per (function, shape) with
+no eviction, no explicit warmup, and no visibility: a serving process
+cannot ask "is this bucket compiled?", bound the memory a long-lived
+ladder of models holds, or report compile time separately from request
+latency. This cache makes the executable a first-class entry:
+
+* built via the AOT path — ``jit(fn).lower(abstract_args).compile()`` —
+  so a bucket can be compiled at WARMUP time from pure
+  ``ShapeDtypeStruct``s (no example batch needed, no first-request
+  compile spike);
+* keyed explicitly on (model fingerprint, kind, bucket shape, dtype,
+  sharding) by the caller (serve/context.py owns key construction);
+* LRU-bounded (``max_entries``) — retired models' executables fall out
+  instead of accumulating for the life of the process;
+* counted: hits/misses/evictions/compile-seconds tick the process-wide
+  ``utils.profiling`` serve aggregate, the source of the serving bench's
+  ``bucket_hits``/``recompiles`` fields.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import Future
+from typing import Any, Callable
+
+from orange3_spark_tpu.utils.profiling import record_serve
+
+
+class ExecutableCache:
+    """Thread-safe LRU of compiled executables (or any build product).
+
+    ``get_or_build(key, build)`` returns the cached entry or runs
+    ``build()`` — serialized PER KEY: two threads racing the same first
+    request pay one XLA compile (the second waits on the first's future),
+    while hits and builds for OTHER keys proceed concurrently. The lock
+    only guards the bookkeeping dicts, never a multi-second compile —
+    a cold model warming up cannot head-of-line-block an already-warmed
+    model's 2 ms hits.
+
+    ``on_evict(key)`` (optional) fires outside the lock for every entry
+    the LRU drops — the owning context uses it to release per-model /
+    per-graph pins whose executables are all gone.
+    """
+
+    def __init__(self, max_entries: int = 64,
+                 on_evict: Callable[[Any], None] | None = None):
+        if max_entries < 1:
+            raise ValueError(f"max_entries must be >= 1, got {max_entries}")
+        self.max_entries = max_entries
+        self.on_evict = on_evict
+        self._lock = threading.RLock()
+        self._entries: OrderedDict[Any, Any] = OrderedDict()
+        self._building: dict[Any, Future] = {}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def keys(self) -> list:
+        with self._lock:
+            return list(self._entries)
+
+    def get_or_build(self, key, build: Callable[[], Any]):
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                record_serve(aot_hits=1)
+                return self._entries[key]
+            fut = self._building.get(key)
+            if fut is None:
+                fut = self._building[key] = Future()
+                owner = True
+            else:
+                owner = False
+        if not owner:
+            # someone else is compiling this key: wait for IT alone; the
+            # shared compile counts once (their miss), we count a hit
+            entry = fut.result()
+            record_serve(aot_hits=1)
+            return entry
+        t0 = time.perf_counter()
+        try:
+            entry = build()
+        except BaseException as e:
+            with self._lock:
+                del self._building[key]
+            fut.set_exception(e)
+            raise
+        dt = time.perf_counter() - t0
+        evicted = []
+        with self._lock:
+            record_serve(aot_misses=1, aot_compile_s=dt)
+            self._entries[key] = entry
+            del self._building[key]
+            while len(self._entries) > self.max_entries:
+                evicted.append(self._entries.popitem(last=False)[0])
+            if evicted:
+                record_serve(aot_evictions=len(evicted))
+        fut.set_result(entry)
+        if self.on_evict is not None:
+            for k in evicted:
+                self.on_evict(k)
+        return entry
+
+    def mark(self, key) -> None:
+        """Insert a countless marker entry: pad-path buckets own no AOT
+        executable (the model's internal jits hold the real compiles), but
+        a marker gives them LRU presence so ``on_evict`` pruning covers
+        pad-served models too. No aot hit/miss ticks — no compile happened
+        here; evictions it forces still count (real entries may fall)."""
+        evicted = []
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return
+            self._entries[key] = "pad-marker"
+            while len(self._entries) > self.max_entries:
+                evicted.append(self._entries.popitem(last=False)[0])
+            if evicted:
+                record_serve(aot_evictions=len(evicted))
+        if self.on_evict is not None:
+            for k in evicted:
+                self.on_evict(k)
+
+    def clear(self) -> None:
+        with self._lock:
+            dropped = list(self._entries)
+            self._entries.clear()
+        if self.on_evict is not None:
+            # same contract as LRU eviction: every dropped key fires, so
+            # the owning context releases its per-model/per-graph pins
+            # instead of holding them for the context's lifetime
+            for k in dropped:
+                self.on_evict(k)

@@ -1,0 +1,660 @@
+"""ServingContext — the predict/transform hot path as a subsystem.
+
+The fit path got its performance layer in exec/ (prefetch overlap,
+donation, epoch batching); this module is the same treatment for
+INFERENCE — the ROADMAP's "serving heavy traffic from millions of users"
+half. Three composable pieces:
+
+1. **Shape bucketing** (serve/bucketing.py): incoming batches pad up to a
+   configurable ladder of canonical row counts, so mixed request sizes
+   share a handful of compiled programs instead of compiling one per
+   distinct size. Pad rows carry weight 0 — the framework's existing
+   validity-mask convention — and are stripped before any caller sees
+   them; live-row outputs are bit-identical to the exact-shape path
+   (tests/test_serving.py pins this per model).
+
+2. **AOT executable cache** (serve/cache.py): each (model fingerprint,
+   kind, bucket shape, dtype, sharding) maps to a compiled executable
+   built with ``jit(fn).lower(abstract_batch).compile()`` — LRU-bounded,
+   warmable ahead of traffic (``warmup``), with hit/miss/compile-time
+   counters in ``utils.profiling.serve_counters()``.
+
+3. **Dynamic micro-batching** (serve/microbatch.py): concurrent
+   ``predict()`` calls coalesce on a bounded background thread (the
+   exec/pipeline.py queue/worker idiom) into one bucketed dispatch, and
+   results scatter back per caller.
+
+Activation is a context manager::
+
+    with ServingContext(BucketLadder(min_bucket=256, max_bucket=1 << 14)):
+        model.predict(batch)        # routed: bucketed + cached + counted
+
+``models.base`` routes every Transformer subclass's ``transform``/
+``predict`` through ``route()`` below; with no active context the raw
+methods run untouched (zero overhead beyond one None check), and batches
+larger than the ladder's ``max_bucket`` bypass serving (the raw path
+amortizes its own compile there, and the serving path's host round trip
+would dominate). Models whose transform cannot trace device-pure are
+blacklisted on first failure and served raw from then on.
+
+The active context is PROCESS-wide (serving worker threads must see the
+context their pool installed, which a thread-local could not give them);
+nesting is a stack, innermost wins.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import threading
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.serve.bucketing import (
+    BucketLadder, domain_sig, pad_rows_np, table_to_host,
+)
+from orange3_spark_tpu.serve.cache import ExecutableCache
+from orange3_spark_tpu.utils.profiling import record_serve
+
+log = logging.getLogger("orange3_spark_tpu")
+
+# process-wide context stack + per-thread reentrancy depth (serving builds
+# trace the RAW methods; the guard keeps the router out of its own trace)
+_ACTIVE: list["ServingContext"] = []
+_ACTIVE_LOCK = threading.Lock()
+_TLS = threading.local()
+
+
+def active_serving_context() -> "ServingContext | None":
+    # lock-free on purpose: this runs on EVERY predict/transform framework
+    # wide, and a single-bytecode list index is already atomic under the
+    # GIL — only the __enter__/__exit__ writers take _ACTIVE_LOCK
+    try:
+        return _ACTIVE[-1]
+    except IndexError:
+        return None
+
+
+def _reentrant() -> bool:
+    return getattr(_TLS, "depth", 0) > 0
+
+
+class _raw_calls:
+    """Suppress serve routing on this thread (used around traced bodies)."""
+
+    def __enter__(self):
+        _TLS.depth = getattr(_TLS, "depth", 0) + 1
+
+    def __exit__(self, *exc):
+        _TLS.depth -= 1
+
+
+def route(kind: str, raw_fn: Callable, model, *args, **kwargs):
+    """The models.base dispatch point: serve when a context is active and
+    the call is a plain single-table ``transform``/``predict``; otherwise
+    run the raw method untouched."""
+    ctx = active_serving_context()
+    if (ctx is None or _reentrant() or kwargs or len(args) != 1
+            or not isinstance(args[0], TpuTable)):
+        return raw_fn(model, *args, **kwargs)
+    table = args[0]
+    if kind == "transform":
+        return ctx.served_transform(model, table, raw_fn)
+    return ctx.served_predict(model, table, raw_fn)
+
+
+def _mesh_key(session) -> tuple:
+    return (id(session.mesh), session.data_axis)
+
+
+def _fingerprint(model) -> tuple:
+    # the state token moves on in-place checkpoint hot-reloads
+    # (Model.load_state_pytree — including a NESTED sub-model's, via the
+    # container's _serve_state_token): the cached executables baked the
+    # OLD state in as jit constants / array-path snapshots, so a reloaded
+    # model must key fresh ones — not silently serve stale weights
+    token_fn = getattr(model, "_serve_state_token", None)
+    token = (token_fn() if token_fn is not None
+             else getattr(model, "_serve_state_version", 0))
+    return (type(model).__name__, id(model), token)
+
+
+class _ModelRecord:
+    """Per-model serving snapshot: the fingerprint that keys executables.
+
+    Identity-based on purpose — an in-process serving cache serves the
+    model OBJECTS the process fitted/loaded; replacing a model (or
+    refitting into a new instance) naturally keys fresh executables and
+    the LRU retires the old ones."""
+
+    __slots__ = ("model", "fingerprint")
+
+    def __init__(self, model):
+        self.model = model
+        self.fingerprint = _fingerprint(model)
+
+
+class ServingContext:
+    """See module docstring. Parameters:
+
+    ladder        BucketLadder (default pow2 256..65536)
+    max_entries   LRU bound on compiled executables
+    micro_batch   enable the background coalescer for predict()
+    max_batch     micro-batcher: flush when merged rows reach this
+    max_wait_ms   micro-batcher: flush when the oldest request has waited
+                  this long
+    """
+
+    def __init__(self, ladder: BucketLadder | None = None, *,
+                 max_entries: int = 64, micro_batch: bool = False,
+                 max_batch: int = 4096, max_wait_ms: float = 2.0):
+        self.ladder = ladder or BucketLadder()
+        self.cache = ExecutableCache(max_entries, on_evict=self._on_evict)
+        self._records: dict[int, _ModelRecord] = {}
+        self._rec_lock = threading.Lock()
+        self._unservable: set = set()       # (fingerprint, kind) build fails
+        self._staged_refs: dict = {}        # id -> staged program (keeps the
+        #                                     id-keyed cache entries honest)
+        self._micro_batch = micro_batch
+        # a merged batch larger than the ladder's top rung would dispatch
+        # at its own (per-merged-size) shape — a fresh AOT compile per
+        # distinct merge, reinstating the recompile pathology bucketing
+        # removes — so the coalescer never merges past max_bucket
+        if max_batch > self.ladder.max_bucket:
+            if micro_batch:   # without the coalescer max_batch is unused
+                log.warning(
+                    "serve: max_batch=%d exceeds the ladder's max_bucket=%d; "
+                    "clamping (larger merges would compile per merged size)",
+                    max_batch, self.ladder.max_bucket)
+            max_batch = self.ladder.max_bucket
+        self._max_batch = max_batch
+        self._max_wait_ms = max_wait_ms
+        self._activations = 0
+        self.micro_batcher = None
+
+    # ------------------------------------------------------ context stack
+    def __enter__(self) -> "ServingContext":
+        # the batcher (and its daemon worker) lives while ANY activation
+        # is open, not per construction: re-entry gets a fresh coalescer
+        # (a closed one silently drops every submit to direct dispatch), a
+        # context built but never entered starts no thread, and the last
+        # overlapping __exit__ — not the first — closes it
+        with _ACTIVE_LOCK:
+            if self._micro_batch and self.micro_batcher is None:
+                from orange3_spark_tpu.serve.microbatch import MicroBatcher
+
+                self.micro_batcher = MicroBatcher(
+                    self, max_batch=self._max_batch,
+                    max_wait_ms=self._max_wait_ms,
+                )
+            self._activations += 1
+            _ACTIVE.append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        with _ACTIVE_LOCK:
+            try:
+                _ACTIVE.remove(self)
+            except ValueError:
+                pass
+            self._activations = max(0, self._activations - 1)
+            mb = self.micro_batcher if self._activations == 0 else None
+            if mb is not None:
+                self.micro_batcher = None
+        if mb is not None:
+            mb.close()    # outside the lock: close() joins the worker
+
+    # ------------------------------------------------------------ records
+    def _record_for(self, model) -> _ModelRecord:
+        key = id(model)
+        with self._rec_lock:
+            rec = self._records.get(key)
+            if rec is None or rec.fingerprint != _fingerprint(model):
+                # fingerprint moved (state hot-reload): fresh record keys
+                # fresh executables; the old ones retire through the LRU
+                rec = self._records[key] = _ModelRecord(model)
+            return rec
+
+    def _tick_bucket(self, key, n: int, n_pad: int) -> None:
+        hit = key in self.cache
+        record_serve(request_rows=n, padded_rows=n_pad,
+                     **({"bucket_hits": 1} if hit else {"bucket_misses": 1}))
+
+    def _tick_dispatch(self, key, n_pad: int) -> None:
+        """Bucket hit/miss + padded rows for one DEVICE DISPATCH — under
+        the micro-batcher that is the merged batch, not each caller's
+        request (callers tick ``request_rows`` at submit; ticking their
+        per-request keys here would count every coalesced request as a
+        miss on a key the cache never stores)."""
+        hit = key in self.cache
+        record_serve(padded_rows=n_pad,
+                     **({"bucket_hits": 1} if hit else {"bucket_misses": 1}))
+
+    def _on_evict(self, key) -> None:
+        """LRU eviction releases the context-side pins: once the cache
+        holds no executable for a staged graph / model fingerprint, drop
+        the strong refs so retired graphs (with their template arrays)
+        and refitted-away models do not accumulate for the context's
+        lifetime. Called by the cache outside its lock."""
+        live = self.cache.keys()
+        if key[0] == "staged":
+            sid = key[1]
+            if not any(k[0] == "staged" and k[1] == sid for k in live):
+                self._staged_refs.pop(sid, None)
+            return
+        fp = key[1]
+        if any(len(k) > 1 and k[1] == fp for k in live):
+            return
+        with self._rec_lock:
+            for mid, r in list(self._records.items()):
+                if r.fingerprint == fp:
+                    del self._records[mid]
+            # the record's strong ref kept id(model) stable; without it the
+            # id can be reused, so fingerprint-keyed state must not outlive
+            # it. Rebuilt under _rec_lock — _blacklist's concurrent .add()
+            # would crash this comprehension's iteration otherwise
+            self._unservable = {u for u in self._unservable if u[0] != fp}
+
+    # ----------------------------------------------------- served entries
+    def served_transform(self, model, table: TpuTable, raw_fn=None):
+        raw_fn = raw_fn or type(model).transform
+        bucket = self.ladder.bucket_for(table.n_rows)
+        # bypass/blacklist checks BEFORE _record_for: a record pins the
+        # model, and a model that is never actually served would otherwise
+        # never gain the cache entry whose eviction releases the pin
+        if (bucket is None
+                or (_fingerprint(model), "transform") in self._unservable):
+            with _raw_calls():
+                return raw_fn(model, table)
+        rec = self._record_for(model)
+        session = table.session
+        n_pad = session.pad_rows(bucket)
+        key = self._table_key("transform", rec, table, n_pad)
+        self._tick_bucket(key, table.n_rows, n_pad)
+        try:
+            compiled, meta = self._ensure_table_exec(
+                key, rec, "transform", session, table.domain,
+                n_attrs=table.n_attrs, x_dtype=table.X.dtype,
+                y_cols=(table.Y.shape[1] if table.Y is not None else 0),
+                y_dtype=(table.Y.dtype if table.Y is not None else None),
+                n_pad=n_pad,
+            )
+        except Exception as e:  # noqa: BLE001 - untraceable transform
+            self._blacklist(rec, "transform", e, key=key)
+            with _raw_calls():
+                return raw_fn(model, table)
+        Xd, Yd, Wd = self._serve_args(table, n_pad, session)
+        outX, outY, outW = compiled(Xd, Yd, Wd)
+        return TpuTable(meta["domain"], outX, outY, outW, table.metas,
+                        table.n_rows, session)
+
+    def served_predict(self, model, table: TpuTable, raw_fn=None):
+        raw_fn = raw_fn or type(model).predict
+        bucket = self.ladder.bucket_for(table.n_rows)
+        if bucket is None:
+            with _raw_calls():
+                return raw_fn(model, table)
+        rec = self._record_for(model)
+        session = table.session
+        n_pad = session.pad_rows(bucket)
+        hook = getattr(type(model), "_device_predict", None)
+        if hook is None or (rec.fingerprint, "predict") in self._unservable:
+            # no device hook: bucket-pad the table and run the raw predict
+            # on it — the model's internal jits then cache per BUCKET
+            # shape (the compile-count win) and strip via n_rows as ever
+            key = self._table_key("predict-pad", rec, table, n_pad)
+            self._tick_bucket(key, table.n_rows, n_pad)
+            self.cache.mark(key)   # LRU presence: pad-served models prune
+            #                        via _on_evict like every other kind
+            padded = self._bucket_pad_table(table, n_pad, session)
+            with _raw_calls():
+                return raw_fn(model, padded)
+        n = table.n_rows
+        if self.micro_batcher is None:
+            # direct path: run the table executable on the table's own
+            # arrays — _serve_args skips the d2h/h2d round trip when the
+            # table already sits bucket-shaped on the session mesh (the
+            # steady state the transform path already fast-paths)
+            key = self._table_key("predict", rec, table, n_pad)
+            self._tick_bucket(key, n, n_pad)
+            try:
+                compiled, _ = self._ensure_table_exec(
+                    key, rec, "predict", session, table.domain,
+                    n_attrs=table.n_attrs, x_dtype=table.X.dtype,
+                    y_cols=(table.Y.shape[1] if table.Y is not None else 0),
+                    y_dtype=(table.Y.dtype if table.Y is not None else None),
+                    n_pad=n_pad,
+                )
+            except Exception as e:  # noqa: BLE001
+                self._blacklist(rec, "predict", e, key=key)
+                with _raw_calls():
+                    return raw_fn(model, table)
+            Xd, Yd, Wd = self._serve_args(table, n_pad, session)
+            out = compiled(Xd, Yd, Wd)
+            return np.asarray(jax.device_get(out))[:n]
+        record_serve(request_rows=n)    # dispatch-level ticks live in
+        #                                 _dispatch (merged under the mb)
+        X, Y, W = table_to_host(table)
+        arrays = (X[:n], Y[:n] if Y is not None else None, W[:n])
+        fut = self.micro_batcher.submit(
+            "predict", rec, arrays, n,
+            meta=(session, table.domain, table.X.dtype))
+        if fut is not None:
+            try:
+                return fut.result()
+            except _BuildFailed:
+                # same contract as direct dispatch: an unservable
+                # model falls back to its raw path, never raises
+                with _raw_calls():
+                    return raw_fn(model, table)
+        try:
+            return self._dispatch("predict", rec, arrays, n,
+                                  meta=(session, table.domain, table.X.dtype))
+        except _BuildFailed:
+            with _raw_calls():
+                return raw_fn(model, table)
+
+    def served_array(self, model, Xall: np.ndarray):
+        """Array-program serving (models whose predict consumes raw host
+        chunks, e.g. hashed_linear): the model supplies the device fn via
+        ``_serve_array_fn``; state travels as ARGUMENTS (no constant
+        embedding — hashed tables are the big-state case). Returns the
+        fn's output rows for ``Xall`` or None when serving does not apply
+        (caller falls through to its raw path)."""
+        Xall = np.asarray(Xall)
+        n = Xall.shape[0]
+        bucket = self.ladder.bucket_for(n)
+        if bucket is None or (_fingerprint(model), "array") in self._unservable:
+            return None
+        rec = self._record_for(model)
+        from orange3_spark_tpu.core.session import TpuSession
+
+        session = TpuSession.active()
+        record_serve(request_rows=n)
+        arrays = (Xall, None, None)
+        if self.micro_batcher is not None:
+            fut = self.micro_batcher.submit(
+                "array", rec, arrays, n, meta=(session, None, Xall.dtype))
+            if fut is not None:
+                try:
+                    return fut.result()
+                except _BuildFailed:
+                    return None      # caller falls through to its raw path
+        try:
+            return self._dispatch("array", rec, arrays, n,
+                                  meta=(session, None, Xall.dtype))
+        except _BuildFailed:
+            return None
+
+    # ------------------------------------------------------------ dispatch
+    def _dispatch(self, kind: str, rec: _ModelRecord, arrays, n: int, *,
+                  meta) -> np.ndarray:
+        """Pad ``arrays`` (host, row-stripped) to the bucket, run the AOT
+        executable, return per-row outputs stripped back to ``n`` rows.
+        The micro-batcher calls this with MERGED request rows."""
+        session, domain, x_dtype = meta
+        bucket = self.ladder.bucket_for(n)
+        if bucket is None:       # merged batch outgrew the ladder: clamp
+            bucket = self.ladder.max_bucket
+        n_pad = session.pad_rows(max(bucket, session.pad_rows(n)))
+        X, Y, W = arrays
+        if kind == "array":
+            key = ("array", rec.fingerprint, n_pad, X.shape[1],
+                   str(X.dtype), _mesh_key(session))
+            self._tick_dispatch(key, n_pad)
+            try:
+                compiled, state = self.cache.get_or_build(
+                    key, lambda: self._build_array_exec(
+                        rec, session, X.shape[1], X.dtype, n_pad))
+            except Exception as e:  # noqa: BLE001
+                self._blacklist(rec, "array", e, key=key)
+                raise _BuildFailed from e
+            Xd = jax.device_put(pad_rows_np(X, n_pad), session.row_sharding)
+            out = compiled(state, Xd)
+        else:
+            model = rec.model
+            key = ("predict", rec.fingerprint, n_pad, X.shape[1],
+                   str(X.dtype), (Y.shape[1] if Y is not None else 0),
+                   domain_sig(domain), _mesh_key(session))
+            self._tick_dispatch(key, n_pad)
+            try:
+                compiled, _ = self._ensure_table_exec(
+                    key, rec, "predict", session, domain,
+                    n_attrs=X.shape[1], x_dtype=x_dtype,
+                    y_cols=(Y.shape[1] if Y is not None else 0),
+                    y_dtype=(Y.dtype if Y is not None else None),
+                    n_pad=n_pad,
+                )
+            except Exception as e:  # noqa: BLE001
+                self._blacklist(rec, "predict", e, key=key)
+                raise _BuildFailed from e
+            Xd = jax.device_put(pad_rows_np(X, n_pad), session.row_sharding)
+            Yd = (jax.device_put(pad_rows_np(Y, n_pad), session.row_sharding)
+                  if Y is not None else None)
+            Wd = jax.device_put(pad_rows_np(W, n_pad),
+                                session.vector_sharding)
+            out = compiled(Xd, Yd, Wd)
+        return np.asarray(jax.device_get(out))[:n]
+
+    # ------------------------------------------------------------ builders
+    def _table_key(self, kind, rec, table: TpuTable, n_pad: int) -> tuple:
+        return (kind, rec.fingerprint, n_pad, table.n_attrs,
+                str(table.X.dtype),
+                (table.Y.shape[1] if table.Y is not None else 0),
+                domain_sig(table.domain), _mesh_key(table.session))
+
+    def _ensure_table_exec(self, key, rec, kind, session, domain, *,
+                           n_attrs, x_dtype, y_cols, y_dtype, n_pad):
+        """Compiled executable ``(X, Y, W) -> outputs`` for one bucket.
+        The model's fitted state is closed over (jit constants — these
+        models' states are small; big-state models take the array path
+        where state travels as arguments)."""
+        model = rec.model
+
+        def build():
+            meta: dict[str, Any] = {}
+
+            def fn(X, Y, W):
+                t = TpuTable(domain, X, Y, W, None, n_pad, session)
+                with _raw_calls():
+                    if kind == "transform":
+                        # copy: transforms may set host attrs on self
+                        out = copy.copy(model).transform(t)
+                        meta["domain"] = out.domain
+                        return out.X, out.Y, out.W
+                    return model._device_predict(t)
+
+            row, vec = session.row_sharding, session.vector_sharding
+            Xa = jax.ShapeDtypeStruct((n_pad, n_attrs), x_dtype, sharding=row)
+            Ya = (jax.ShapeDtypeStruct((n_pad, y_cols), y_dtype, sharding=row)
+                  if y_cols else None)
+            Wa = jax.ShapeDtypeStruct((n_pad,), np.float32, sharding=vec)
+            compiled = jax.jit(fn).lower(Xa, Ya, Wa).compile()
+            return compiled, meta
+
+        return self.cache.get_or_build(key, build)
+
+    def _build_array_exec(self, rec, session, n_cols, dtype, n_pad):
+        """Compiled ``(state, X[n_pad, n_cols]) -> rows`` for an
+        array-serving model (``_serve_array_state`` / ``_serve_array_fn``
+        hooks)."""
+        model = rec.model
+        # host leaves replicate onto the SESSION mesh — a bare device_put
+        # would land them on the default device, and AOT compile rejects
+        # arguments spanning different device sets
+        state = jax.tree.map(
+            lambda a: a if isinstance(a, jax.Array)
+            else jax.device_put(np.asarray(a), session.replicated),
+            model._serve_array_state(),
+        )
+
+        def fn(state, Xp):
+            with _raw_calls():
+                return model._serve_array_fn(state, Xp)
+
+        st_avals = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                           sharding=a.sharding), state)
+        Xa = jax.ShapeDtypeStruct((n_pad, n_cols), dtype,
+                                  sharding=session.row_sharding)
+        compiled = jax.jit(fn).lower(st_avals, Xa).compile()
+        return compiled, state
+
+    def _blacklist(self, rec, kind, e, key=None) -> None:
+        with self._rec_lock:
+            known = (rec.fingerprint, kind) in self._unservable
+            if not known:
+                self._unservable.add((rec.fingerprint, kind))
+        if not known:
+            log.warning("serve: %s %s not AOT-servable, using raw path (%s)",
+                        rec.fingerprint[0], kind,
+                        f"{type(e).__name__}: {e}"[:200])
+        if key is not None:
+            # the failed build left no cache entry; a marker gives the
+            # fingerprint LRU presence so _on_evict eventually releases
+            # the record pin and the blacklist entry
+            self.cache.mark(key)
+
+    # ----------------------------------------------------------- utilities
+    def _serve_args(self, table: TpuTable, n_pad: int, session):
+        """(X, Y, W) ready for the bucket executable. A table that is
+        already exactly bucket-shaped on the session mesh (the steady
+        state for in-session tables whose n_pad lands on a rung) goes in
+        AS IS — its own pad rows already ride W=0, and row-wise programs
+        don't read them — skipping the d2h/h2d round trip on the
+        latency-critical path."""
+        row, vec = session.row_sharding, session.vector_sharding
+        if (table.n_pad == n_pad
+                and getattr(table.X, "sharding", None) == row
+                and (table.Y is None
+                     or getattr(table.Y, "sharding", None) == row)
+                and getattr(table.W, "sharding", None) == vec):
+            return table.X, table.Y, table.W
+        return self._pad_to_device(table, n_pad, session)
+
+    def _pad_to_device(self, table: TpuTable, n_pad: int, session):
+        n = table.n_rows
+        X, Y, W = table_to_host(table)
+        Xd = jax.device_put(pad_rows_np(X[:n], n_pad), session.row_sharding)
+        Yd = (jax.device_put(pad_rows_np(Y[:n], n_pad), session.row_sharding)
+              if Y is not None else None)
+        Wd = jax.device_put(pad_rows_np(W[:n], n_pad), session.vector_sharding)
+        return Xd, Yd, Wd
+
+    def _bucket_pad_table(self, table: TpuTable, n_pad: int,
+                          session) -> TpuTable:
+        if table.n_pad == n_pad:
+            return table
+        Xd, Yd, Wd = self._pad_to_device(table, n_pad, session)
+        metas = table.metas
+        return TpuTable(table.domain, Xd, Yd, Wd, metas, table.n_rows,
+                        session)
+
+    # ------------------------------------------------------------- warmup
+    def warmup(self, model, template: TpuTable | None = None, *,
+               buckets=None, kinds=None, n_cols: int | None = None,
+               session=None) -> dict:
+        """Pre-compile the model's serving executables for ``buckets``
+        (default: the ladder's full rungs) so no request pays an XLA
+        compile. ``template`` supplies the schema for table-serving
+        models (a 1-row table with the right domain is enough);
+        ``n_cols`` does the same for array-serving models. Returns
+        {"compiled": n, "buckets": [...]} for the ops log."""
+        from orange3_spark_tpu.core.session import TpuSession
+
+        buckets = list(buckets if buckets is not None
+                       else self.ladder.buckets())
+        rec = self._record_for(model)
+        if kinds is None:
+            kinds = []
+            if template is not None:
+                kinds.append("transform")
+                if getattr(type(model), "_device_predict", None) is not None:
+                    kinds.append("predict")
+            if n_cols is not None or hasattr(model, "_serve_array_fn"):
+                kinds.append("array")
+        compiled = 0
+        for b in buckets:
+            for kind in kinds:
+                if kind == "array":
+                    sess = session or TpuSession.active()
+                    nc = n_cols
+                    if nc is None:
+                        raise ValueError(
+                            "array warmup needs n_cols= (the model's "
+                            "serving chunk width)")
+                    n_pad = sess.pad_rows(b)
+                    key = ("array", rec.fingerprint, n_pad, nc,
+                           str(np.dtype(np.float32)), _mesh_key(sess))
+                    hit = key in self.cache   # rungs can collide via
+                    #                           pad_rows; count real work
+                    self.cache.get_or_build(
+                        key, lambda: self._build_array_exec(
+                            rec, sess, nc, np.dtype(np.float32), n_pad))
+                    compiled += 0 if hit else 1
+                    continue
+                if template is None:
+                    raise ValueError(f"{kind} warmup needs template=")
+                sess = template.session
+                n_pad = sess.pad_rows(b)
+                key = self._table_key(
+                    "predict" if kind == "predict" else kind,
+                    rec, template, n_pad)
+                hit = key in self.cache
+                self._ensure_table_exec(
+                    key, rec, kind, sess, template.domain,
+                    n_attrs=template.n_attrs, x_dtype=template.X.dtype,
+                    y_cols=(template.Y.shape[1]
+                            if template.Y is not None else 0),
+                    y_dtype=(template.Y.dtype
+                             if template.Y is not None else None),
+                    n_pad=n_pad,
+                )
+                compiled += 0 if hit else 1
+        return {"compiled": compiled, "buckets": buckets}
+
+    # ------------------------------------------------- staged-graph reuse
+    def staged_executable(self, staged, example_args):
+        """Workflow programs share this context's executable cache: key a
+        staged graph's compiled form on (program identity, arg shapes) and
+        AOT-compile through the same LRU/counters (workflow/staging.py
+        routes here when a context is active)."""
+        from orange3_spark_tpu.exec.donate import donation_enabled
+
+        # sharding rides in the key (like the model keys' _mesh_key): the
+        # AOT executable bakes in its input shardings, and a same-shape
+        # call from a rebuilt session/mesh must compile fresh, not be
+        # rejected by the cached executable's device-set check
+        shapes = tuple(
+            (tuple(leaf.shape), str(leaf.dtype),
+             getattr(leaf, "sharding", None))
+            for leaf in jax.tree.leaves(example_args)
+        )
+        # pin the program object: the key is identity-based, and a strong
+        # ref guarantees a GC'd graph can never hand its id (and therefore
+        # its cached executable) to a different staged program
+        self._staged_refs[id(staged)] = staged
+        # donation_enabled() in the key: staged programs promise the
+        # OTPU_DONATE kill-switch is read PER CALL (staging.py _jitted),
+        # and the AOT build bakes in whichever twin was active — flipping
+        # the switch must key a fresh executable, not redispatch the
+        # donating one against buffers the caller still holds
+        key = ("staged", id(staged), shapes, donation_enabled())
+
+        def build():
+            # lowering traces the fused program, and each stage's
+            # serve-wrapped transform would re-enter route() with this
+            # context active — handing served_transform a TRACER-backed
+            # table (table_to_host on a tracer raises). The trace must see
+            # the raw methods, exactly like _ensure_table_exec's build.
+            with _raw_calls():
+                return staged._jitted.lower(*example_args).compile()
+
+        return self.cache.get_or_build(key, build)
+
+
+class _BuildFailed(Exception):
+    """Internal: the AOT build for a request failed; caller falls back."""

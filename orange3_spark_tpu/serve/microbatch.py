@@ -1,0 +1,164 @@
+"""Dynamic micro-batching — coalesce concurrent predicts into one dispatch.
+
+Serving traffic arrives as many small concurrent ``predict()`` calls; each
+would dispatch its own (bucket-padded) XLA program and serialize on the
+device. This worker merges them: requests enqueue on a bounded queue (the
+``exec/pipeline.py`` daemon-thread/queue idiom, coalescing instead of
+prefetching), the worker drains up to ``max_batch`` merged rows or
+``max_wait_ms`` of the oldest request's wait, concatenates the host-side
+row blocks, runs ONE bucketed executable through the owning
+``ServingContext``, and scatters the per-row outputs back to each
+caller's future.
+
+Only same-model, same-kind requests merge (different fingerprints flush
+the in-flight group and start a new one — request streams are usually
+model-homogeneous per endpoint, so the lost merge is marginal). Transform
+serving stays direct-dispatch: its output is a table, and splitting a
+merged table back per caller would cost more than the merge saves.
+
+Failure semantics: an exception in the merged dispatch lands on every
+participating future (callers see the real error, not a hang). ``submit``
+and ``close`` are mutually exclusive, so the shutdown sentinel is always
+the LAST item the worker sees — everything ahead of it flushes normally
+and no future is ever abandoned behind it.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from orange3_spark_tpu.serve.bucketing import domain_sig
+from orange3_spark_tpu.utils.dispatch import beat
+from orange3_spark_tpu.utils.profiling import record_serve
+
+_SENTINEL = object()
+
+
+@dataclass
+class _Request:
+    kind: str                    # 'predict' | 'array'
+    rec: object                  # serve.context._ModelRecord
+    arrays: tuple                # row-stripped host arrays (X, Y|None, W|None)
+    n: int                       # logical rows in this request
+    meta: tuple                  # (session, domain, x_dtype) for dispatch
+    future: Future = field(default_factory=Future)
+
+    @property
+    def group_key(self):
+        # EVERY array's schema, not just X: a labeled (Y present) and an
+        # unlabeled predict on the same model must not merge — their row
+        # blocks cannot concatenate. Domain and session follow _dispatch's
+        # executable key for the same reason.
+        session, domain, _ = self.meta
+        return (self.kind, self.rec.fingerprint,
+                tuple((a.shape[1:], str(a.dtype)) if a is not None else None
+                      for a in self.arrays),
+                id(session), domain_sig(domain))
+
+
+class MicroBatcher:
+    """Bounded background coalescer; see module docstring."""
+
+    def __init__(self, ctx, *, max_batch: int = 4096,
+                 max_wait_ms: float = 2.0, queue_depth: int = 1024):
+        self.ctx = ctx
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_ms / 1e3
+        self._q: queue.Queue = queue.Queue(maxsize=queue_depth)
+        self._closed = False
+        self._close_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._worker, daemon=True, name="serve-microbatch"
+        )
+        self._thread.start()
+
+    def submit(self, kind: str, rec, arrays, n: int, *,
+               meta) -> Future | None:
+        """Enqueue one request; returns its Future, or None when this
+        request cannot micro-batch (oversized, full queue, or the batcher
+        is closed / called from its own worker — the caller then
+        direct-dispatches)."""
+        if (self._closed or n > self.max_batch
+                or threading.current_thread() is self._thread):
+            return None
+        req = _Request(kind, rec, tuple(
+            np.asarray(a) if a is not None else None for a in arrays
+        ), n, meta)
+        # atomic with close(): no request can land BEHIND the shutdown
+        # sentinel, where the worker would exit without resolving its
+        # future and the caller would block in fut.result() forever
+        with self._close_lock:
+            if self._closed:
+                return None
+            try:
+                self._q.put_nowait(req)
+            except queue.Full:
+                return None          # overloaded: shed to direct dispatch
+        return req.future
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        with self._close_lock:
+            if not self._closed:
+                self._closed = True
+                self._q.put(_SENTINEL)   # worker drains ahead of us
+        self._thread.join(timeout=timeout_s)
+
+    # ------------------------------------------------------------- worker
+    def _worker(self) -> None:
+        pending = None
+        while True:
+            item = pending if pending is not None else self._q.get()
+            pending = None
+            if item is _SENTINEL:
+                return
+            batch = [item]
+            rows = item.n
+            deadline = time.perf_counter() + self.max_wait_s
+            while rows < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is _SENTINEL:
+                    pending = nxt
+                    break
+                if (nxt.group_key != item.group_key
+                        or rows + nxt.n > self.max_batch):
+                    pending = nxt     # flush current group, start the next
+                    break
+                batch.append(nxt)
+                rows += nxt.n
+            self._flush(batch, rows)
+            beat()                    # serving progress feeds the watchdog
+
+    def _flush(self, batch: list, rows: int) -> None:
+        record_serve(mb_requests=len(batch), mb_batches=1)
+        try:
+            first = batch[0]
+            if len(batch) == 1:
+                merged = first.arrays
+            else:
+                merged = tuple(
+                    np.concatenate([r.arrays[i] for r in batch])
+                    if first.arrays[i] is not None else None
+                    for i in range(len(first.arrays))
+                )
+            out = self.ctx._dispatch(first.kind, first.rec, merged, rows,
+                                     meta=first.meta)
+            off = 0
+            for r in batch:
+                r.future.set_result(out[off:off + r.n])
+                off += r.n
+        except BaseException as e:  # noqa: BLE001 - delivered to callers
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)

@@ -74,6 +74,96 @@ def reset_exec_counters() -> None:
             _exec_counts[k] = type(_exec_counts[k])()
 
 
+# ------------------------------------------------------- serve/ counters
+# Process-wide aggregates for the serving subsystem (serve/): the AOT
+# executable cache ticks hits/misses/evictions and accumulates compile
+# seconds; the bucketing layer ticks bucket_hits (dispatch landed on an
+# already-compiled bucket) vs bucket_misses (first touch of a bucket) —
+# per DEVICE DISPATCH, so coalesced requests sharing one merged dispatch
+# tick once — and the padding overhead (padded vs requested rows); the
+# micro-batcher reports its merge factor (requests per dispatched batch).
+_serve_counts = {
+    "aot_hits": 0,           # executable served from the in-process cache
+    "aot_misses": 0,         # lower+compile paid (first touch / evicted)
+    "aot_evictions": 0,      # LRU evictions from the executable cache
+    "aot_compile_s": 0.0,    # seconds inside lower().compile()
+    "bucket_hits": 0,        # dispatch mapped to an already-seen bucket
+    "bucket_misses": 0,      # dispatch was a bucket's first touch
+    "request_rows": 0,       # logical rows requested through serve/
+    "padded_rows": 0,        # total rows dispatched (incl. bucket padding)
+    "mb_requests": 0,        # predict() calls through the micro-batcher
+    "mb_batches": 0,         # coalesced device dispatches it issued
+}
+
+
+def record_serve(**deltas) -> None:
+    """Fold counter deltas into the process-wide serve aggregate."""
+    with _exec_lock:
+        for k, v in deltas.items():
+            _serve_counts[k] += v
+
+
+def serve_counters() -> dict:
+    """Snapshot of the serve counters plus derived ratios: ``pad_overhead``
+    (dispatched/requested rows — 1.0 means zero padding waste) and
+    ``mb_merge_factor`` (requests per micro-batch dispatch)."""
+    with _exec_lock:
+        out = dict(_serve_counts)
+    out["pad_overhead"] = (
+        out["padded_rows"] / out["request_rows"]
+        if out["request_rows"] else None
+    )
+    out["mb_merge_factor"] = (
+        out["mb_requests"] / out["mb_batches"] if out["mb_batches"] else None
+    )
+    return out
+
+
+def reset_serve_counters() -> None:
+    with _exec_lock:
+        for k in _serve_counts:
+            _serve_counts[k] = type(_serve_counts[k])()
+
+
+# -------------------------------------------- XLA compilation counter
+# One process-wide backend-compile counter fed by jax.monitoring (the
+# serving bench's ``recompiles`` field and the tests' recompile-regression
+# guard). Registered lazily and exactly once — jax has no unregister, so
+# the listener must be a permanent, cheap tick.
+_compile_count = 0
+_compile_listener_installed = False
+
+
+def _on_compile_event(key: str, _dur: float, **_kw) -> None:
+    global _compile_count
+    if "backend_compile" in key:
+        with _exec_lock:
+            _compile_count += 1
+
+
+def install_compile_counter() -> bool:
+    """Register the backend-compile listener (idempotent). Returns whether
+    the counter is live (False on jax builds without jax.monitoring)."""
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return True
+    try:
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_compile_event
+        )
+    except Exception:  # noqa: BLE001 - counter is best-effort diagnostics
+        return False
+    _compile_listener_installed = True
+    return True
+
+
+def xla_compile_count() -> int:
+    """Backend compiles observed since ``install_compile_counter`` (0 until
+    installed — call install first, before the jits you want counted)."""
+    with _exec_lock:
+        return _compile_count
+
+
 @contextlib.contextmanager
 def profile_trace(log_dir: str):
     """Device+host profile into log_dir (view with TensorBoard's profile tab)."""

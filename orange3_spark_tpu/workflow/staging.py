@@ -58,7 +58,19 @@ class StagedTransform:
     def __call__(self, table: TpuTable) -> TpuTable:
         if table.domain != self.in_domain:
             raise ValueError("table domain does not match the staged input domain")
-        X, Y, W = self._jitted(table.X, table.Y, table.W)
+        from orange3_spark_tpu.serve.context import active_serving_context
+
+        ctx = active_serving_context()
+        if ctx is not None:
+            # serving path: the staged program's compiled form lives in the
+            # context's shared executable cache (same LRU, same counters as
+            # the model executables) — an AOT .lower().compile() keyed on
+            # (program identity, input shapes), never jit's hidden cache
+            compiled = ctx.staged_executable(
+                self, (table.X, table.Y, table.W))
+            X, Y, W = compiled(table.X, table.Y, table.W)
+        else:
+            X, Y, W = self._jitted(table.X, table.Y, table.W)
         return TpuTable(
             self.out_domain, X, Y, W, table.metas, table.n_rows, self.session
         )
@@ -223,7 +235,17 @@ class StagedGraph:
                     "template tables cannot be donated — they are reused "
                     "by later calls"
                 )
-        X, Y, W = jitted(*self._flat_args(replacements))
+        args = self._flat_args(replacements)
+        from orange3_spark_tpu.serve.context import active_serving_context
+
+        ctx = active_serving_context()
+        if ctx is not None:
+            # serving path: staged-graph executables share the context's
+            # AOT cache/counters (see StagedTransform.__call__)
+            compiled = ctx.staged_executable(self, args)
+            X, Y, W = compiled(*args)
+        else:
+            X, Y, W = jitted(*args)
         if replacements:
             # every staged widget is row-preserving, so the output's LOGICAL
             # row count follows the (row-aligned) inputs of THIS call — the

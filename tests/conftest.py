@@ -145,3 +145,23 @@ def make_killing_checkpointer():
         return Killer(path, every_steps=every_steps)
 
     return _make
+
+
+@pytest.fixture()
+def xla_compiles():
+    """Recompile-regression guard: counts XLA backend compilations via the
+    process-wide ``jax.monitoring`` listener (utils/profiling.py). Yields
+    a zero-arg callable returning the number of backend compiles since the
+    fixture was set up — the serving tests assert the bucketed predict
+    path compiles AT MOST ONCE PER BUCKET, so a silent per-request or
+    per-size recompile regression fails here instead of surfacing as a
+    mystery latency cliff in the round-end bench. Skips (never
+    false-passes) on jax builds without jax.monitoring."""
+    from orange3_spark_tpu.utils.profiling import (
+        install_compile_counter, xla_compile_count,
+    )
+
+    if not install_compile_counter():
+        pytest.skip("jax.monitoring unavailable: cannot count compiles")
+    base = xla_compile_count()
+    yield lambda: xla_compile_count() - base

@@ -36,6 +36,9 @@ def _run(argv, timeout=420):
     # pin: the 30k-row config must run at full size (no cpu row reduction),
     # whatever the ambient harness environment sets
     env["OTPU_CPU_FALLBACK_ROWS"] = "30000"
+    # serving config: 40 requests keep the unbucketed phase (one XLA
+    # compile per distinct size — the pathology under test) under ~15 s
+    env["OTPU_SERVE_REQUESTS"] = "40"
     return subprocess.run([sys.executable] + argv, capture_output=True,
                           text=True, timeout=timeout, cwd=REPO, env=env)
 
@@ -51,6 +54,16 @@ def _run(argv, timeout=420):
     (["bench_suite.py", "--config", "5", "--rows-scale", "0.002"],
      "taxi_kmeans_pca_pipeline",
      {"staged_speedup", "workflow_fit_s"}),
+    # serving contract: the bucketed-AOT predict path's JSON line must
+    # carry the latency percentiles and the compile-count pair the
+    # acceptance criterion is judged on (ISSUE 2), schema-checked here so
+    # a field rename fails in CI instead of in the round-end capture
+    (["bench.py", "--config", "serving", "--rows", "30000"],
+     "criteo_serving_predict_rows_per_sec_per_chip",
+     {"p50_ms", "p99_ms", "recompiles", "bucket_hits",
+      "recompiles_unbucketed", "compile_reduction", "p50_ms_unbucketed",
+      "p99_ms_unbucketed", "pad_overhead", "mb_merge_factor",
+      "warmup_buckets"}),
 ])
 def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
     r = _run(argv)

@@ -68,9 +68,19 @@ def test_logreg_elasticnet_matches_sklearn_saga(session, iris):
     ours = objective(np.asarray(model.coef), np.asarray(model.intercept))
     theirs = objective(sk.coef_.T, sk.intercept_)
     assert ours <= theirs + 1e-6, f"OWLQN {ours} worse than saga {theirs}"
-    # L1 support recovery is well-determined even where magnitudes are not
-    np.testing.assert_array_equal(
-        np.abs(np.asarray(model.coef)) < 1e-6, np.abs(sk.coef_.T) < 1e-6
+    # EXACT-zero-pattern equality across solvers is NOT well-determined
+    # here (root-caused this round): the multinomial softmax is invariant
+    # to per-feature row shifts W[j,:] += c, and the L1 term breaks that
+    # tie toward median-centered rows — OWLQN lands on the tie-break
+    # (exact zeros; 2 of them on this jaxlib, at a BETTER objective than
+    # saga, asserted above) while saga stops on max_iter short of it with
+    # small nonzeros (|w| ~ 0.05-0.10 observed). What IS determined: any
+    # coefficient we drive to exactly zero must be a flat direction for
+    # saga too — small magnitude at the objective's flatness scale.
+    ours_zero = np.abs(np.asarray(model.coef)) < 1e-6
+    flat = np.abs(sk.coef_.T)[ours_zero]
+    assert flat.size == 0 or flat.max() < 0.25, (
+        f"zeroed a coefficient saga holds large: {flat}"
     )
     agree = np.mean(model.predict(iris) == sk.predict(X))
     assert agree >= 0.99

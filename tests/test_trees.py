@@ -65,7 +65,17 @@ def test_rf_respects_filter(session):
     filtered = t2.filter(jnp.arange(t2.n_pad) < 500)
     model = RandomForestClassifier(num_trees=10, max_depth=6, seed=0).fit(filtered)
     acc_clean_half = np.mean(model.predict(t2)[:500] == y[:500])
-    assert acc_clean_half > 0.85  # corrupt (filtered) half did not poison trees
+    # Root-caused round 6: the old bare `> 0.85` threshold sat EXACTLY on
+    # the accuracy this jaxlib's RNG stream produces (0.85) — a quality
+    # flake, not a filtering bug. The claim under test is that the
+    # corrupt (filtered) half did not poison the trees, so assert it
+    # directly: the filtered fit must beat a fit that really ingests the
+    # corrupt labels (measured 0.85 vs 0.76 here), with a loose absolute
+    # floor guarding against both fits degenerating together.
+    poisoned = RandomForestClassifier(num_trees=10, max_depth=6, seed=0).fit(t2)
+    acc_poisoned = np.mean(poisoned.predict(t2)[:500] == y[:500])
+    assert acc_clean_half >= acc_poisoned + 0.05
+    assert acc_clean_half >= 0.8
 
 
 def test_rf_regressor(session):
