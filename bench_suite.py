@@ -6,6 +6,9 @@ Prints ONE JSON line per config:
   4 MovieLens-proxy ALS rank-16 over 25M ratings, fit wall + RMSE
   5 Taxi-proxy      KMeans+PCA feature pipeline, eager widget-graph wall vs
                     staged single-XLA-computation wall
+  6 dispatch        epochs_per_dispatch K in {1,4,16} replay amortization
+  7 serving ladders bucket-ladder sweep (none/pow2/fixed-64)
+  8 optim sweep     adam vs dense/sparse adagrad + sgd/ftrl arms (optim/)
 
 No published reference numbers exist (BASELINE.md: empty mount,
 `published: {}`), so every `vs_baseline` is null — the honest fields are the
@@ -13,7 +16,7 @@ absolute wall-clocks, quality metrics, and rows/s. Shapes follow the
 BASELINE configs' datasets (synthetic, same dimensionality); row counts are
 sized to one chip's HBM and can be overridden with --rows-scale.
 
-Run: python bench_suite.py [--config 3|4|5|all] [--rows-scale 1.0]
+Run: python bench_suite.py [--config 3|4|5|6|7|8|all] [--rows-scale 1.0]
 """
 
 from __future__ import annotations
@@ -384,6 +387,83 @@ def bench_dispatch_overhead(scale: float) -> dict:
     }
 
 
+# --------------------------------------------------- optimizer A/B bench
+def bench_optim_sweep(scale: float) -> dict:
+    """Optimizer-lever sweep (optim/ subsystem): the same cached-replay
+    hashed fit under the legacy dense-adam path, the dense-adagrad twin,
+    and the touched-row sparse-adagrad path — wall + per-replay-epoch
+    time per arm, plus the sparse-vs-dense-twin embedding parity (the
+    rules are the same math; only the lowering differs). The headline A/B
+    at full Criteo scale lives in ``bench.py`` (pure_step_ms vs
+    pure_step_ms_dense in one JSON line); this config is the small-scale
+    sweep that also covers sgd/ftrl arms."""
+    import jax
+    import numpy as np
+
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+
+    n_rows = max(1 << 16, int((1 << 17) * scale))
+    n_dense, n_cat, dims = 4, 8, 1 << 16
+    chunk = 1 << 14
+    epochs = 9
+    session = TpuSession.builder_get_or_create()
+    rng = np.random.default_rng(9)
+    dense = rng.standard_normal((n_rows, n_dense)).astype(np.float32)
+    cats = rng.integers(0, 5000, (n_rows, n_cat)).astype(np.float32)
+    y = (dense[:, 0] + 0.3 * rng.standard_normal(n_rows) > 0
+         ).astype(np.float32)
+    Xall = np.concatenate([dense, cats], axis=1)
+
+    def arm(optim):
+        est = StreamingHashedLinearEstimator(
+            n_dims=dims, n_dense=n_dense, n_cat=n_cat, epochs=epochs,
+            step_size=0.05, reg_param=1e-4, chunk_rows=chunk,
+            optim_update=optim,
+        )
+        src = array_chunk_source(Xall, y, chunk_rows=chunk)
+        _log(f"[optim] warm-up {optim} ...")
+        est.fit_stream(src, session=session, cache_device=True)
+        _log(f"[optim] timed {optim} ...")
+        st: dict = {}
+        t0 = time.perf_counter()
+        model = est.fit_stream(src, session=session, cache_device=True,
+                               stage_times=st)
+        jax.block_until_ready(model.theta["emb"])
+        wall = time.perf_counter() - t0
+        return model, {
+            "wall_s": round(wall, 3),
+            "replay_fused_s": st.get("replay_fused_s"),
+            "optim_update": st.get("optim_update"),      # post-kill-switch
+            "sparse_lowering": st.get("sparse_lowering"),
+        }
+
+    sweep = {}
+    models = {}
+    for optim in ("adam", "dense_adagrad", "sparse_adagrad",
+                  "sparse_sgd", "sparse_ftrl"):
+        models[optim], sweep[optim] = arm(optim)
+    twin_diff = float(np.abs(
+        np.asarray(models["sparse_adagrad"].theta["emb"])
+        - np.asarray(models["dense_adagrad"].theta["emb"])).max())
+    rf = {k: v["replay_fused_s"] for k, v in sweep.items()}
+    return {
+        "metric": "hashed_optim_update_sweep", "unit": "s",
+        "value": sweep["sparse_adagrad"]["wall_s"], "vs_baseline": None,
+        "rows": n_rows, "epochs": epochs, "n_hashed_dims": dims,
+        "sweep": sweep,
+        "sparse_replay_speedup_vs_adam": (
+            round(rf["adam"] / rf["sparse_adagrad"], 2)
+            if rf.get("adam") and rf.get("sparse_adagrad") else None),
+        # sparse-vs-dense-twin parity, measured per run (the hard gates
+        # live in tests/test_sparse_optim.py)
+        "adagrad_twin_max_abs_diff": twin_diff,
+    }
+
+
 # --------------------------------------------------- serving-ladder bench
 def bench_serving_ladders(scale: float) -> dict:
     """Bucket-ladder sweep (serve/ subsystem): the same mixed-size predict
@@ -486,7 +566,7 @@ def main():
     tune_malloc()  # dedicated bench process: keep big buffers resident
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="all",
-                    choices=["3", "4", "5", "6", "7", "all"])
+                    choices=["3", "4", "5", "6", "7", "8", "all"])
     ap.add_argument("--rows-scale", type=float, default=1.0)
     args = ap.parse_args()
     # serialize against any other TPU harness (see utils/devlock.py)
@@ -523,8 +603,8 @@ def _main_locked(args, lk):
         lk.release()
     benches = {"3": bench_higgs_trees, "4": bench_movielens_als,
                "5": bench_taxi_pipeline, "6": bench_dispatch_overhead,
-               "7": bench_serving_ladders}
-    keys = (["3", "4", "5", "6", "7"] if args.config == "all"
+               "7": bench_serving_ladders, "8": bench_optim_sweep}
+    keys = (["3", "4", "5", "6", "7", "8"] if args.config == "all"
             else [args.config])
     failed = []
     for k in keys:

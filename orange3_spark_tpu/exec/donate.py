@@ -19,7 +19,10 @@ exceptions):
 
 * ``models/hashed_linear._hashed_step`` / ``_hashed_replay_epochs``
   (per-chunk step, fused/epoch/disk-group replay) — donate
-  ``(theta, opt_state)``.
+  ``(theta, opt_state)``; under the optim/ subsystem ``opt_state`` is the
+  sparse state ``(slots, timestamps, step)``, donated identically. The
+  per-chunk touched-row PLANS are scan xs (reused every epoch) and are
+  deliberately NOT donated.
 * ``io/streaming._stream_step`` / ``_stream_replay_epochs`` — donate
   ``(theta, opt_state)``; ``_kmeans_stream_step`` /
   ``_kmeans_replay_epochs`` — donate ``(centers, counts)``.

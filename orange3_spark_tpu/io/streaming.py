@@ -601,7 +601,13 @@ class _DeviceCache:
 
     @staticmethod
     def _size(batch: tuple) -> int:
-        return sum(b.nbytes for b in batch if hasattr(b, "nbytes"))
+        # tree-flatten, not a flat scan: hashed sparse-plan batches carry
+        # a DICT of plan arrays as their 5th element, and skipping it
+        # would under-count the budget the replay-fusion gate reads
+        import jax
+
+        return sum(b.nbytes for b in jax.tree.leaves(batch)
+                   if hasattr(b, "nbytes"))
 
     def exclude(self, drop_ids: set) -> None:
         """Remove batches whose FIRST element's id() is in ``drop_ids``,

@@ -61,6 +61,11 @@ from orange3_spark_tpu.io.multihost import put_sharded
 from orange3_spark_tpu.models._linear import EPS_TOTAL_WEIGHT, per_row_loss
 from orange3_spark_tpu.models.base import Estimator, Model, Params
 from orange3_spark_tpu.ops.hashing import column_salts, hash_columns
+from orange3_spark_tpu.optim.sparse import (
+    build_plan_np, dense_update, finalize_lazy_decay, init_optim_state,
+    is_sparse_update, optim_kind, plan_field_shapes, resolve_optim_update,
+    resolve_sparse_lowering, sparse_embedding_update,
+)
 from orange3_spark_tpu.utils.dispatch import bound_dispatch
 from orange3_spark_tpu.utils.profiling import count_dispatch
 
@@ -88,6 +93,24 @@ class HashedLinearParams(Params):
     # 'fused' on every backend — the 2026-07-31 on-chip A/B winner).
     # Explicit values force a specific scatter lowering.
     emb_update: str = "auto"     # 'auto' | 'fused' | 'per_column' | 'sorted'
+    # Optimizer rule + lowering (optim/ subsystem, docs/optim.md):
+    # 'adam' is the legacy dense optax path (in-loss L2, full-table moment
+    # sweeps every step). The sparse_* rules update ONLY the rows a step
+    # touches — per-row f32 slots, lazy decoupled weight decay via
+    # last-seen timestamps — and each has a dense_* twin (same math, full
+    # sweeps) for parity/A-B. OTPU_SPARSE_UPDATE=0 resolves sparse_* to
+    # dense_* at fit entry (the kill-switch, donation-sweep conventions).
+    # Note: the non-adam rules treat reg_param as DECOUPLED weight decay
+    # (FTRL: its closed-form L2), not an in-loss term, and report the
+    # pure data loss.
+    optim_update: str = "adam"   # 'adam' | '{dense,sparse}_{sgd,adagrad,ftrl}'
+    # Dedup lowering for sparse_* rules: 'plan' pre-sorts each chunk's
+    # touched rows on the HOST at ingest (replayed every epoch, gather-
+    # based writeback — CPU default); 'sort' dedups in-step (argsort in
+    # the jit, no per-chunk aux memory — TPU default). 'auto' resolves
+    # per backend via optim.resolve_sparse_lowering.
+    sparse_lowering: str = "auto"   # 'auto' | 'plan' | 'sort'
+    l1_param: float = 0.0        # FTRL-proximal l1 (sparse/dense ftrl only)
     fused_replay: bool = True    # cache replay epochs as scan program(s)
     # Granularity of the fused replay dispatches: 'all' lowers epochs 2+
     # to ONE scan (n_epochs-1 trip count — cheapest, one dispatch);
@@ -315,73 +338,149 @@ def _split_chunk(Xall, n_valid, y, w, *, label_in_chunk: bool, n_dense: int,
 
 
 def _step_core(
-    theta, opt_state, Xall, n_valid, y, w, salts, reg, lr,
+    theta, opt_state, Xall, n_valid, y, w, salts, reg, lr, plan=None, l1=0.0,
     *, loss_kind: str, n_dims: int, n_dense: int, compute_dtype=jnp.float32,
     label_in_chunk: bool = False, emb_update: str = "fused",
     value_weighted: bool = False, impute_missing: bool = False,
+    optim_update: str = "adam", sparse_lowering: str = "none",
+    use_decay: bool = False,
 ):
-    """One adam step on one chunk — traced by both the per-chunk jit
-    (`_hashed_step`) and the fused replay scan (`_hashed_replay_epochs`)."""
+    """One optimizer step on one chunk — traced by both the per-chunk jit
+    (`_hashed_step`) and the fused replay scan (`_hashed_replay_epochs`).
+
+    optim_update == 'adam' is the legacy path: in-loss L2 + a dense optax
+    adam sweep over the whole table. Every other rule (optim/ subsystem)
+    reports the pure data loss, treats reg as decoupled weight decay, and
+    — for the sparse_* rules — updates only the touched rows, with ``plan``
+    carrying the host-presorted dedup under the 'plan' lowering."""
     yv, dense, cats, wv, vals = _split_chunk(
         Xall, n_valid, y, w, label_in_chunk=label_in_chunk, n_dense=n_dense,
         value_weighted=value_weighted, impute_missing=impute_missing,
     )
     idx = hash_columns(cats, salts, n_dims)
 
-    def loss_fn(theta):
-        logits = _hashed_logits(theta, dense, idx, compute_dtype, emb_update,
+    if optim_update == "adam":
+        def loss_fn(theta):
+            logits = _hashed_logits(theta, dense, idx, compute_dtype,
+                                    emb_update, vals)
+            row = per_row_loss(loss_kind, logits, yv)
+            sw = jnp.maximum(jnp.sum(wv), EPS_TOTAL_WEIGHT)
+            data = jnp.sum(row * wv) / sw
+            return data + 0.5 * reg * (
+                jnp.sum(theta["emb"] ** 2) + jnp.sum(theta["coef"] ** 2)
+            )
+
+        loss, g = jax.value_and_grad(loss_fn)(theta)
+        updates, opt_state = _ADAM_UNIT.update(g, opt_state, theta)
+        updates = jax.tree.map(lambda u: lr * u, updates)
+        return optax.apply_updates(theta, updates), opt_state, loss
+
+    kind = optim_kind(optim_update)
+    decay = 1.0 - lr * reg
+    step = opt_state["step"]
+    slots = opt_state["slots"]
+    if is_sparse_update(optim_update):
+        # forward only — no autodiff through the table: the [N, k] logits
+        # gradient is all the touched-row engine needs (the plain 'fused'
+        # gather forward; emb_update scatter lowerings are a BACKWARD
+        # concern and only apply to the dense paths)
+        logits = _hashed_logits(theta, dense, idx, compute_dtype, "fused",
                                 vals)
-        row = per_row_loss(loss_kind, logits, yv)
-        sw = jnp.maximum(jnp.sum(wv), EPS_TOTAL_WEIGHT)
-        data = jnp.sum(row * wv) / sw
-        return data + 0.5 * reg * (
-            jnp.sum(theta["emb"] ** 2) + jnp.sum(theta["coef"] ** 2)
+
+        def data_loss(z):
+            row = per_row_loss(loss_kind, z, yv)
+            sw = jnp.maximum(jnp.sum(wv), EPS_TOTAL_WEIGHT)
+            return jnp.sum(row * wv) / sw
+
+        loss, dl = jax.value_and_grad(data_loss)(logits)
+        emb, t, eslots = sparse_embedding_update(
+            kind, theta["emb"], opt_state["t"], slots["emb"], dl, idx,
+            lr, decay, reg, l1, step, lowering=sparse_lowering,
+            use_decay=use_decay, plan=plan, n_valid=n_valid,
+            raw_cats=(cats if value_weighted else None), vals=vals,
         )
+        # dense small parameters: the same rule, full-array (they are tiny)
+        if theta["coef"].shape[0]:
+            g_coef = jnp.dot(dense.astype(compute_dtype).T, dl,
+                             preferred_element_type=jnp.float32)
+        else:
+            g_coef = jnp.zeros_like(theta["coef"])
+        g_int = jnp.sum(dl, axis=0)
+    else:
+        # dense twin: autodiff through the table (the emb_update scatter
+        # lowering applies), then a full-array rule sweep — the parity
+        # baseline the sparse path is measured against
+        def loss_fn(theta):
+            logits = _hashed_logits(theta, dense, idx, compute_dtype,
+                                    emb_update, vals)
+            row = per_row_loss(loss_kind, logits, yv)
+            sw = jnp.maximum(jnp.sum(wv), EPS_TOTAL_WEIGHT)
+            return jnp.sum(row * wv) / sw
 
-    loss, g = jax.value_and_grad(loss_fn)(theta)
-    updates, opt_state = _ADAM_UNIT.update(g, opt_state, theta)
-    updates = jax.tree.map(lambda u: lr * u, updates)
-    return optax.apply_updates(theta, updates), opt_state, loss
+        loss, g = jax.value_and_grad(loss_fn)(theta)
+        t = opt_state["t"]
+        emb, eslots = dense_update(
+            kind, theta["emb"], slots["emb"], g["emb"], lr, decay, reg, l1,
+            use_decay=use_decay)
+        g_coef, g_int = g["coef"], g["intercept"]
+    coef, cslots = dense_update(
+        kind, theta["coef"], slots["coef"], g_coef, lr, decay, reg, l1,
+        use_decay=use_decay)
+    intercept, islots = dense_update(
+        kind, theta["intercept"], slots["intercept"], g_int, lr, decay,
+        reg, l1, use_decay=False)    # reg never touched the intercept
+    theta = {"emb": emb, "coef": coef, "intercept": intercept}
+    opt_state = {"step": step + 1, "t": t,
+                 "slots": {"emb": eslots, "coef": cslots,
+                           "intercept": islots}}
+    return theta, opt_state, loss
 
 
-@donating_jit(
-    static_argnames=(
-        "loss_kind", "n_dims", "n_dense", "compute_dtype", "label_in_chunk",
-        "emb_update", "value_weighted", "impute_missing",
-    ),
-    donate_argnums=(0, 1),
+_STEP_STATICS = (
+    "loss_kind", "n_dims", "n_dense", "compute_dtype", "label_in_chunk",
+    "emb_update", "value_weighted", "impute_missing", "optim_update",
+    "sparse_lowering", "use_decay",
 )
+
+
+@donating_jit(static_argnames=_STEP_STATICS, donate_argnums=(0, 1))
 def _hashed_step(
-    theta, opt_state, Xall, n_valid, y, w, salts, reg, lr,
+    theta, opt_state, Xall, n_valid, y, w, salts, reg, lr, plan=None,
+    l1=0.0,
     *, loss_kind: str, n_dims: int, n_dense: int, compute_dtype=jnp.float32,
     label_in_chunk: bool = False, emb_update: str = "fused",
     value_weighted: bool = False, impute_missing: bool = False,
+    optim_update: str = "adam", sparse_lowering: str = "none",
+    use_decay: bool = False,
 ):
     return _step_core(
-        theta, opt_state, Xall, n_valid, y, w, salts, reg, lr,
+        theta, opt_state, Xall, n_valid, y, w, salts, reg, lr, plan, l1,
         loss_kind=loss_kind, n_dims=n_dims, n_dense=n_dense,
         compute_dtype=compute_dtype, label_in_chunk=label_in_chunk,
         emb_update=emb_update, value_weighted=value_weighted,
-        impute_missing=impute_missing,
+        impute_missing=impute_missing, optim_update=optim_update,
+        sparse_lowering=sparse_lowering, use_decay=use_decay,
     )
 
 
-@donating_jit(
-    static_argnames=(
-        "loss_kind", "n_dims", "n_dense", "compute_dtype", "label_in_chunk",
-        "emb_update", "value_weighted", "impute_missing", "n_epochs",
-    ),
-    donate_argnums=(0, 1),
-)
+@donating_jit(static_argnames=_STEP_STATICS + ("n_epochs",),
+              donate_argnums=(0, 1))
 def _hashed_replay_epochs(
-    theta, opt_state, Xstack, n_valid_vec, ystack, wstack, salts, reg, lr,
+    theta, opt_state, stacks, salts, reg, lr, l1=0.0,
     *, loss_kind: str, n_dims: int, n_dense: int, compute_dtype=jnp.float32,
     label_in_chunk: bool = False, emb_update: str = "fused",
     value_weighted: bool = False, impute_missing: bool = False,
+    optim_update: str = "adam", sparse_lowering: str = "none",
+    use_decay: bool = False,
     n_epochs: int,
 ):
     """Epochs 2+ of a cached fit as ONE XLA program: an epoch-level scan
     around a chunk-level scan over the HBM-resident chunk stack.
+
+    ``stacks`` is the chunk stack as one pytree — ``(Xstack, n_valid_vec,
+    ystack, wstack)`` plus, when the sparse 'plan' lowering is active, a
+    fifth element holding the stacked per-chunk touched-row plans (each
+    leaf [n_chunks, ...]); the scan slices all of them in lockstep.
 
     Rationale (measured round 3, BASELINE.md roofline): the per-chunk jit
     replay paid ~275 ms/step of per-dispatch/sync overhead on the tunneled
@@ -394,20 +493,20 @@ def _hashed_replay_epochs(
     kw = dict(loss_kind=loss_kind, n_dims=n_dims, n_dense=n_dense,
               compute_dtype=compute_dtype, label_in_chunk=label_in_chunk,
               emb_update=emb_update, value_weighted=value_weighted,
-              impute_missing=impute_missing)
+              impute_missing=impute_missing, optim_update=optim_update,
+              sparse_lowering=sparse_lowering, use_decay=use_decay)
 
     def chunk_body(carry, xs):
         theta, opt = carry
-        Xall, n_valid, y, w = xs
+        Xall, n_valid, y, w = xs[:4]
+        plan = xs[4] if len(xs) > 4 else None
         theta, opt, loss = _step_core(
-            theta, opt, Xall, n_valid, y, w, salts, reg, lr, **kw
+            theta, opt, Xall, n_valid, y, w, salts, reg, lr, plan, l1, **kw
         )
         return (theta, opt), loss
 
     def epoch_body(carry, _):
-        carry, losses = jax.lax.scan(
-            chunk_body, carry, (Xstack, n_valid_vec, ystack, wstack)
-        )
+        carry, losses = jax.lax.scan(chunk_body, carry, tuple(stacks))
         return carry, losses
 
     (theta, opt_state), chunk_losses = jax.lax.scan(
@@ -599,7 +698,10 @@ class HashedLinearModel(Model):
         salts = jnp.asarray(self.salts)
         kind = _row_loss_kind(p)
         tot = None
-        for Xd, n_valid, yd, wd in device_chunks:
+        for chunk in device_chunks:
+            # sparse-plan fits cache 5-tuples (the touched-row plan rides
+            # along for replay); eval only needs the data quadruple
+            Xd, n_valid, yd, wd = chunk[:4]
             count_dispatch()
             out = _hashed_eval_chunk(
                 self.theta, Xd, n_valid, yd, wd, salts,
@@ -626,6 +728,38 @@ class HashedLinearModel(Model):
             if auc is not None:
                 out["auc"] = auc
         return out
+
+
+#: spill serialization order of the touched-row plan's arrays ('val' only
+#: in value-weighted mode) — the one ordering _plan_f32_views and
+#: _plan_from_f32 share with the DiskChunkCache record layout
+_PLAN_ORDER = ("row", "seg", "uniq", "inv", "val")
+
+
+def _plan_f32_views(plan: dict) -> tuple:
+    """Plan arrays as f32 VIEWS (bit-preserving reinterpretation) in
+    ``_PLAN_ORDER`` — the disk spill stores flat f32 records, and every
+    plan array is 4-byte, so a view round-trips losslessly."""
+    return tuple(
+        np.ascontiguousarray(plan[k]).view(np.float32)
+        for k in _PLAN_ORDER if k in plan
+    )
+
+
+def _plan_from_f32(arrays, value_weighted: bool) -> dict:
+    """Inverse of ``_plan_f32_views`` over spill-record views."""
+    keys = _PLAN_ORDER if value_weighted else _PLAN_ORDER[:4]
+    plan = {}
+    for k, a in zip(keys, arrays):
+        a = np.asarray(a)
+        plan[k] = a if k == "val" else a.view(np.int32)
+    return plan
+
+
+def _plan_spill_shapes(p: HashedLinearParams, pad_rows: int) -> tuple:
+    """Per-record plan-array shapes appended to the spill layout."""
+    shapes = plan_field_shapes(pad_rows, p.n_cat, p.n_dims, p.value_weighted)
+    return tuple(shapes[k] for k in _PLAN_ORDER if k in shapes)
 
 
 def _chunk_cols(p: HashedLinearParams) -> int:
@@ -658,7 +792,13 @@ def _init_fit_state(p: HashedLinearParams, session: TpuSession):
         theta["emb"] = jax.device_put(
             theta["emb"], session.sharding(session.model_axis, None)
         )
-    opt_state = _ADAM_UNIT.init(theta)
+    optim = resolve_optim_update(p.optim_update)
+    lowering = (resolve_sparse_lowering(p.sparse_lowering)
+                if is_sparse_update(optim) else "none")
+    if optim == "adam":
+        opt_state = _ADAM_UNIT.init(theta)
+    else:
+        opt_state = init_optim_state(optim, theta)
     if p.value_weighted:
         # position-INDEPENDENT hashing: libsvm-style sources pack
         # (idx, val) pairs positionally, so every slot must share ONE salt
@@ -677,6 +817,10 @@ def _init_fit_state(p: HashedLinearParams, session: TpuSession):
         compute_dtype=jnp.dtype(p.compute_dtype),
         label_in_chunk=p.label_in_chunk, emb_update=resolve_emb_update(p),
         value_weighted=p.value_weighted, impute_missing=_impute_flag(p),
+        optim_update=optim, sparse_lowering=lowering,
+        # static decay gate: reg == 0 compiles the sparse step without the
+        # timestamp gathers/pow (and ftrl owns its L2 in closed form)
+        use_decay=(p.reg_param != 0.0 and optim_kind(optim) != "ftrl"),
     )
     return theta, opt_state, salts_np, salts, static_kw
 
@@ -752,7 +896,7 @@ class StreamingHashedLinearEstimator(Estimator):
             return None
         n_cols = _chunk_cols(p)
         pad_rows = session.pad_rows(p.chunk_rows)
-        theta, opt, _, salts, kw = _init_fit_state(p, session)
+        theta, opt, salts_np, salts, kw = _init_fit_state(p, session)
         # one zero chunk through the SAME device-put path as the real fit,
         # so the stacked avals (incl. shardings) match the timed run's
         z = put_sharded(np.zeros((pad_rows, n_cols), np.float32),
@@ -764,6 +908,20 @@ class StreamingHashedLinearEstimator(Estimator):
             zy = put_sharded(np.zeros((pad_rows,), np.float32),
                              session.vector_sharding)
             zw = zy
+        plan = None
+        if kw["sparse_lowering"] == "plan":
+            # the zero chunk's touched-row plan, through the same builder
+            # as the real fit (zero codes hash to one bucket per column —
+            # the skew is irrelevant to the compiled shapes)
+            zc = np.zeros((pad_rows, p.n_cat), np.float32)
+            plan = jax.device_put(
+                build_plan_np(
+                    zc, salts_np, p.n_dims, pad_rows,
+                    vals=(np.zeros((pad_rows, p.n_cat), np.float32)
+                          if p.value_weighted else None),
+                    impute_missing=kw["impute_missing"]),
+                session.replicated)
+        l1 = jnp.float32(p.l1_param)
         if not p.defer_epoch1:
             # theta/opt must have step-OUTPUT provenance (GSPMD-placed),
             # like the real replay's inputs after a per-chunk epoch 1. A
@@ -773,15 +931,19 @@ class StreamingHashedLinearEstimator(Estimator):
             # fault needs.
             theta, opt, _ = _hashed_step(
                 theta, opt, z, nv, zy, zw, salts,
-                jnp.float32(p.reg_param), jnp.float32(p.step_size), **kw)
+                jnp.float32(p.reg_param), jnp.float32(p.step_size),
+                plan, l1, **kw)
         n_rep = p.epochs - 1 + (1 if p.defer_epoch1 else 0)
         stacks = (
             jnp.stack([z] * n_chunks), jnp.stack([nv] * n_chunks),
             jnp.stack([zy] * n_chunks), jnp.stack([zw] * n_chunks),
         )
+        if plan is not None:
+            stacks = stacks + (jax.tree.map(
+                lambda a: jnp.stack([a] * n_chunks), plan),)
         theta, opt, losses = _hashed_replay_epochs(
-            theta, opt, *stacks, salts,
-            jnp.float32(p.reg_param), jnp.float32(p.step_size),
+            theta, opt, stacks, salts,
+            jnp.float32(p.reg_param), jnp.float32(p.step_size), l1,
             # 'epoch' granularity dispatches n_epochs=K scans (the
             # epochs_per_dispatch group size, clamped to the replay span)
             n_epochs=(min(max(1, p.epochs_per_dispatch), n_rep)
@@ -867,6 +1029,16 @@ class StreamingHashedLinearEstimator(Estimator):
         vec_sh = session.vector_sharding
         reg = jnp.float32(p.reg_param)
         lr = jnp.float32(p.step_size)
+        l1 = jnp.float32(p.l1_param)
+        # sparse-optimizer plumbing (optim/ subsystem): under the 'plan'
+        # lowering every device chunk carries its host-presorted
+        # touched-row plan as a 5th tuple element — built once on the
+        # prefetch thread, cached/spilled/stacked alongside the chunk
+        optim_resolved = static_kw["optim_update"]
+        sparse_plan = static_kw["sparse_lowering"] == "plan"
+        # categorical block offset in the padded chunk ([label?] + dense +
+        # cats, or [label?] + idx pairs; n_dense == 0 in vw mode)
+        cats_off = (1 if p.label_in_chunk else 0) + p.n_dense
         times = {"parse_s": 0.0, "h2d_s": 0.0} if stage_times is not None else None
         # fit-level pipeline counters: every prefetch stream (live ingest,
         # disk replay, grouped disk replay) folds in, so overlap_pct is the
@@ -896,13 +1068,30 @@ class StreamingHashedLinearEstimator(Estimator):
             else:
                 Xp, yp, wp = _pad_chunk(X_np, y_np, w_np, pad_rows,
                                         n_cols)
+            plan_np = None
+            if sparse_plan:
+                # host-presorted touched-row plan (optim/sparse.py) —
+                # the stable argsort runs here on the prefetch thread,
+                # overlapping device steps, and is replayed every epoch
+                t_pl = time.perf_counter() if times is not None else 0.0
+                plan_np = build_plan_np(
+                    Xp[:, cats_off:cats_off + p.n_cat], salts_np,
+                    p.n_dims, n,
+                    vals=(Xp[:, cats_off + p.n_cat:]
+                          if p.value_weighted else None),
+                    impute_missing=static_kw["impute_missing"])
+                if times is not None:
+                    times["plan_s"] = (times.get("plan_s", 0.0)
+                                       + time.perf_counter() - t_pl)
             if spill_active[0]:
                 # sequential f32 write of the already-padded chunk — still
-                # on the prefetch thread, overlapping device steps
+                # on the prefetch thread, overlapping device steps. Plan
+                # arrays ride the same record, i32 bit-viewed as f32.
                 t_sp = time.perf_counter() if times is not None else 0.0
-                spill.append(
-                    (Xp,) if p.label_in_chunk else (Xp, yp, wp), n
-                )
+                rec = (Xp,) if p.label_in_chunk else (Xp, yp, wp)
+                if plan_np is not None:
+                    rec = rec + _plan_f32_views(plan_np)
+                spill.append(rec, n)
                 if times is not None:
                     times["spill_s"] = (times.get("spill_s", 0.0)
                                         + time.perf_counter() - t_sp)
@@ -913,9 +1102,12 @@ class StreamingHashedLinearEstimator(Estimator):
             else:
                 yd = put_sharded(yp, vec_sh)
                 wd = put_sharded(wp, vec_sh)
+            out = (Xd, jnp.int32(n), yd, wd)
+            if plan_np is not None:
+                out = out + (jax.device_put(plan_np, session.replicated),)
             if times is not None:
                 times["h2d_s"] += time.perf_counter() - t0
-            return Xd, jnp.int32(n), yd, wd
+            return out
 
         _ZERO = jnp.zeros((1,), jnp.float32)
 
@@ -984,6 +1176,8 @@ class StreamingHashedLinearEstimator(Estimator):
                 and (p.epochs > 1 or defer)):
             shapes = (((pad_rows, n_cols),) if p.label_in_chunk
                       else ((pad_rows, n_cols), (pad_rows,), (pad_rows,)))
+            if sparse_plan:
+                shapes = shapes + _plan_spill_shapes(p, pad_rows)
             spill = DiskChunkCache(cache_spill_dir, shapes)
             spill_active[0] = True
         use_disk = False
@@ -1001,10 +1195,11 @@ class StreamingHashedLinearEstimator(Estimator):
 
         def run_step(dev_chunk):
             nonlocal theta, opt_state, n_steps, last_loss
-            Xd, n_valid, yd, wd = dev_chunk
+            Xd, n_valid, yd, wd = dev_chunk[:4]
+            plan = dev_chunk[4] if len(dev_chunk) > 4 else None
             theta, opt_state, loss = _hashed_step(
                 theta, opt_state, Xd, n_valid, yd, wd, salts, reg, lr,
-                **static_kw,
+                plan, l1, **static_kw,
             )
             n_steps += 1
             last_loss = loss
@@ -1044,6 +1239,7 @@ class StreamingHashedLinearEstimator(Estimator):
 
             def rec_to_device(i):
                 arrays, n = spill.read(i)
+                n_base = 1 if p.label_in_chunk else 3
                 t0 = time.perf_counter() if times is not None else 0.0
                 Xd = put_sharded(np.asarray(arrays[0]), row_sh)
                 if p.label_in_chunk:
@@ -1051,9 +1247,15 @@ class StreamingHashedLinearEstimator(Estimator):
                 else:
                     yd = put_sharded(np.asarray(arrays[1]), vec_sh)
                     wd = put_sharded(np.asarray(arrays[2]), vec_sh)
+                out = (Xd, jnp.int32(n), yd, wd)
+                if sparse_plan:
+                    plan_np = _plan_from_f32(arrays[n_base:],
+                                             p.value_weighted)
+                    out = out + (jax.device_put(plan_np,
+                                                session.replicated),)
                 if times is not None:
                     times["h2d_s"] += time.perf_counter() - t0
-                return Xd, jnp.int32(n), yd, wd
+                return out
 
             idxs = iter(range(start, spill.n_records - holdout_chunks))
             if p.prefetch_depth > 0:
@@ -1079,6 +1281,7 @@ class StreamingHashedLinearEstimator(Estimator):
             def grp_to_device(start):
                 g = group
                 recs = [spill.read(start + j) for j in range(g)]
+                n_base = 1 if p.label_in_chunk else 3
                 t0 = time.perf_counter() if times is not None else 0.0
                 Xs = put_sharded(
                     np.stack([np.asarray(r[0][0]) for r in recs]),
@@ -1093,9 +1296,16 @@ class StreamingHashedLinearEstimator(Estimator):
                         np.stack([np.asarray(r[0][1]) for r in recs]), vsh)
                     ws = put_sharded(
                         np.stack([np.asarray(r[0][2]) for r in recs]), vsh)
+                stacks = (Xs, nv, ys, ws)
+                if sparse_plan:
+                    plans = [_plan_from_f32(r[0][n_base:], p.value_weighted)
+                             for r in recs]
+                    stacks = stacks + (jax.device_put(
+                        jax.tree.map(lambda *a: np.stack(a), *plans),
+                        session.replicated),)
                 if times is not None:
                     times["h2d_s"] += time.perf_counter() - t0
-                return g, (Xs, nv, ys, ws)
+                return g, stacks
 
             starts = iter(range(0, n_full, group))
             if p.prefetch_depth > 0:
@@ -1179,7 +1389,7 @@ class StreamingHashedLinearEstimator(Estimator):
                     n_groups = 0
                     for g, stacks in disk_group_iter(group):
                         theta, opt_state, losses = _hashed_replay_epochs(
-                            theta, opt_state, *stacks, salts, reg, lr,
+                            theta, opt_state, stacks, salts, reg, lr, l1,
                             n_epochs=1, **static_kw,
                         )
                         n_steps += g
@@ -1232,10 +1442,11 @@ class StreamingHashedLinearEstimator(Estimator):
                     n_steps += n_rep * spe
                     break
                 t_rep = time.perf_counter()
-                stacks = tuple(
-                    jnp.stack([c[i] for c in cache.batches])
-                    for i in range(4)
-                )
+                # stack the WHOLE chunk tuple as one pytree — the 5th
+                # (plan) element's dict leaves stack right along under
+                # the sparse 'plan' lowering
+                stacks = jax.tree.map(
+                    lambda *xs: jnp.stack(xs), *cache.batches)
                 if p.replay_granularity == "epoch":
                     # one n_epochs=1 scan dispatch per epoch over the same
                     # stack — the tunnel-fragility middle ground (see the
@@ -1250,8 +1461,8 @@ class StreamingHashedLinearEstimator(Estimator):
                         nonlocal theta, opt_state
                         theta, opt_state, chunk_losses = \
                             _hashed_replay_epochs(
-                                theta, opt_state, *stacks, salts, reg, lr,
-                                n_epochs=n_ep, **static_kw,
+                                theta, opt_state, stacks, salts, reg, lr,
+                                l1, n_epochs=n_ep, **static_kw,
                             )
                         return chunk_losses[-1, -1]
 
@@ -1266,7 +1477,7 @@ class StreamingHashedLinearEstimator(Estimator):
                         last_loss = last
                 else:
                     theta, opt_state, chunk_losses = _hashed_replay_epochs(
-                        theta, opt_state, *stacks, salts, reg, lr,
+                        theta, opt_state, stacks, salts, reg, lr, l1,
                         n_epochs=n_rep, **static_kw,
                     )
                     count_dispatch()   # one-shot fused scan: no loop ticks
@@ -1281,8 +1492,19 @@ class StreamingHashedLinearEstimator(Estimator):
 
         if spill is not None:
             spill.delete()
+        if is_sparse_update(optim_resolved):
+            # settle the lazy decay the table still owes (rows untouched
+            # since their last step) so the returned model equals the
+            # dense schedule's — predictions/serving read theta directly
+            theta = finalize_lazy_decay(
+                theta, opt_state, p.step_size, p.reg_param, optim_resolved)
         if stage_times is not None and times is not None:
             stage_times.update(times)
+            # the resolved lowerings, so A/B records are self-describing
+            # (the 'auto' decisions are otherwise invisible post-hoc)
+            stage_times["emb_update"] = static_kw["emb_update"]
+            stage_times["optim_update"] = optim_resolved
+            stage_times["sparse_lowering"] = static_kw["sparse_lowering"]
             stage_times["epoch_s"] = [round(t, 3) for t in epoch_walls]
             if pipe_stats.items:
                 # measured prefetch overlap (exec/pipeline.py): 100% = all

@@ -19,7 +19,8 @@ import zlib
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["hash_columns", "column_salts", "strings_to_u32", "STRING_CODE_MASK"]
+__all__ = ["hash_columns", "hash_columns_np", "column_salts",
+           "strings_to_u32", "STRING_CODE_MASK"]
 
 
 def _fmix32(h):
@@ -50,6 +51,26 @@ def hash_columns(cats, salts, n_dims: int):
     u = cats.astype(jnp.int32).astype(jnp.uint32)  # wrap negatives to uint32
     h = _fmix32(u ^ jnp.asarray(salts, jnp.uint32)[None, :])
     return (h & jnp.uint32(n_dims - 1)).astype(jnp.int32)
+
+
+def hash_columns_np(cats: np.ndarray, salts: np.ndarray,
+                    n_dims: int) -> np.ndarray:
+    """Host twin of ``hash_columns`` — BIT-IDENTICAL buckets, needed by the
+    sparse-optimizer plan builder (optim/sparse.py) which pre-sorts a
+    chunk's touched rows on the prefetch thread. Any drift between the two
+    would silently update the wrong table rows, so tests/test_sparse_optim
+    pins equality over random codes including negatives and the f32
+    carrier dtype."""
+    if n_dims & (n_dims - 1):
+        raise ValueError(f"n_dims must be a power of two, got {n_dims}")
+    u = np.asarray(cats).astype(np.int32).astype(np.uint32)
+    h = u ^ np.asarray(salts, np.uint32)[None, :]
+    h ^= h >> np.uint32(16)
+    h = (h * np.uint32(0x85EBCA6B)) & np.uint32(0xFFFFFFFF)
+    h ^= h >> np.uint32(13)
+    h = (h * np.uint32(0xC2B2AE35)) & np.uint32(0xFFFFFFFF)
+    h ^= h >> np.uint32(16)
+    return (h & np.uint32(n_dims - 1)).astype(np.int32)
 
 
 #: String codes are masked to 24 bits so they survive a float32 round-trip

@@ -1,0 +1,25 @@
+"""Optimizer subsystem — touched-row-only (sparse) updates for the hashed
+embedding hot path, plus their dense twins. See ``optim/sparse.py`` and
+``docs/optim.md``."""
+
+from orange3_spark_tpu.optim.sparse import (  # noqa: F401
+    ADAGRAD_EPS,
+    DENSE_UPDATES,
+    FTRL_BETA,
+    OPTIM_UPDATES,
+    SPARSE_UPDATES,
+    apply_rule,
+    build_plan_np,
+    dense_update,
+    finalize_lazy_decay,
+    init_optim_state,
+    is_sparse_update,
+    occurrence_dead,
+    optim_kind,
+    plan_field_shapes,
+    plan_slots,
+    resolve_optim_update,
+    resolve_sparse_lowering,
+    sparse_embedding_update,
+    sparse_updates_enabled,
+)

@@ -50,7 +50,14 @@ def _run(argv, timeout=420):
     (["bench.py", "--rows", "30000", "--epochs", "8"],
      "criteo_hashed_logreg_rows_per_sec_per_chip",
      {"train_rows_x_epochs_per_sec_per_chip", "defer_epoch1", "epoch1_s",
-      "replay_source", "cache_overflow", "baseline", "holdout_auc"}),
+      "replay_source", "cache_overflow", "baseline", "holdout_auc",
+      # baseline provenance: the proxy constant + its derivation must ride
+      # every record (a bare "proxy-estimate" tag has no audit trail)
+      "baseline_value", "baseline_note",
+      # optimizer A/B self-description: the RESOLVED rule/lowerings and
+      # the dense arm measured in the same run
+      "optim_update", "sparse_lowering", "emb_update",
+      "pure_step_ms_dense", "optim_step_speedup"}),
     (["bench_suite.py", "--config", "5", "--rows-scale", "0.002"],
      "taxi_kmeans_pca_pipeline",
      {"staged_speedup", "workflow_fit_s"}),
@@ -63,7 +70,7 @@ def _run(argv, timeout=420):
      {"p50_ms", "p99_ms", "recompiles", "bucket_hits",
       "recompiles_unbucketed", "compile_reduction", "p50_ms_unbucketed",
       "p99_ms_unbucketed", "pad_overhead", "mb_merge_factor",
-      "warmup_buckets"}),
+      "warmup_buckets", "baseline_value", "baseline_note"}),
 ])
 def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
     r = _run(argv)
@@ -79,3 +86,16 @@ def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
     assert d["backend"] == "cpu"          # honest label on the fallback
     missing = extra_keys - set(d)
     assert not missing, f"contract fields missing: {missing}"
+    if "baseline_note" in extra_keys:
+        # provenance is a real derivation note, not a placeholder; when a
+        # numeric baseline backs vs_baseline the two must be consistent
+        assert isinstance(d["baseline_note"], str) and len(d["baseline_note"]) > 40
+        if d.get("baseline_value") and d.get("vs_baseline") is not None:
+            assert d["vs_baseline"] == round(
+                d["value"] / d["baseline_value"], 3)
+    if "optim_update" in extra_keys:
+        from orange3_spark_tpu.optim.sparse import OPTIM_UPDATES
+
+        assert d["optim_update"] in OPTIM_UPDATES
+        assert d["sparse_lowering"] in ("plan", "sort", "none")
+        assert d["emb_update"] in ("fused", "per_column", "sorted")

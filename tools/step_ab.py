@@ -126,12 +126,12 @@ def _main_locked(args):
                   jnp.zeros((stack_chunks, 1), jnp.float32),
                   jnp.zeros((stack_chunks, 1), jnp.float32))
         theta, opt, losses = _hashed_replay_epochs(
-            theta, opt, *stacks, salts, jnp.float32(0.0), jnp.float32(0.04),
+            theta, opt, stacks, salts, jnp.float32(0.0), jnp.float32(0.04),
             n_epochs=scan_epochs, **kw)
         jax.block_until_ready(losses)       # compile + first run
         t0 = time.perf_counter()            # stacks are not donated; reuse
         theta, opt, losses = _hashed_replay_epochs(
-            theta, opt, *stacks, salts, jnp.float32(0.0), jnp.float32(0.04),
+            theta, opt, stacks, salts, jnp.float32(0.0), jnp.float32(0.04),
             n_epochs=scan_epochs, **kw)
         jax.block_until_ready(losses)
         n_in_scan = stack_chunks * scan_epochs
