@@ -9,6 +9,7 @@ Prints ONE JSON line per config:
   6 dispatch        epochs_per_dispatch K in {1,4,16} replay amortization
   7 serving ladders bucket-ladder sweep (none/pow2/fixed-64)
   8 optim sweep     adam vs dense/sparse adagrad + sgd/ftrl arms (optim/)
+  9 cache codec     f32 vs bf16 vs packed chunk-cache precision (io/codec)
 
 No published reference numbers exist (BASELINE.md: empty mount,
 `published: {}`), so every `vs_baseline` is null — the honest fields are the
@@ -16,7 +17,7 @@ absolute wall-clocks, quality metrics, and rows/s. Shapes follow the
 BASELINE configs' datasets (synthetic, same dimensionality); row counts are
 sized to one chip's HBM and can be overridden with --rows-scale.
 
-Run: python bench_suite.py [--config 3|4|5|6|7|8|all] [--rows-scale 1.0]
+Run: python bench_suite.py [--config 3|4|5|6|7|8|9|all] [--rows-scale 1.0]
 """
 
 from __future__ import annotations
@@ -464,6 +465,96 @@ def bench_optim_sweep(scale: float) -> dict:
     }
 
 
+# ---------------------------------------------------------------- config 9
+def bench_cache_codec_sweep(scale: float) -> dict:
+    """Cache-codec sweep (io/codec.py): the SAME chunk stream cached at
+    f32 (legacy), bf16 (dense block halved) and packed (bf16 + lossless
+    bit-packed hashed indices and plan arrays) — per arm: fit wall, fused
+    replay wall, measured cache bytes and the f32-equivalent compression
+    ratio, plus the max-|theta| divergence vs the f32 arm (packed differs
+    from bf16 by NOTHING — the int packing is lossless, pinned hard in
+    tests/test_cache_codec.py; bf16 differs from f32 only through the
+    bounded dense-feature rounding). The headline capacity criterion at
+    Criteo scale lives in bench.py (compression_ratio field); this config
+    is the small-scale ladder that also shows the CPU decode-tax trade."""
+    import jax
+    import numpy as np
+
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.io.codec import force_cache_dtype
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+
+    n_rows = max(1 << 16, int((1 << 17) * scale))
+    n_dense, n_cat, dims = 4, 8, 1 << 16
+    chunk = 1 << 14
+    epochs = 9
+    session = TpuSession.builder_get_or_create()
+    rng = np.random.default_rng(17)
+    dense = rng.lognormal(size=(n_rows, n_dense)).astype(np.float32)
+    cats = rng.integers(0, 50_000, (n_rows, n_cat)).astype(np.float32)
+    y = (np.log(dense[:, 0]) + 0.3 * rng.standard_normal(n_rows) > 0
+         ).astype(np.float32)
+    Xall = np.concatenate([dense, cats], axis=1)
+
+    def arm(cache):
+        with force_cache_dtype(cache):
+            est = StreamingHashedLinearEstimator(
+                n_dims=dims, n_dense=n_dense, n_cat=n_cat, epochs=epochs,
+                step_size=0.05, reg_param=1e-4, chunk_rows=chunk,
+                optim_update="sparse_adagrad",
+            )
+            src = array_chunk_source(Xall, y, chunk_rows=chunk)
+            _log(f"[cache-codec] warm-up {cache} ...")
+            est.fit_stream(src, session=session, cache_device=True)
+            _log(f"[cache-codec] timed {cache} ...")
+            st: dict = {}
+            t0 = time.perf_counter()
+            model = est.fit_stream(src, session=session, cache_device=True,
+                                   stage_times=st)
+            jax.block_until_ready(model.theta["emb"])
+            wall = time.perf_counter() - t0
+        return model, {
+            "wall_s": round(wall, 3),
+            "replay_fused_s": st.get("replay_fused_s"),
+            "cache_dtype": st.get("cache_dtype"),
+            "cache_bytes": st.get("cache_bytes"),
+            "compression_ratio": (
+                round(st["cache_raw_bytes"] / st["cache_bytes"], 3)
+                if st.get("cache_bytes") else None),
+            "encode_s": (round(st["encode_s"], 3)
+                         if "encode_s" in st else None),
+        }
+
+    sweep = {}
+    models = {}
+    for cache in ("f32", "bf16", "packed"):
+        models[cache], sweep[cache] = arm(cache)
+    emb32 = np.asarray(models["f32"].theta["emb"])
+    for cache in ("bf16", "packed"):
+        sweep[cache]["theta_max_abs_diff_vs_f32"] = float(np.abs(
+            np.asarray(models[cache].theta["emb"]) - emb32).max())
+    rf = {k: v["replay_fused_s"] for k, v in sweep.items()}
+    return {
+        "metric": "hashed_cache_codec_sweep", "unit": "s",
+        "value": sweep["packed"]["wall_s"], "vs_baseline": None,
+        "rows": n_rows, "epochs": epochs, "n_hashed_dims": dims,
+        "sweep": sweep,
+        "packed_compression_ratio": sweep["packed"]["compression_ratio"],
+        # packed-replay-vs-f32-replay: the CPU decode-tax / TPU bandwidth
+        # trade, measured (>1 = packed replay faster)
+        "packed_replay_speedup_vs_f32": (
+            round(rf["f32"] / rf["packed"], 3)
+            if rf.get("f32") and rf.get("packed") else None),
+        # the int packing is lossless: packed must equal bf16 exactly
+        "packed_equals_bf16": bool(np.array_equal(
+            np.asarray(models["packed"].theta["emb"]),
+            np.asarray(models["bf16"].theta["emb"]))),
+    }
+
+
 # --------------------------------------------------- serving-ladder bench
 def bench_serving_ladders(scale: float) -> dict:
     """Bucket-ladder sweep (serve/ subsystem): the same mixed-size predict
@@ -566,7 +657,7 @@ def main():
     tune_malloc()  # dedicated bench process: keep big buffers resident
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="all",
-                    choices=["3", "4", "5", "6", "7", "8", "all"])
+                    choices=["3", "4", "5", "6", "7", "8", "9", "all"])
     ap.add_argument("--rows-scale", type=float, default=1.0)
     args = ap.parse_args()
     # serialize against any other TPU harness (see utils/devlock.py)
@@ -603,8 +694,9 @@ def _main_locked(args, lk):
         lk.release()
     benches = {"3": bench_higgs_trees, "4": bench_movielens_als,
                "5": bench_taxi_pipeline, "6": bench_dispatch_overhead,
-               "7": bench_serving_ladders, "8": bench_optim_sweep}
-    keys = (["3", "4", "5", "6", "7", "8"] if args.config == "all"
+               "7": bench_serving_ladders, "8": bench_optim_sweep,
+               "9": bench_cache_codec_sweep}
+    keys = (["3", "4", "5", "6", "7", "8", "9"] if args.config == "all"
             else [args.config])
     failed = []
     for k in keys:

@@ -36,6 +36,15 @@ class TpuSession:
         (new capability beyond the reference; size 1 by default).
     """
 
+    #: Session-level cache-precision policy (io/codec.py): what an
+    #: estimator's ``cache_dtype='auto'`` resolves to. 'packed' = full
+    #: compression (bf16 floats + lossless bit-packed ints — ~2x cache/
+    #: spill/DMA capacity); assign 'f32' to opt a whole session back onto
+    #: the legacy layout. The per-fit ``OTPU_CACHE_DTYPE`` env kill-switch
+    #: overrides BOTH this and the param, and like ``OTPU_SPARSE_UPDATE``
+    #: it resolves ONCE at fit entry into a static jit argument.
+    default_cache_dtype: str = "packed"
+
     _lock = threading.Lock()
     _active: "TpuSession | None" = None
     # per-context override installed by use(); isolates concurrent threads /

@@ -46,6 +46,10 @@ class PipelineStats:
     prep_s: float = 0.0   # producer time inside prep (parse/pad/device_put)
     wait_s: float = 0.0   # consumer time blocked waiting on the queue
     wall_s: float = 0.0   # consumer wall from first wait to stream end
+    # producer time spent ENCODING chunks for the compressed cache
+    # (io/codec.py) — a subset of prep_s, attributed by the prep callback
+    # itself so the cache-codec cost is visible next to parse/DMA
+    encode_s: float = 0.0
     done: bool = False
 
     @property
@@ -62,6 +66,7 @@ class PipelineStats:
         self.prep_s += other.prep_s
         self.wait_s += other.wait_s
         self.wall_s += other.wall_s
+        self.encode_s += other.encode_s
         return self
 
 

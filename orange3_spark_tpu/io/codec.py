@@ -1,0 +1,236 @@
+"""Compressed device-resident chunk codec — the cache-precision subsystem.
+
+The replay wall is HBM bandwidth (BENCH_r05: ``device_hbm_gbps_est``
+dominates ``pure_step_ms``), and both the ``_DeviceCache`` fusion gate and
+the disk spill priced every chunk at padded **f32** — so datasets fell off
+the fused-replay cliff at half the rows they needed to. This module owns
+the storage-side fix, the mixed-precision pattern standard in large-scale
+training input pipelines: cache/spill/transfer chunks COMPRESSED and widen
+them inside the jitted step (a cheap decode XLA fuses into the consumer),
+so HBM, disk and the h2d DMA all move ~2x fewer bytes while the math stays
+f32.
+
+Three cache dtypes, resolved ONCE at fit entry (the ``OTPU_SPARSE_UPDATE``
+convention — the resolution is a static jit argument, never the env var):
+
+* ``'f32'``    — the legacy layout, bit-for-bit. The kill-switch target.
+* ``'bf16'``   — dense float features stored bfloat16 (lossy, bounded:
+  round-to-nearest-even, relative error <= 2^-8); integer-carrying columns
+  (labels where exact, categorical codes) stay exact.
+* ``'packed'`` — bf16 floats PLUS lossless integer bit-packing: values with
+  a statically known range (hashed categorical indices bounded by
+  ``n_dims``, the sparse-optimizer plan arrays bounded by chunk/table
+  shape) are stored at their true bit width in a u32 carrier and unpacked
+  with static shifts/masks in-jit.
+
+Layering: this module knows nothing about chunk layouts or models — it
+provides the primitives (bit packing, bf16 host encode) and the policy
+resolver; ``models/hashed_linear`` and ``io/streaming`` own their layouts.
+
+Bit-packing layouts (both decode with STATIC shift/mask ops — no gathers):
+
+* per-row: ``[N, C]`` values at ``b`` bits -> ``[N, ceil(C*b/32)]`` u32.
+  Row-aligned, so the packed array row-shards exactly like the raw one.
+* 32-group (flat): ``[n]`` values at ``b`` bits -> ``[ceil(n/32), b]`` u32
+  — 32 b-bit values fill exactly b words, zero padding waste. Used for the
+  (replicated) plan arrays. ``b = 1`` packs a bit array 32x.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+__all__ = [
+    "CACHE_DTYPES", "BF16", "resolve_cache_dtype", "force_cache_dtype",
+    "bit_width", "pack_rows_np", "unpack_rows", "pack_flat_np",
+    "unpack_flat",
+]
+
+CACHE_DTYPES = ("f32", "bf16", "packed")
+
+#: the host-side bfloat16 dtype (numpy has none; jax ships ml_dtypes).
+#: ``np.astype(BF16)`` rounds to nearest even — identical to the device's
+#: ``astype(jnp.bfloat16)``, so host-encoded chunks decode the same bits.
+BF16 = ml_dtypes.bfloat16
+
+
+def resolve_cache_dtype(value: str, session=None) -> str:
+    """The concrete cache dtype for this fit — THE one resolver, applied
+    ONCE at fit entry so the resolved value is a static jit argument.
+
+    ``OTPU_CACHE_DTYPE`` (the kill-switch, read per resolution) overrides
+    the param when set: ``=f32`` restores the legacy cache exactly whatever
+    the caller asked for; ``=bf16``/``=packed`` force a mode (the bench
+    sweep's lever). ``'auto'`` resolves to the session policy knob
+    ``TpuSession.default_cache_dtype`` ('packed' — full compression)."""
+    env = os.environ.get("OTPU_CACHE_DTYPE", "")
+    if env:
+        value = env
+    if value == "auto":
+        if session is None:
+            from orange3_spark_tpu.core.session import TpuSession
+
+            session = TpuSession.active()
+        value = session.default_cache_dtype
+    if value not in CACHE_DTYPES:
+        raise ValueError(
+            f"cache_dtype must be one of {CACHE_DTYPES} or 'auto', "
+            f"got {value!r}"
+        )
+    return value
+
+
+@contextlib.contextmanager
+def force_cache_dtype(value: str):
+    """Pin the resolver for one bench arm. The env kill-switch outranks
+    the param BY DESIGN (so ``OTPU_CACHE_DTYPE=f32`` restores the legacy
+    cache whatever a caller hard-coded), which means A/B sweeps must pin
+    arms through the same lever — this scopes it and restores the
+    ambient value afterwards."""
+    old = os.environ.get("OTPU_CACHE_DTYPE")
+    os.environ["OTPU_CACHE_DTYPE"] = value
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop("OTPU_CACHE_DTYPE", None)
+        else:
+            os.environ["OTPU_CACHE_DTYPE"] = old
+
+
+def bit_width(n_values: int) -> int:
+    """Bits needed to hold values ``0 .. n_values-1`` (at least 1)."""
+    return max(1, int(n_values - 1).bit_length())
+
+
+def _check_bits(bits: int) -> np.uint32:
+    if not 1 <= bits <= 31:
+        raise ValueError(f"pack bit width must be in [1, 31], got {bits}")
+    return np.uint32((1 << bits) - 1)
+
+
+def pack_rows_np(vals: np.ndarray, bits: int) -> np.ndarray:
+    """Host-side per-row pack: ``[N, C]`` unsigned values at ``bits`` bits
+    each -> ``[N, ceil(C*bits/32)]`` u32 words. Values must already be in
+    range (high bits are masked off, silently — callers pack statically
+    bounded quantities)."""
+    mask = _check_bits(bits)
+    vals = np.asarray(vals).astype(np.uint32) & mask
+    N, C = vals.shape
+    W = -(-(C * bits) // 32)
+    words = np.zeros((N, W), np.uint32)
+    for c in range(C):
+        bitpos = c * bits
+        w0, off = bitpos // 32, bitpos % 32
+        v = vals[:, c]
+        words[:, w0] |= v << np.uint32(off)
+        if off + bits > 32:
+            words[:, w0 + 1] |= v >> np.uint32(32 - off)
+    return words
+
+
+def unpack_rows(packed, bits: int, n_cols: int):
+    """In-jit inverse of ``pack_rows_np``: ``[N, W]`` u32 -> ``[N, n_cols]``
+    i32. Every word index / shift / mask is STATIC, so the decode lowers to
+    a handful of vectorized integer ops XLA fuses into the consumer (the
+    embedding gather) — no gathers, no dynamic indexing."""
+    mask = _check_bits(bits)
+    cols = []
+    for c in range(n_cols):
+        bitpos = c * bits
+        w0, off = bitpos // 32, bitpos % 32
+        v = packed[:, w0] >> np.uint32(off)
+        if off + bits > 32:
+            v = v | (packed[:, w0 + 1] << np.uint32(32 - off))
+        cols.append((v & mask).astype(jnp.int32))
+    if not cols:
+        return jnp.zeros((packed.shape[0], 0), jnp.int32)
+    return jnp.stack(cols, axis=1)
+
+
+def _planes(bits: int) -> tuple:
+    """Decomposition of a bit width into word-divisor plane widths
+    (16/8/4/2/1) — e.g. 18 -> (16, 2), 23 -> (16, 8). Within a plane
+    every field sits wholly inside one u32 word, so the decode is a
+    single broadcast shift+mask+reshape per plane: no cross-word
+    combines, no gathers, no 32-way stacks (the naive sequential-bit
+    layout decoded at ~60 ns/value on XLA:CPU — a stack of 32 strided
+    extracts; planes decode in a handful of dense vectorized passes).
+
+    Each plane costs a full pass over the data at decode, so FEWER planes
+    beat exact bit counts: widths may round UP by at most 2 bits when
+    that removes a plane (23 stores as 16+8=24 — one pass saved for a
+    4% size cost — while 9 stays 8+1: rounding to 16 would waste 7)."""
+    best = None
+    for m in range(32):                       # subsets of {16, 8, 4, 2, 1}
+        sizes = tuple(s for i, s in enumerate((16, 8, 4, 2, 1))
+                      if m & (1 << i))
+        total = sum(sizes)
+        if bits <= total <= bits + 2:
+            key = (len(sizes), total)
+            if best is None or key < best[0]:
+                best = (key, sizes)
+    return best[1]
+
+
+def pack_flat_np(vals: np.ndarray, bits: int) -> np.ndarray:
+    """Host-side flat pack: ``[n]`` unsigned values at ``bits`` bits each
+    -> ``[ceil(n/32) * bits]`` u32 — exact bit count, zero waste. The
+    value's bits split across the ``_planes`` sub-arrays, concatenated:
+    plane of width s holds 32/s consecutive values' s-bit fields per
+    word. ``bits=1`` is the bit-array case (32x)."""
+    mask = _check_bits(bits)
+    vals = np.asarray(vals).astype(np.uint32) & mask
+    n = vals.shape[0]
+    B = -(-n // 32) if n else 0
+    n_pad = B * 32
+    if n_pad != n:
+        vals = np.concatenate([vals, np.zeros(n_pad - n, np.uint32)])
+    parts = []
+    bit_ofs = 0
+    for s in _planes(bits):
+        k = 32 // s
+        f = ((vals >> np.uint32(bit_ofs))
+             & np.uint32((1 << s) - 1)).reshape(-1, k)
+        w = np.zeros(f.shape[0], np.uint32)
+        for pos in range(k):
+            w |= f[:, pos] << np.uint32(pos * s)
+        parts.append(w)
+        bit_ofs += s
+    if not parts:
+        return np.zeros((0,), np.uint32)
+    return np.concatenate(parts)
+
+
+def flat_words(n: int, bits: int) -> int:
+    """u32 words ``pack_flat_np`` emits for ``n`` values at ``bits`` bits
+    (the plane decomposition may round the stored width up slightly)."""
+    return -(-n // 32) * sum(_planes(bits))
+
+
+def unpack_flat(packed, bits: int, n: int):
+    """In-jit inverse of ``pack_flat_np``: ``[flat_words(n, bits)]`` u32
+    -> ``[n]`` i32. One broadcast shift + mask + reshape per plane, OR-ed
+    into the accumulator — fully dense vectorized ops (see ``_planes``)."""
+    _check_bits(bits)
+    planes = _planes(bits)
+    n_pad = (packed.shape[0] // sum(planes)) * 32
+    acc = None
+    word_ofs = 0
+    bit_ofs = 0
+    for s in planes:
+        k = 32 // s
+        nw = n_pad // k
+        w = packed[word_ofs:word_ofs + nw]
+        shifts = (jnp.arange(k, dtype=jnp.uint32) * np.uint32(s))[None, :]
+        f = (w[:, None] >> shifts) & np.uint32((1 << s) - 1)
+        part = f.reshape(n_pad) << np.uint32(bit_ofs)
+        acc = part if acc is None else acc | part
+        word_ofs += nw
+        bit_ofs += s
+    return acc[:n].astype(jnp.int32)

@@ -569,50 +569,100 @@ class StreamingLinearParams(Params):
     # is preserved by clamping groups at snapshot boundaries
     # (run_epoch_replay). Ignored under granularity 'all'.
     epochs_per_dispatch: int = 1
+    # Cache/spill storage precision (io/codec.py; resolved ONCE at fit
+    # entry, OTPU_CACHE_DTYPE kill-switch): 'f32' is the legacy layout,
+    # bit-for-bit; 'bf16' stores the cached/spilled feature matrix as
+    # bfloat16 — HALF the HBM/disk/DMA bytes, decoded by the step's
+    # existing astype(compute_dtype) widen (models/_linear._make_objective)
+    # so the math stays f32. The dense path has no statically-bounded
+    # integer columns, so 'packed'/'auto' resolve to bf16 here; the full
+    # packed-int codec lives on the hashed estimator.
+    cache_dtype: str = "f32"     # 'f32' | 'bf16' | 'packed' | 'auto'
 
 
 class _DeviceCache:
     """Epoch-1 HBM batch cache shared by the streaming estimators — one
     place for the budget/degrade rule: batches accumulate until ``budget``
-    bytes, after which the WHOLE cache drops and the fit degrades to pure
-    streaming (a partial replay would reorder/double-count batches).
-    ``batches`` is a plain list the owner may filter (holdout exclusion);
-    ``degraded`` stays True after an overflow so owners can warn/spill."""
+    bytes. With ``may_exclude_tail > 0`` (an owner that excludes that
+    many TRAILING batches after ingest — the hashed estimator's holdout
+    tail), a batch that would overflow is NOT cached — and neither is any later
+    batch, so misses form a contiguous SUFFIX of the offer sequence (the
+    cached list must stay a gap-free prefix of the stream, or replay
+    would reorder it) — and the run is provisionally ``degraded``.
+    ``forgive_tail(k)`` (called alongside the holdout ``exclude()``)
+    clears the misses when they all sit inside the excluded last-k-offers
+    window, so a tail that was never going to be replayed no longer
+    degrades the run (previously ONE transient overflow latched
+    ``degraded`` forever and dropped everything). Misses are tracked by
+    OFFER ORDINAL, never by object identity — a missed batch is dead by
+    exclusion time and CPython recycles ids, so an id match there could
+    silently bless an incomplete cache. ``settle()``, called once ingest
+    + exclusion are done, finalizes: a surviving miss drops the WHOLE
+    cache — a PARTIAL replay would reorder/double-count batches, which
+    is why ``enabled`` can never un-latch past a real (non-forgiven)
+    miss. A miss older than the excludable tail can never be forgiven,
+    so the cache drops THE MOMENT a miss ages out of the window (and
+    immediately when ``may_exclude_tail == 0``) — the latch — freeing
+    the HBM for the rest of the ingest pass instead of pinning a doomed
+    budget's worth until settle."""
 
-    def __init__(self, enabled: bool, budget: int):
+    def __init__(self, enabled: bool, budget: int, *,
+                 may_exclude_tail: int = 0):
         self.enabled = enabled
         self.budget = budget
+        self.may_exclude_tail = may_exclude_tail
         self.batches: list = []
         self.nbytes = 0
         self.degraded = False
+        self.offered = 0           # total offer() calls
+        self.first_miss: int | None = None   # ordinal of the first miss
 
     def offer(self, batch: tuple) -> None:
         if not self.enabled:
             return
+        self.offered += 1
         sz = self._size(batch)
-        if self.nbytes + sz <= self.budget:
+        if self.first_miss is None and self.nbytes + sz <= self.budget:
             self.batches.append(batch)
             self.nbytes += sz
         else:
-            self.enabled = False
+            if self.first_miss is None:
+                self.first_miss = self.offered - 1
             self.degraded = True
-            self.batches = []
-            self.nbytes = 0  # honest accounting for any downstream gate
+            if self.offered - self.first_miss > self.may_exclude_tail:
+                # the miss can no longer sit inside the excludable tail:
+                # no forgiveness is possible — drop NOW, legacy-style
+                self.enabled = False
+                self.batches = []
+                self.nbytes = 0  # honest accounting for downstream gates
+                self.first_miss = None
+
+    def forgive_tail(self, k: int) -> None:
+        """The last ``k`` offers were excluded from training (holdout):
+        misses wholly inside that tail never needed replaying — clear the
+        warn state. A miss that starts EARLIER is a real train-chunk gap
+        and stays latched for ``settle()`` to resolve."""
+        if self.first_miss is not None and self.first_miss >= self.offered - k:
+            self.first_miss = None
+            self.degraded = False
 
     @staticmethod
     def _size(batch: tuple) -> int:
         # tree-flatten, not a flat scan: hashed sparse-plan batches carry
-        # a DICT of plan arrays as their 5th element, and skipping it
-        # would under-count the budget the replay-fusion gate reads
+        # a DICT of plan arrays as their 5th element (and compressed
+        # chunks a dict of encoded blocks as their 1st), and skipping
+        # them would under-count the budget the replay-fusion gate reads
         import jax
 
         return sum(b.nbytes for b in jax.tree.leaves(batch)
                    if hasattr(b, "nbytes"))
 
     def exclude(self, drop_ids: set) -> None:
-        """Remove batches whose FIRST element's id() is in ``drop_ids``,
-        keeping ``nbytes`` accurate (holdout exclusion must not leave the
-        budget accounting stale — downstream gates read nbytes)."""
+        """Remove CACHED batches whose FIRST element's id() is in
+        ``drop_ids`` (these are alive in the caller's hands, so identity
+        is sound here), keeping ``nbytes`` accurate — holdout exclusion
+        must not leave the budget accounting stale, downstream gates read
+        nbytes. Miss forgiveness is ``forgive_tail``'s job."""
         kept = []
         for b in self.batches:
             if id(b[0]) in drop_ids:
@@ -621,42 +671,200 @@ class _DeviceCache:
                 kept.append(b)
         self.batches = kept
 
+    def settle(self) -> None:
+        """End-of-ingest resolution: a cache still missing batches cannot
+        replay (partial replay reorders/double-counts), so it drops whole
+        — freeing the HBM for whatever replay path the owner falls back
+        to — and stays ``degraded``; a complete cache stays live."""
+        if self.first_miss is not None:
+            self.enabled = False
+            self.degraded = True
+            self.batches = []
+            self.nbytes = 0
+            self.first_miss = None
+
+
+def _spill_cleanup(f, path: str, named: list) -> None:
+    """Module-level so ``weakref.finalize`` holds no reference to the
+    cache object: close the fd (frees the unlinked inode) and, for a
+    named (``keep_file=True``) spill an aborted fit left behind, unlink
+    the file — the spill-dir hygiene guarantee."""
+    try:
+        f.close()
+    except Exception:  # noqa: BLE001 - cleanup must never raise
+        pass
+    if named and named[0]:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
 
 class DiskChunkCache:
-    """Epoch-1 on-disk spill of PADDED f32 chunks — the 1B-row overflow
-    path. When a many-epoch streaming fit outgrows the HBM chunk cache,
-    every later epoch would otherwise re-run the source, i.e. re-PARSE the
-    CSV (at 1B rows x 100 epochs: hours of single-core parse per fit).
-    This cache writes each already-padded chunk once, sequentially, on the
+    """Epoch-1 on-disk spill of padded chunks — the 1B-row overflow path.
+    When a many-epoch streaming fit outgrows the HBM chunk cache, every
+    later epoch would otherwise re-run the source, i.e. re-PARSE the CSV
+    (at 1B rows x 100 epochs: hours of single-core parse per fit). This
+    cache writes each already-padded chunk once, sequentially, on the
     prefetch thread during epoch 1 (overlapping device steps), and replays
     epochs 2+ at disk/page-cache bandwidth — the fixed-shape records need
     zero parsing, just a read + DMA.
 
-    Layout: one flat little-endian f32 file; record i = the chunk's arrays
-    concatenated in declaration order (shapes fixed at construction), plus
-    a host-side list of live-row counts. Single writer (the prefetch
-    thread), then ``finalize()`` flips it to a read-only memmap. The file
-    is unlinked the moment it is opened (POSIX anonymous-file idiom): the
-    fd and memmap stay valid, and a crashed fit can never leak a
-    dataset-sized spill on disk."""
+    Format (version 1, self-describing): an ``OTPUSPL1`` magic + JSON
+    header (shapes + dtypes, 8-byte padded), then fixed-size records —
+    each a little-endian u32 live-row count followed by the fields'
+    raw bytes in declaration order, every field 8-byte aligned. ``dtypes``
+    defaults to all-f32 (the legacy layout); the cache-codec path stores
+    bf16 / u8 / bit-packed-u32 fields directly, so spill I/O shrinks with
+    the cache (io/codec.py). Headerless flat-f32 files — the pre-header
+    format (version 0) — remain readable through :meth:`attach`, which
+    sniffs the magic and falls back to caller-supplied shapes.
 
-    def __init__(self, dir_path: str, shapes: tuple):
+    Single writer (the prefetch thread), then ``finalize()`` flips it to a
+    read-only memmap. By default the file is unlinked the moment it is
+    opened (POSIX anonymous-file idiom): fd and memmap stay valid and a
+    crashed fit can never leak a dataset-sized spill on disk. Either way a
+    ``weakref.finalize`` closes the fd (and unlinks a ``keep_file=True``
+    spill) when the object dies without ``delete()`` — an aborted fit
+    (exception mid-epoch-1) leaks neither the inode nor a named file."""
+
+    MAGIC = b"OTPUSPL1"
+
+    def __init__(self, dir_path: str, shapes: tuple, dtypes: tuple | None = None,
+                 *, keep_file: bool = False):
+        import json as _json
+        import struct
+        import weakref
+
         self.shapes = [tuple(s) for s in shapes]
-        self.sizes = [int(np.prod(s)) for s in self.shapes]
-        self.record_floats = sum(self.sizes)
+        self.dtypes = ([np.dtype(np.float32)] * len(self.shapes)
+                       if dtypes is None
+                       else [np.dtype(d) for d in dtypes])
+        if len(self.dtypes) != len(self.shapes):
+            raise ValueError("one dtype per field")
+        self._init_layout()
         os.makedirs(dir_path, exist_ok=True)
-        self.path = os.path.join(dir_path, f"spill_{uuid.uuid4().hex}.f32")
+        self.path = os.path.join(dir_path, f"spill_{uuid.uuid4().hex}.otpu")
         self._f: object | None = open(self.path, "w+b")
-        os.unlink(self.path)
+        header = _json.dumps({
+            "version": 1,
+            "shapes": self.shapes,
+            "dtypes": [dt.name for dt in self.dtypes],
+        }).encode()
+        head = self.MAGIC + struct.pack("<I", len(header)) + header
+        head += b"\0" * (-len(head) % 8)
+        self._f.write(head)
+        self._data_start = len(head)
+        self._named = [bool(keep_file)]
+        if not keep_file:
+            os.unlink(self.path)
+        self._finalizer = weakref.finalize(
+            self, _spill_cleanup, self._f, self.path, self._named)
         self.n_valid: list[int] = []
         self._mm: np.memmap | None = None
 
+    def _init_layout(self) -> None:
+        """Record layout: u32 n_valid (+4 pad), then each field at the next
+        8-aligned offset — alignment keeps the read-side dtype views (and
+        the DMA they feed) on natural boundaries."""
+        self._field_bytes = [int(np.prod(s)) * dt.itemsize
+                             for s, dt in zip(self.shapes, self.dtypes)]
+        #: bytes of one record's ARRAYS — what a device_put of the record
+        #: costs in HBM (record_bytes adds the n_valid word + alignment,
+        #: an on-disk detail no memory gate should price)
+        self.payload_bytes = sum(self._field_bytes)
+        self._offsets, ofs = [], 8
+        for nb in self._field_bytes:
+            self._offsets.append(ofs)
+            ofs += -(-nb // 8) * 8
+        self.record_bytes = ofs
+
+    @classmethod
+    def attach(cls, path: str, shapes: tuple | None = None,
+               dtypes: tuple | None = None) -> "DiskChunkCache":
+        """Open an EXISTING spill file read-only. Version-1 files are
+        self-describing; headerless files are the legacy flat-f32 format
+        (version 0: records = fields' f32 bytes back to back, no stored
+        live-row counts) and need the caller's ``shapes`` — their
+        ``n_valid`` reads as the full padded row count."""
+        import json as _json
+        import struct
+
+        import weakref
+
+        obj = cls.__new__(cls)
+        obj._f = open(path, "rb")
+        obj.path = path
+        obj._named = [False]       # attach never owns/removes the file
+        obj._finalizer = weakref.finalize(
+            obj, _spill_cleanup, obj._f, path, obj._named)
+        obj._mm = None
+        magic = obj._f.read(len(cls.MAGIC))
+        if magic == cls.MAGIC:
+            (hlen,) = struct.unpack("<I", obj._f.read(4))
+            layout = _json.loads(obj._f.read(hlen))
+            obj.shapes = [tuple(s) for s in layout["shapes"]]
+            # bfloat16 etc. resolve through ml_dtypes-registered names
+            from orange3_spark_tpu.io.codec import BF16
+
+            obj.dtypes = [np.dtype(BF16) if d == "bfloat16" else np.dtype(d)
+                          for d in layout["dtypes"]]
+            obj._init_layout()
+            head = len(cls.MAGIC) + 4 + hlen
+            obj._data_start = head + (-head % 8)
+            obj._version = 1
+        else:
+            if shapes is None:
+                raise ValueError(
+                    "headerless (version-0) spill files need shapes=")
+            obj.shapes = [tuple(s) for s in shapes]
+            obj.dtypes = ([np.dtype(np.float32)] * len(obj.shapes)
+                          if dtypes is None
+                          else [np.dtype(d) for d in dtypes])
+            obj._field_bytes = [int(np.prod(s)) * dt.itemsize
+                                for s, dt in zip(obj.shapes, obj.dtypes)]
+            obj.payload_bytes = sum(obj._field_bytes)
+            obj._offsets, ofs = [], 0
+            for nb in obj._field_bytes:
+                obj._offsets.append(ofs)
+                ofs += nb
+            obj.record_bytes = ofs
+            obj._data_start = 0
+            obj._version = 0
+        n_bytes = os.path.getsize(path) - obj._data_start
+        n_rec = n_bytes // obj.record_bytes if obj.record_bytes else 0
+        obj._mm = np.memmap(obj._f, dtype=np.uint8, mode="r",
+                            offset=obj._data_start,
+                            shape=(n_rec, obj.record_bytes))
+        if obj._version == 1:
+            import struct as _s
+
+            obj.n_valid = [
+                _s.unpack_from("<I", obj._mm[i, :4].tobytes())[0]
+                for i in range(n_rec)
+            ]
+        else:
+            obj.n_valid = [obj.shapes[0][0]] * n_rec
+        return obj
+
     def append(self, arrays: tuple, n_valid: int) -> None:
-        for a, shape in zip(arrays, self.shapes):
-            a = np.ascontiguousarray(a, dtype=np.float32)
+        import struct
+
+        self._f.write(struct.pack("<Ixxxx", int(n_valid)))
+        written = 8
+        for a, shape, dt, ofs, nb in zip(arrays, self.shapes, self.dtypes,
+                                         self._offsets, self._field_bytes):
+            a = np.ascontiguousarray(a, dtype=dt)
             if a.shape != shape:
                 raise ValueError(f"spill record shape {a.shape} != {shape}")
+            pad = ofs - written
+            if pad:
+                self._f.write(b"\0" * pad)
             a.tofile(self._f)
+            written = ofs + nb
+        tail = self.record_bytes - written
+        if tail:
+            self._f.write(b"\0" * tail)
         self.n_valid.append(int(n_valid))
 
     @property
@@ -666,26 +874,31 @@ class DiskChunkCache:
     def finalize(self) -> None:
         if self._mm is None and self._f is not None and self.n_valid:
             self._f.flush()
-            self._mm = np.memmap(self._f, dtype=np.float32, mode="r",
-                                 shape=(self.n_records, self.record_floats))
+            self._mm = np.memmap(self._f, dtype=np.uint8, mode="r",
+                                 offset=self._data_start,
+                                 shape=(self.n_records, self.record_bytes))
 
     def read(self, i: int) -> tuple[tuple, int]:
-        """Record i as array views into the memmap (the device_put reads
-        pages straight out of it — no intermediate host copy)."""
+        """Record i as typed array views into the memmap (the device_put
+        reads pages straight out of it — no intermediate host copy)."""
         rec = self._mm[i]
-        out, ofs = [], 0
-        for shape, size in zip(self.shapes, self.sizes):
-            out.append(rec[ofs:ofs + size].reshape(shape))
-            ofs += size
+        out = []
+        for shape, dt, ofs, nb in zip(self.shapes, self.dtypes,
+                                      self._offsets, self._field_bytes):
+            out.append(rec[ofs:ofs + nb].view(dt).reshape(shape))
         return tuple(out), self.n_valid[i]
 
     def delete(self) -> None:
-        """Release the backing storage (the unlinked inode frees itself
-        once the fd and memmap close)."""
+        """Release the backing storage (closes the fd; a ``keep_file``
+        spill's named file is unlinked here or, failing that, by the
+        finalizer/atexit path)."""
         self._mm = None
         if self._f is not None:
-            self._f.close()
-            self._f = None
+            f, self._f = self._f, None
+            if self._finalizer is not None:
+                self._finalizer()   # close + unlink-if-named, exactly once
+            else:
+                f.close()
 
 
 def warn_cache_overflow(cache_device_bytes: int, epochs_left: int,
@@ -1083,6 +1296,9 @@ class StreamingKMeans(Estimator):
             if epoch == 0:
                 if spill is not None:
                     spill.finalize()
+                # no excludable tail here: an over-budget offer already
+                # latched the degrade at the overflow point (the hashed
+                # estimator's holdout un-latch doesn't apply)
                 if cache.degraded and (p.epochs > 1 or defer):
                     use_disk = spill is not None and spill.n_records > 0
                     if not use_disk:
@@ -1225,12 +1441,22 @@ class StreamingLinearEstimator(Estimator):
         n_replay = p.epochs - 1 + (1 if defer else 0)
         cache = _DeviceCache(cache_device and (p.epochs > 1 or defer),
                              cache_device_bytes)
+        # cache precision (io/codec.py), resolved once at fit entry: bf16
+        # halves the cached/spilled/DMA'd X bytes; the step widens it back
+        # via the objective's astype (in-scan decode). 'f32' = the legacy
+        # path, bit-for-bit; 'packed' has no integer columns to pack here
+        # and behaves as bf16.
+        from orange3_spark_tpu.io.codec import BF16, resolve_cache_dtype
+
+        cache_bf16 = resolve_cache_dtype(p.cache_dtype, session) != "f32"
+        x_store = np.dtype(BF16) if cache_bf16 else np.dtype(np.float32)
         spill: DiskChunkCache | None = None
         if (cache_device and cache_spill_dir is not None
                 and (p.epochs > 1 or defer)):
             spill = DiskChunkCache(
                 cache_spill_dir,
                 ((pad_rows, n_features), (pad_rows,), (pad_rows,)),
+                (x_store, np.float32, np.float32),
             )
         use_disk = False
 
@@ -1285,7 +1511,9 @@ class StreamingLinearEstimator(Estimator):
                     # and except a defer ingest pass: it contributes ZERO
                     # steps, so counting its chunks here would corrupt the
                     # resume offset (even after a mid-ingest cache
-                    # overflow, when cache.enabled has flipped off)
+                    # overflow, when cache.enabled has flipped off — this
+                    # estimator has no excludable tail, so a miss latches
+                    # at the offer exactly as before)
                     n_steps += 1
                     continue
                 # every device batch is EXACTLY pad_rows tall (last one padded
@@ -1299,6 +1527,8 @@ class StreamingLinearEstimator(Estimator):
                             "true class count"
                         )
                 Xp, yp, wp = _pad_chunk(X_np, y_np, w_np, pad_rows, n_features)
+                if cache_bf16:
+                    Xp = Xp.astype(x_store)   # encode once: spill AND HBM
                 if epoch == 0 and spill is not None:
                     # live PRE-pad rows (the DiskChunkCache contract);
                     # replay neutralizes padding via w=0 either way
@@ -1317,6 +1547,9 @@ class StreamingLinearEstimator(Estimator):
             if epoch == 0:
                 if spill is not None:
                     spill.finalize()
+                # no excludable tail here: an over-budget offer already
+                # latched the degrade at the overflow point (the hashed
+                # estimator's holdout un-latch doesn't apply)
                 if cache.degraded and (p.epochs > 1 or defer):
                     use_disk = spill is not None and spill.n_records > 0
                     if not use_disk:

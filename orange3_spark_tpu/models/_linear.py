@@ -226,6 +226,11 @@ def _make_objective(loss_kind: str, fit_intercept: bool, compute_dtype):
     def objective(theta, X, y, w, reg_l2, sum_w, col_scale):
         coef = theta["coef"]
         intercept = theta["intercept"]
+        # THE in-scan decode point for compressed caches (io/codec.py):
+        # a bf16-cached X widens here — one fused convert-on-load, so the
+        # streaming replay scan reads half the HBM/spill bytes while the
+        # matmul accumulates in f32 exactly as before (f32 input: no-op).
+        # tests/test_cache_codec.py pins the bf16-vs-f32 fit divergence.
         Xc = X.astype(compute_dtype)
         # fold per-column standardization into the coefficient side: X@(s*B)
         # keeps the [N,d] operand untouched (no scaled copy of the data ever

@@ -57,14 +57,20 @@ import optax
 from orange3_spark_tpu.core.session import TpuSession
 from orange3_spark_tpu.exec.donate import donating_jit
 from orange3_spark_tpu.exec.pipeline import PipelineStats
+from orange3_spark_tpu.io.codec import (
+    BF16, bit_width, pack_rows_np, resolve_cache_dtype, unpack_rows,
+)
 from orange3_spark_tpu.io.multihost import put_sharded
 from orange3_spark_tpu.models._linear import EPS_TOTAL_WEIGHT, per_row_loss
 from orange3_spark_tpu.models.base import Estimator, Model, Params
-from orange3_spark_tpu.ops.hashing import column_salts, hash_columns
+from orange3_spark_tpu.ops.hashing import (
+    column_salts, hash_columns, hash_columns_np,
+)
 from orange3_spark_tpu.optim.sparse import (
     build_plan_np, dense_update, finalize_lazy_decay, init_optim_state,
-    is_sparse_update, optim_kind, plan_field_shapes, resolve_optim_update,
-    resolve_sparse_lowering, sparse_embedding_update,
+    is_sparse_update, optim_kind, pack_plan_np, plan_field_shapes,
+    plan_packed_field_shapes, resolve_optim_update, resolve_sparse_lowering,
+    sparse_embedding_update, unpack_plan,
 )
 from orange3_spark_tpu.utils.dispatch import bound_dispatch
 from orange3_spark_tpu.utils.profiling import count_dispatch
@@ -162,6 +168,28 @@ class HashedLinearParams(Params):
     # passes NaN through for an upstream imputer to handle — a NaN
     # reaching the step then poisons the loss, visibly.
     missing: str = "zero"        # 'zero' | 'keep'
+    # Cache/spill storage precision (io/codec.py; resolved ONCE at fit
+    # entry via resolve_chunk_codec, OTPU_CACHE_DTYPE kill-switch —
+    # '=f32' restores the legacy cache exactly):
+    #   'f32'    legacy padded-f32 chunks, bit-for-bit.
+    #   'bf16'   dense numeric block stored bfloat16 (lossy, bounded:
+    #            RTNE, rel. err <= 2^-8); label stored u8 where exact
+    #            (classification losses); categorical codes stay f32.
+    #   'packed' bf16 PLUS lossless integer packing: categorical columns
+    #            pre-hash on the prefetch thread (the host hash twin is
+    #            pinned bit-identical to the device's) and store at
+    #            log2(n_dims) bits; the sparse 'plan' arrays bit-pack at
+    #            their static widths (optim/sparse.pack_plan_np). Decode
+    #            is static shifts/masks INSIDE the step — HBM, disk spill
+    #            and h2d DMA all move ~2x fewer bytes, and the cache/
+    #            fusion-gate capacity roughly doubles.
+    #   'auto'   the session policy knob (TpuSession.default_cache_dtype,
+    #            'packed').
+    # value_weighted fits keep 'f32' (explicit (idx, val) pairs carry
+    # their own -1/0 padding the codec must not re-encode), and 'packed'
+    # degrades to 'bf16' under missing='keep' (NaN codes must reach the
+    # in-jit hash to poison visibly — pre-hashing would hide them).
+    cache_dtype: str = "f32"     # 'f32' | 'bf16' | 'packed' | 'auto'
 
 
 def _effective_k(p: HashedLinearParams) -> int:
@@ -343,7 +371,7 @@ def _step_core(
     label_in_chunk: bool = False, emb_update: str = "fused",
     value_weighted: bool = False, impute_missing: bool = False,
     optim_update: str = "adam", sparse_lowering: str = "none",
-    use_decay: bool = False,
+    use_decay: bool = False, codec=None,
 ):
     """One optimizer step on one chunk — traced by both the per-chunk jit
     (`_hashed_step`) and the fused replay scan (`_hashed_replay_epochs`).
@@ -352,12 +380,27 @@ def _step_core(
     adam sweep over the whole table. Every other rule (optim/ subsystem)
     reports the pure data loss, treats reg as decoupled weight decay, and
     — for the sparse_* rules — updates only the touched rows, with ``plan``
-    carrying the host-presorted dedup under the 'plan' lowering."""
-    yv, dense, cats, wv, vals = _split_chunk(
-        Xall, n_valid, y, w, label_in_chunk=label_in_chunk, n_dense=n_dense,
-        value_weighted=value_weighted, impute_missing=impute_missing,
-    )
-    idx = hash_columns(cats, salts, n_dims)
+    carrying the host-presorted dedup under the 'plan' lowering.
+
+    codec (io/codec.py, resolved once at fit entry): None is the legacy
+    f32 chunk; otherwise ``Xall`` is the compressed block dict and the
+    decode (bf16 widen / static bit-unpack, fused by XLA) happens HERE, so
+    the replay scan reads compressed HBM bytes. A packed plan unpacks here
+    too — bit-exact, so the plan-lowering update is unchanged math."""
+    if codec is None:
+        yv, dense, cats, wv, vals = _split_chunk(
+            Xall, n_valid, y, w, label_in_chunk=label_in_chunk,
+            n_dense=n_dense, value_weighted=value_weighted,
+            impute_missing=impute_missing,
+        )
+        idx = hash_columns(cats, salts, n_dims)
+    else:
+        yv, dense, idx, wv = _decode_chunk(codec, Xall, n_valid, y, w, salts)
+        cats = None
+        vals = None
+        if plan is not None and codec.mode == "packed":
+            plan = unpack_plan(plan, Xall["cats"].shape[0], codec.n_cat,
+                               n_dims)
 
     if optim_update == "adam":
         def loss_fn(theta):
@@ -439,7 +482,7 @@ def _step_core(
 _STEP_STATICS = (
     "loss_kind", "n_dims", "n_dense", "compute_dtype", "label_in_chunk",
     "emb_update", "value_weighted", "impute_missing", "optim_update",
-    "sparse_lowering", "use_decay",
+    "sparse_lowering", "use_decay", "codec",
 )
 
 
@@ -451,7 +494,7 @@ def _hashed_step(
     label_in_chunk: bool = False, emb_update: str = "fused",
     value_weighted: bool = False, impute_missing: bool = False,
     optim_update: str = "adam", sparse_lowering: str = "none",
-    use_decay: bool = False,
+    use_decay: bool = False, codec=None,
 ):
     return _step_core(
         theta, opt_state, Xall, n_valid, y, w, salts, reg, lr, plan, l1,
@@ -459,7 +502,7 @@ def _hashed_step(
         compute_dtype=compute_dtype, label_in_chunk=label_in_chunk,
         emb_update=emb_update, value_weighted=value_weighted,
         impute_missing=impute_missing, optim_update=optim_update,
-        sparse_lowering=sparse_lowering, use_decay=use_decay,
+        sparse_lowering=sparse_lowering, use_decay=use_decay, codec=codec,
     )
 
 
@@ -471,7 +514,7 @@ def _hashed_replay_epochs(
     label_in_chunk: bool = False, emb_update: str = "fused",
     value_weighted: bool = False, impute_missing: bool = False,
     optim_update: str = "adam", sparse_lowering: str = "none",
-    use_decay: bool = False,
+    use_decay: bool = False, codec=None,
     n_epochs: int,
 ):
     """Epochs 2+ of a cached fit as ONE XLA program: an epoch-level scan
@@ -494,7 +537,8 @@ def _hashed_replay_epochs(
               compute_dtype=compute_dtype, label_in_chunk=label_in_chunk,
               emb_update=emb_update, value_weighted=value_weighted,
               impute_missing=impute_missing, optim_update=optim_update,
-              sparse_lowering=sparse_lowering, use_decay=use_decay)
+              sparse_lowering=sparse_lowering, use_decay=use_decay,
+              codec=codec)
 
     def chunk_body(carry, xs):
         theta, opt = carry
@@ -534,23 +578,31 @@ def _hashed_predict(theta, Xall, salts, *, n_dims: int, n_dense: int,
 @partial(
     jax.jit,
     static_argnames=("loss_kind", "n_dims", "n_dense", "label_in_chunk",
-                     "value_weighted", "impute_missing"),
+                     "value_weighted", "impute_missing", "codec"),
 )
 def _hashed_eval_chunk(
     theta, Xall, n_valid, y, w, salts,
     *, loss_kind: str, n_dims: int, n_dense: int, label_in_chunk: bool,
-    value_weighted: bool = False, impute_missing: bool = False,
+    value_weighted: bool = False, impute_missing: bool = False, codec=None,
 ):
     """Device-side eval accumulators for one chunk: (weighted logloss sum,
     weighted correct sum, weight sum, pos/neg score histograms for AUC).
     Nothing but these small arrays ever crosses back to the host — device->
-    host bandwidth is the scarcest resource in the whole pipeline."""
-    yv, dense, cats, wv, vals = _split_chunk(
-        Xall, n_valid, y, w, label_in_chunk=label_in_chunk, n_dense=n_dense,
-        value_weighted=value_weighted, impute_missing=impute_missing,
-    )
-    idx = hash_columns(cats, salts, n_dims)
-    logits = _hashed_logits(theta, dense, idx, jnp.float32, vals=vals)
+    host bandwidth is the scarcest resource in the whole pipeline.
+    ``codec``: the fit's cache codec when evaluating compressed cached
+    chunks (decode-in-jit, same contract as the step)."""
+    if codec is None:
+        yv, dense, cats, wv, vals = _split_chunk(
+            Xall, n_valid, y, w, label_in_chunk=label_in_chunk,
+            n_dense=n_dense, value_weighted=value_weighted,
+            impute_missing=impute_missing,
+        )
+        idx = hash_columns(cats, salts, n_dims)
+        vals_arg = vals
+    else:
+        yv, dense, idx, wv = _decode_chunk(codec, Xall, n_valid, y, w, salts)
+        vals_arg = None
+    logits = _hashed_logits(theta, dense, idx, jnp.float32, vals=vals_arg)
     row = per_row_loss(loss_kind, logits, yv)
     loss_sum = jnp.sum(row * wv)
     if loss_kind == "binary_logistic":
@@ -589,6 +641,9 @@ class HashedLinearModel(Model):
         self.class_values = tuple(class_values) if class_values else None
         self.n_steps_: int | None = None
         self.final_loss_: float | None = None
+        # the cache codec of the producing fit (None = raw f32 chunks):
+        # evaluate_device's default decode key for device_chunks_
+        self.cache_codec_ = None
 
     @property
     def state_pytree(self):
@@ -689,12 +744,18 @@ class HashedLinearModel(Model):
             out["auc"] = auc
         return out
 
-    def evaluate_device(self, device_chunks) -> dict:
+    def evaluate_device(self, device_chunks, *, codec="auto") -> dict:
         """Evaluate over device-resident chunks (as cached/returned by
         ``fit_stream(..., cache_device=True)``: (Xall, n_valid, y, w)
-        tuples). All reduction happens on device; only five small arrays
-        come home at the END — no per-chunk device->host round trips."""
+        tuples — ``Xall`` is the compressed block dict when the fit cached
+        compressed, see ``cache_dtype``). All reduction happens on device;
+        only five small arrays come home at the END — no per-chunk
+        device->host round trips. ``codec='auto'`` reads the codec the
+        producing fit recorded on this model (``cache_codec_``); pass
+        ``None`` explicitly for raw f32 chunks built by hand."""
         p = self.params
+        if codec == "auto":
+            codec = getattr(self, "cache_codec_", None)
         salts = jnp.asarray(self.salts)
         kind = _row_loss_kind(p)
         tot = None
@@ -708,7 +769,7 @@ class HashedLinearModel(Model):
                 loss_kind=kind, n_dims=p.n_dims, n_dense=p.n_dense,
                 label_in_chunk=p.label_in_chunk,
                 value_weighted=p.value_weighted,
-                impute_missing=_impute_flag(p),
+                impute_missing=_impute_flag(p), codec=codec,
             )
             tot = out if tot is None else tuple(
                 a + b for a, b in zip(tot, out)
@@ -731,35 +792,251 @@ class HashedLinearModel(Model):
 
 
 #: spill serialization order of the touched-row plan's arrays ('val' only
-#: in value-weighted mode) — the one ordering _plan_f32_views and
-#: _plan_from_f32 share with the DiskChunkCache record layout
+#: in value-weighted mode) — shared with the DiskChunkCache record layout
 _PLAN_ORDER = ("row", "seg", "uniq", "inv", "val")
+#: spill order of the PACKED plan's u32 carriers (cache_dtype='packed')
+_PLAN_PACKED_ORDER = ("rowp", "segb", "uniqp", "invp")
 
 
-def _plan_f32_views(plan: dict) -> tuple:
-    """Plan arrays as f32 VIEWS (bit-preserving reinterpretation) in
-    ``_PLAN_ORDER`` — the disk spill stores flat f32 records, and every
-    plan array is 4-byte, so a view round-trips losslessly."""
-    return tuple(
-        np.ascontiguousarray(plan[k]).view(np.float32)
-        for k in _PLAN_ORDER if k in plan
+@dataclasses.dataclass(frozen=True)
+class _ChunkCodec:
+    """STATIC description of a fit's compressed chunk layout — a hashable
+    jit argument resolved once at fit entry (``resolve_chunk_codec``), so
+    the compile cache is keyed on the resolution, never on the env var.
+    ``None`` stands for the legacy f32 layout everywhere."""
+
+    mode: str             # 'bf16' | 'packed'
+    label_in_chunk: bool
+    n_dense: int
+    n_cat: int
+    n_dims: int
+    label_u8: bool        # classification labels stored u8 (exact)
+    impute: bool          # NaN -> 0 semantics live in the decode
+
+    @property
+    def idx_bits(self) -> int:
+        return bit_width(self.n_dims)
+
+    @property
+    def cat_words(self) -> int:
+        return -(-(self.n_cat * self.idx_bits) // 32)
+
+
+def resolve_chunk_codec(p: HashedLinearParams,
+                        session: TpuSession | None = None):
+    """The concrete cache codec for this fit — THE one resolver (the
+    ``resolve_optim_update`` convention; ``OTPU_CACHE_DTYPE=f32`` is the
+    kill-switch back to the legacy layout). Returns ``None`` for f32."""
+    mode = resolve_cache_dtype(p.cache_dtype, session)
+    if mode == "f32" or p.value_weighted:
+        # vw chunks are explicit (idx, val) PAIRS with their own -1/0
+        # padding convention — kept f32 (see the Params docstring)
+        return None
+    impute = _impute_flag(p)
+    if mode == "packed" and not impute and p.n_cat:
+        # missing='keep': NaN codes must reach the in-jit hash and poison
+        # visibly; pre-hash packing would silently launder them
+        mode = "bf16"
+    kind = _row_loss_kind(p)
+    return _ChunkCodec(
+        mode=mode, label_in_chunk=p.label_in_chunk, n_dense=p.n_dense,
+        n_cat=p.n_cat, n_dims=p.n_dims,
+        # classification labels are small ints — u8-exact — but only
+        # while every class id fits a byte: a 300-class logistic fit
+        # keeps f32 labels instead of refusing the compressed cache
+        label_u8=(p.label_in_chunk
+                  and (kind in ("binary_logistic", "hinge", "squared_hinge")
+                       or (kind == "logistic" and p.n_classes <= 256))),
+        impute=impute,
     )
 
 
-def _plan_from_f32(arrays, value_weighted: bool) -> dict:
-    """Inverse of ``_plan_f32_views`` over spill-record views."""
-    keys = _PLAN_ORDER if value_weighted else _PLAN_ORDER[:4]
-    plan = {}
-    for k, a in zip(keys, arrays):
-        a = np.asarray(a)
-        plan[k] = a if k == "val" else a.view(np.int32)
-    return plan
+def _encode_chunk_np(codec: _ChunkCodec, Xp: np.ndarray,
+                     salts_np: np.ndarray,
+                     idx: np.ndarray | None = None) -> dict:
+    """Host-side encode of one PADDED chunk on the prefetch thread: the
+    dict this returns is what the HBM cache, the disk spill and the h2d
+    DMA all carry — compressed bytes, decoded only inside the step.
+    ``idx``: the pre-hashed [N, C] indices when the caller already built
+    them (the sparse-plan path shares ONE host hash per chunk)."""
+    off = 1 if codec.label_in_chunk else 0
+    enc = {}
+    if codec.label_in_chunk:
+        lab = Xp[:, 0]
+        if codec.label_u8:
+            lab8 = lab.astype(np.uint8)
+            if not np.array_equal(lab8.astype(np.float32), lab):
+                raise ValueError(
+                    "cache_dtype compression stores classification labels "
+                    "as u8, but a label is not an integer in [0, 255] — "
+                    "soft/duplicated-range labels need cache_dtype='f32' "
+                    "(or OTPU_CACHE_DTYPE=f32)"
+                )
+            enc["y"] = lab8
+        else:
+            enc["y"] = np.ascontiguousarray(lab, np.float32)
+    if codec.n_dense:
+        enc["dense"] = np.asarray(
+            Xp[:, off:off + codec.n_dense]).astype(BF16)
+    cats = Xp[:, off + codec.n_dense:]
+    if codec.mode == "packed":
+        if idx is None:
+            if codec.impute:
+                cats = np.where(np.isnan(cats), np.float32(0.0), cats)
+            idx = hash_columns_np(cats, salts_np, codec.n_dims)
+        enc["cats"] = pack_rows_np(idx, codec.idx_bits)
+    else:
+        enc["cats"] = np.ascontiguousarray(cats, np.float32)
+    return enc
 
 
-def _plan_spill_shapes(p: HashedLinearParams, pad_rows: int) -> tuple:
-    """Per-record plan-array shapes appended to the spill layout."""
+def _decode_chunk(codec: _ChunkCodec, enc: dict, n_valid, y, w, salts):
+    """In-jit decode: compressed blocks -> (yv, dense f32, idx i32, wv).
+    A widen-on-load XLA fuses into the consumers (the embedding gather,
+    the dense matmul) — HBM holds compressed bytes, the math stays f32.
+    The packed mode's indices were pre-hashed on the host (the host twin
+    is pinned bit-identical to ``hash_columns``), so the step skips the
+    hash entirely; bf16 mode hashes exactly as the legacy step does."""
+    N = enc["cats"].shape[0]
+    if codec.label_in_chunk:
+        yv = enc["y"].astype(jnp.float32)
+        wv = (jnp.arange(N, dtype=jnp.int32) < n_valid).astype(jnp.float32)
+    else:
+        yv, wv = y, w
+    if codec.n_dense:
+        dense = enc["dense"].astype(jnp.float32)
+        if codec.impute:
+            dense = jnp.where(jnp.isnan(dense), 0.0, dense)
+    else:
+        dense = jnp.zeros((N, 0), jnp.float32)
+    if codec.mode == "packed":
+        idx = unpack_rows(enc["cats"], codec.idx_bits, codec.n_cat)
+    else:
+        cats = enc["cats"]
+        if codec.impute:
+            cats = jnp.where(jnp.isnan(cats), 0.0, cats)
+        idx = hash_columns(cats, salts, codec.n_dims)
+    return yv, dense, idx, wv
+
+
+def _put_encoded(enc: dict, session: TpuSession) -> dict:
+    """Device-put an encoded block dict: [N] vectors on the vector
+    sharding, [N, k] blocks row-sharded — compressed bytes over the DMA.
+    THE one leaf->sharding rule: fit ingest, disk replay and the warm
+    builders must produce identical avals or the warm compiles miss."""
+    return {k: put_sharded(v, session.row_sharding if v.ndim == 2
+                           else session.vector_sharding)
+            for k, v in enc.items()}
+
+
+def _chunk_field_specs(p: HashedLinearParams, codec, pad_rows: int) -> tuple:
+    """Ordered (name, shape, dtype) of one spill record's CHUNK payload —
+    the one authority the spill writer/reader and the warm-path builders
+    share (plan fields, when the sparse 'plan' lowering is active, append
+    after these via ``_plan_store_specs``)."""
+    if codec is None:
+        n_cols = _chunk_cols(p)
+        fields = [("x", (pad_rows, n_cols), np.dtype(np.float32))]
+        if not p.label_in_chunk:
+            fields += [("yv", (pad_rows,), np.dtype(np.float32)),
+                       ("wv", (pad_rows,), np.dtype(np.float32))]
+        return tuple(fields)
+    fields = []
+    if codec.label_in_chunk:
+        fields.append(("y", (pad_rows,),
+                       np.dtype(np.uint8 if codec.label_u8 else np.float32)))
+    if codec.n_dense:
+        fields.append(("dense", (pad_rows, codec.n_dense), np.dtype(BF16)))
+    if codec.mode == "packed":
+        fields.append(("cats", (pad_rows, codec.cat_words),
+                       np.dtype(np.uint32)))
+    else:
+        fields.append(("cats", (pad_rows, codec.n_cat),
+                       np.dtype(np.float32)))
+    if not codec.label_in_chunk:
+        fields += [("yv", (pad_rows,), np.dtype(np.float32)),
+                   ("wv", (pad_rows,), np.dtype(np.float32))]
+    return tuple(fields)
+
+
+def _plan_store_specs(p: HashedLinearParams, codec, pad_rows: int) -> tuple:
+    """Ordered (name, shape, dtype) of the plan's spill fields — packed
+    u32 carriers under the 'packed' codec, raw i32 (+ f32 'val') else."""
+    if codec is not None and codec.mode == "packed":
+        d = plan_packed_field_shapes(pad_rows, p.n_cat, p.n_dims)
+        return tuple((k, d[k][0], np.dtype(d[k][1]))
+                     for k in _PLAN_PACKED_ORDER)
     shapes = plan_field_shapes(pad_rows, p.n_cat, p.n_dims, p.value_weighted)
-    return tuple(shapes[k] for k in _PLAN_ORDER if k in shapes)
+    return tuple(
+        (k, shapes[k],
+         np.dtype(np.float32 if k == "val" else np.int32))
+        for k in _PLAN_ORDER if k in shapes
+    )
+
+
+def _plan_device_form(codec, plan_np: dict, pad_rows: int,
+                      p: HashedLinearParams) -> dict:
+    """The plan dict as it travels with the chunk (cache/spill/device):
+    bit-packed under the 'packed' codec, raw otherwise."""
+    if codec is not None and codec.mode == "packed":
+        return pack_plan_np(plan_np, pad_rows, p.n_cat, p.n_dims)
+    return plan_np
+
+
+def _raw_chunk_bytes(p: HashedLinearParams, pad_rows: int,
+                     sparse_plan: bool) -> int:
+    """f32-layout bytes of one cached chunk (+ its raw plan) — the
+    denominator of the bench's ``compression_ratio`` and the legacy term
+    in capacity estimates."""
+    n = pad_rows * _chunk_cols(p) * 4
+    if not p.label_in_chunk:
+        n += 2 * pad_rows * 4
+    if sparse_plan:
+        shapes = plan_field_shapes(pad_rows, p.n_cat, p.n_dims,
+                                   p.value_weighted)
+        n += 4 * sum(int(np.prod(s)) for s in shapes.values())
+    return n
+
+
+def estimate_cached_chunk_bytes(p: HashedLinearParams,
+                                session: TpuSession) -> int:
+    """Per-chunk HBM cache bytes under the RESOLVED codec/lowering — the
+    estimate bench.py's overflow/fusion pre-gates use; it must agree with
+    what ``fit_stream``'s cache accounting will actually see or the two
+    gates disagree in a boundary window."""
+    pad_rows = session.pad_rows(p.chunk_rows)
+    codec = resolve_chunk_codec(p, session)
+    optim = resolve_optim_update(p.optim_update)
+    sparse_plan = (is_sparse_update(optim)
+                   and resolve_sparse_lowering(p.sparse_lowering) == "plan")
+    specs = _chunk_field_specs(p, codec, pad_rows)
+    if sparse_plan:
+        specs = specs + _plan_store_specs(p, codec, pad_rows)
+    return sum(int(np.prod(s)) * dt.itemsize for _, s, dt in specs)
+
+
+def warm_eval_chunk(p: HashedLinearParams, session: TpuSession) -> tuple:
+    """A zero device chunk in the fit's CACHE layout (encoded under the
+    resolved codec) — bench.py warms the eval program against it so the
+    eval compile never lands inside the timed window. Mirrors the fit's
+    salts derivation so the encode path is byte-compatible."""
+    pad_rows = session.pad_rows(p.chunk_rows)
+    codec = resolve_chunk_codec(p, session)
+    Xp0 = np.zeros((pad_rows, _chunk_cols(p)), np.float32)
+    if codec is None:
+        Xd = put_sharded(Xp0, session.row_sharding)
+    else:
+        # codec is never active for value_weighted fits (resolve_chunk_codec
+        # returns None there), so the fit's plain per-column salts apply
+        salts_np = column_salts(p.n_cat, p.seed)
+        Xd = _put_encoded(_encode_chunk_np(codec, Xp0, salts_np), session)
+    if p.label_in_chunk:
+        zy = zw = jnp.zeros((1,), jnp.float32)
+    else:
+        zy = put_sharded(np.zeros((pad_rows,), np.float32),
+                         session.vector_sharding)
+        zw = zy
+    return (Xd, jnp.int32(1), zy, zw)
 
 
 def _chunk_cols(p: HashedLinearParams) -> int:
@@ -821,6 +1098,10 @@ def _init_fit_state(p: HashedLinearParams, session: TpuSession):
         # static decay gate: reg == 0 compiles the sparse step without the
         # timestamp gathers/pow (and ftrl owns its L2 in closed form)
         use_decay=(p.reg_param != 0.0 and optim_kind(optim) != "ftrl"),
+        # cache codec (io/codec.py): resolved HERE, once, like the
+        # optimizer rule — the OTPU_CACHE_DTYPE kill-switch can never
+        # poison the jit cache key space mid-process
+        codec=resolve_chunk_codec(p, session),
     )
     return theta, opt_state, salts_np, salts, static_kw
 
@@ -897,10 +1178,16 @@ class StreamingHashedLinearEstimator(Estimator):
         n_cols = _chunk_cols(p)
         pad_rows = session.pad_rows(p.chunk_rows)
         theta, opt, salts_np, salts, kw = _init_fit_state(p, session)
-        # one zero chunk through the SAME device-put path as the real fit,
-        # so the stacked avals (incl. shardings) match the timed run's
-        z = put_sharded(np.zeros((pad_rows, n_cols), np.float32),
-                        session.row_sharding)
+        codec = kw["codec"]
+        # one zero chunk through the SAME encode + device-put path as the
+        # real fit, so the stacked avals (incl. dtypes/shardings of the
+        # compressed blocks) match the timed run's
+        Xp0 = np.zeros((pad_rows, n_cols), np.float32)
+        if codec is None:
+            z = put_sharded(Xp0, session.row_sharding)
+        else:
+            z = _put_encoded(_encode_chunk_np(codec, Xp0, salts_np),
+                             session)
         nv = jnp.int32(pad_rows)
         if p.label_in_chunk:
             zy = zw = jnp.zeros((1,), jnp.float32)
@@ -914,12 +1201,13 @@ class StreamingHashedLinearEstimator(Estimator):
             # as the real fit (zero codes hash to one bucket per column —
             # the skew is irrelevant to the compiled shapes)
             zc = np.zeros((pad_rows, p.n_cat), np.float32)
+            plan_np0 = build_plan_np(
+                zc, salts_np, p.n_dims, pad_rows,
+                vals=(np.zeros((pad_rows, p.n_cat), np.float32)
+                      if p.value_weighted else None),
+                impute_missing=kw["impute_missing"])
             plan = jax.device_put(
-                build_plan_np(
-                    zc, salts_np, p.n_dims, pad_rows,
-                    vals=(np.zeros((pad_rows, p.n_cat), np.float32)
-                          if p.value_weighted else None),
-                    impute_missing=kw["impute_missing"]),
+                _plan_device_form(codec, plan_np0, pad_rows, p),
                 session.replicated)
         l1 = jnp.float32(p.l1_param)
         if not p.defer_epoch1:
@@ -935,7 +1223,8 @@ class StreamingHashedLinearEstimator(Estimator):
                 plan, l1, **kw)
         n_rep = p.epochs - 1 + (1 if p.defer_epoch1 else 0)
         stacks = (
-            jnp.stack([z] * n_chunks), jnp.stack([nv] * n_chunks),
+            jax.tree.map(lambda a: jnp.stack([a] * n_chunks), z),
+            jnp.stack([nv] * n_chunks),
             jnp.stack([zy] * n_chunks), jnp.stack([zw] * n_chunks),
         )
         if plan is not None:
@@ -971,9 +1260,10 @@ class StreamingHashedLinearEstimator(Estimator):
           epochs 2+ (Spark's ``persist()`` before MLlib's iterative fit).
           If the stream outgrows ``cache_device_bytes`` the fit degrades
           (no partial replay — see the module docstring): with
-          ``cache_spill_dir`` set, epochs 2+ replay PADDED f32 records
-          from an on-disk cache written during epoch 1 (read + DMA, no
-          re-parse — the 1B-row regime); without it, every epoch re-runs
+          ``cache_spill_dir`` set, epochs 2+ replay padded records
+          (encoded per ``cache_dtype``) from an on-disk cache written
+          during epoch 1 (read + DMA, no re-parse — the 1B-row regime);
+          without it, every epoch re-runs
           the source, which for a CSV source means re-PARSING the file
           per epoch — a loud ``warnings.warn`` says so once. The cached
           chunk list is exposed on the returned model as
@@ -1036,6 +1326,13 @@ class StreamingHashedLinearEstimator(Estimator):
         # prefetch thread, cached/spilled/stacked alongside the chunk
         optim_resolved = static_kw["optim_update"]
         sparse_plan = static_kw["sparse_lowering"] == "plan"
+        # cache codec (io/codec.py), resolved once in _init_fit_state: all
+        # storage surfaces — HBM cache, disk spill, h2d DMA — carry the
+        # encoded blocks; decode happens inside the jitted step
+        codec = static_kw["codec"]
+        chunk_specs = _chunk_field_specs(p, codec, pad_rows)
+        plan_specs = (_plan_store_specs(p, codec, pad_rows)
+                      if sparse_plan else ())
         # categorical block offset in the padded chunk ([label?] + dense +
         # cats, or [label?] + idx pairs; n_dense == 0 in vw mode)
         cats_off = (1 if p.label_in_chunk else 0) + p.n_dense
@@ -1044,6 +1341,54 @@ class StreamingHashedLinearEstimator(Estimator):
         # disk replay, grouped disk replay) folds in, so overlap_pct is the
         # measured host-prep/device-compute overlap of the WHOLE fit
         pipe_stats = PipelineStats()
+
+        def put_payload(payload):
+            """Device-put one chunk payload: the raw [N, cols] array, or
+            the encoded block dict via the shared leaf->sharding rule."""
+            if codec is None:
+                return put_sharded(payload, row_sh)
+            return _put_encoded(payload, session)
+
+        def record_arrays(payload, yp, wp, plan_store):
+            """Spill-record field tuple in ``chunk_specs``(+``plan_specs``)
+            declaration order."""
+            if codec is None:
+                rec = (payload,) if p.label_in_chunk else (payload, yp, wp)
+            else:
+                rec = tuple(
+                    yp if name == "yv" else wp if name == "wv"
+                    else payload[name]
+                    for name, _, _ in chunk_specs
+                )
+            if plan_store is not None:
+                rec = rec + tuple(plan_store[name]
+                                  for name, _, _ in plan_specs)
+            return rec
+
+        def record_to_host(arrays):
+            """Typed spill-record views -> (payload, y, w, plan) host
+            arrays — the inverse of ``record_arrays``."""
+            chunk_arr = arrays[:len(chunk_specs)]
+            y_np = w_np = None
+            if codec is None:
+                payload = np.asarray(chunk_arr[0])
+                if not p.label_in_chunk:
+                    y_np = np.asarray(chunk_arr[1])
+                    w_np = np.asarray(chunk_arr[2])
+            else:
+                payload = {}
+                for (name, _, _), a in zip(chunk_specs, chunk_arr):
+                    if name == "yv":
+                        y_np = np.asarray(a)
+                    elif name == "wv":
+                        w_np = np.asarray(a)
+                    else:
+                        payload[name] = np.asarray(a)
+            plan_np = None
+            if plan_specs:
+                plan_np = {name: np.asarray(a) for (name, _, _), a
+                           in zip(plan_specs, arrays[len(chunk_specs):])}
+            return payload, y_np, w_np, plan_np
 
         def to_device(host_chunk):
             """parse-thread side: pad + device_put one chunk."""
@@ -1068,6 +1413,14 @@ class StreamingHashedLinearEstimator(Estimator):
             else:
                 Xp, yp, wp = _pad_chunk(X_np, y_np, w_np, pad_rows,
                                         n_cols)
+            # under the packed codec the chunk's indices are hashed ONCE
+            # on this thread and shared by the plan builder and the encode
+            idx_np = None
+            if codec is not None and codec.mode == "packed":
+                c = Xp[:, cats_off:cats_off + p.n_cat]
+                if codec.impute:
+                    c = np.where(np.isnan(c), np.float32(0.0), c)
+                idx_np = hash_columns_np(c, salts_np, p.n_dims)
             plan_np = None
             if sparse_plan:
                 # host-presorted touched-row plan (optim/sparse.py) —
@@ -1079,32 +1432,46 @@ class StreamingHashedLinearEstimator(Estimator):
                     p.n_dims, n,
                     vals=(Xp[:, cats_off + p.n_cat:]
                           if p.value_weighted else None),
-                    impute_missing=static_kw["impute_missing"])
+                    impute_missing=static_kw["impute_missing"],
+                    idx=idx_np)
                 if times is not None:
                     times["plan_s"] = (times.get("plan_s", 0.0)
                                        + time.perf_counter() - t_pl)
-            if spill_active[0]:
-                # sequential f32 write of the already-padded chunk — still
-                # on the prefetch thread, overlapping device steps. Plan
-                # arrays ride the same record, i32 bit-viewed as f32.
-                t_sp = time.perf_counter() if times is not None else 0.0
-                rec = (Xp,) if p.label_in_chunk else (Xp, yp, wp)
+            # encode on the prefetch thread (io/codec.py): bf16 / u8 /
+            # bit-packed blocks — the cache, the spill AND the DMA all
+            # carry the compressed bytes from here on
+            payload = Xp
+            plan_store = plan_np
+            if codec is not None:
+                t_en = time.perf_counter()
+                payload = _encode_chunk_np(codec, Xp, salts_np, idx=idx_np)
                 if plan_np is not None:
-                    rec = rec + _plan_f32_views(plan_np)
-                spill.append(rec, n)
+                    plan_store = _plan_device_form(codec, plan_np,
+                                                   pad_rows, p)
+                dt_en = time.perf_counter() - t_en
+                pipe_stats.encode_s += dt_en
+                if times is not None:
+                    times["encode_s"] = times.get("encode_s", 0.0) + dt_en
+            if spill_active[0]:
+                # sequential write of the already-encoded chunk — still
+                # on the prefetch thread, overlapping device steps. Plan
+                # arrays ride the same record, typed (packed u32 under
+                # the 'packed' codec).
+                t_sp = time.perf_counter() if times is not None else 0.0
+                spill.append(record_arrays(payload, yp, wp, plan_store), n)
                 if times is not None:
                     times["spill_s"] = (times.get("spill_s", 0.0)
                                         + time.perf_counter() - t_sp)
             t0 = time.perf_counter() if times is not None else 0.0
-            Xd = put_sharded(Xp, row_sh)
+            Xd = put_payload(payload)
             if p.label_in_chunk:
                 yd = wd = _ZERO
             else:
                 yd = put_sharded(yp, vec_sh)
                 wd = put_sharded(wp, vec_sh)
             out = (Xd, jnp.int32(n), yd, wd)
-            if plan_np is not None:
-                out = out + (jax.device_put(plan_np, session.replicated),)
+            if plan_store is not None:
+                out = out + (jax.device_put(plan_store, session.replicated),)
             if times is not None:
                 times["h2d_s"] += time.perf_counter() - t0
             return out
@@ -1145,8 +1512,13 @@ class StreamingHashedLinearEstimator(Estimator):
 
         # device-resident training chunks; shared budget/degrade rule with
         # the other streaming estimators. Enabled even at epochs=1 because
-        # the cache doubles as the model's exposed device_chunks_
-        cache = _DeviceCache(cache_device, cache_device_bytes)
+        # the cache doubles as the model's exposed device_chunks_.
+        # may_exclude_tail: an over-budget offer within the last
+        # holdout_chunks offers may later be excluded (the un-latch); any
+        # earlier miss is final and the cache drops the moment that is
+        # known, legacy-style
+        cache = _DeviceCache(cache_device, cache_device_bytes,
+                             may_exclude_tail=holdout_chunks)
         # Defer-epoch-1 schedule (see the Params docstring): the streaming
         # pass is pure ingest and ALL p.epochs training passes run off the
         # cache/spill/stream afterwards. Bit-identical step sequence; the
@@ -1174,11 +1546,13 @@ class StreamingHashedLinearEstimator(Estimator):
         #                             to_device on the prefetch thread
         if (cache_device and cache_spill_dir is not None
                 and (p.epochs > 1 or defer)):
-            shapes = (((pad_rows, n_cols),) if p.label_in_chunk
-                      else ((pad_rows, n_cols), (pad_rows,), (pad_rows,)))
-            if sparse_plan:
-                shapes = shapes + _plan_spill_shapes(p, pad_rows)
-            spill = DiskChunkCache(cache_spill_dir, shapes)
+            # the spill records carry the SAME encoded fields as the HBM
+            # cache (typed, versioned header — io/streaming.DiskChunkCache)
+            # so spill I/O shrinks with the cache under a compressed codec
+            specs = chunk_specs + plan_specs
+            spill = DiskChunkCache(cache_spill_dir,
+                                   tuple(s for _, s, _ in specs),
+                                   tuple(dt for _, _, dt in specs))
             spill_active[0] = True
         use_disk = False
         holdout: list = []         # device-resident holdout chunks
@@ -1239,18 +1613,16 @@ class StreamingHashedLinearEstimator(Estimator):
 
             def rec_to_device(i):
                 arrays, n = spill.read(i)
-                n_base = 1 if p.label_in_chunk else 3
+                payload, y_np, w_np, plan_np = record_to_host(arrays)
                 t0 = time.perf_counter() if times is not None else 0.0
-                Xd = put_sharded(np.asarray(arrays[0]), row_sh)
+                Xd = put_payload(payload)
                 if p.label_in_chunk:
                     yd = wd = _ZERO
                 else:
-                    yd = put_sharded(np.asarray(arrays[1]), vec_sh)
-                    wd = put_sharded(np.asarray(arrays[2]), vec_sh)
+                    yd = put_sharded(y_np, vec_sh)
+                    wd = put_sharded(w_np, vec_sh)
                 out = (Xd, jnp.int32(n), yd, wd)
-                if sparse_plan:
-                    plan_np = _plan_from_f32(arrays[n_base:],
-                                             p.value_weighted)
+                if plan_np is not None:
                     out = out + (jax.device_put(plan_np,
                                                 session.replicated),)
                 if times is not None:
@@ -1281,25 +1653,29 @@ class StreamingHashedLinearEstimator(Estimator):
             def grp_to_device(start):
                 g = group
                 recs = [spill.read(start + j) for j in range(g)]
-                n_base = 1 if p.label_in_chunk else 3
+                hosts = [record_to_host(r[0]) for r in recs]
                 t0 = time.perf_counter() if times is not None else 0.0
-                Xs = put_sharded(
-                    np.stack([np.asarray(r[0][0]) for r in recs]),
-                    session.sharding(None, session.data_axis, None),
-                )
+
+                def stack_put(leaves):
+                    a = np.stack(leaves)
+                    spec = ((None, session.data_axis)
+                            + (None,) * (a.ndim - 2))
+                    return put_sharded(a, session.sharding(*spec))
+
+                if codec is None:
+                    Xs = stack_put([h[0] for h in hosts])
+                else:
+                    Xs = {k2: stack_put([h[0][k2] for h in hosts])
+                          for k2 in hosts[0][0]}
                 nv = jnp.asarray([r[1] for r in recs], jnp.int32)
                 if p.label_in_chunk:
                     ys = ws = jnp.zeros((g, 1), jnp.float32)
                 else:
-                    vsh = session.sharding(None, session.data_axis)
-                    ys = put_sharded(
-                        np.stack([np.asarray(r[0][1]) for r in recs]), vsh)
-                    ws = put_sharded(
-                        np.stack([np.asarray(r[0][2]) for r in recs]), vsh)
+                    ys = stack_put([h[1] for h in hosts])
+                    ws = stack_put([h[2] for h in hosts])
                 stacks = (Xs, nv, ys, ws)
                 if sparse_plan:
-                    plans = [_plan_from_f32(r[0][n_base:], p.value_weighted)
-                             for r in recs]
+                    plans = [h[3] for h in hosts]
                     stacks = stacks + (jax.device_put(
                         jax.tree.map(lambda *a: np.stack(a), *plans),
                         session.replicated),)
@@ -1340,12 +1716,19 @@ class StreamingHashedLinearEstimator(Estimator):
                     if cache.enabled:
                         # the tail chunks live in the cache too — they must
                         # never be trained on in replay epochs (exclude()
-                        # keeps nbytes honest for the fuse_replay gate)
+                        # keeps nbytes honest for the fuse_replay gate) —
+                        # and misses confined to this excluded tail never
+                        # degrade the run (the un-latch)
                         cache.exclude({id(c[0]) for c in holdout})
+                        cache.forgive_tail(holdout_chunks)
                 if epoch == 0:
                     spill_active[0] = False   # prefetch thread has exited
                     if spill is not None:
                         spill.finalize()
+                    # an incomplete cache drops whole here; one whose
+                    # misses were all holdout-excluded keeps replaying
+                    # from HBM (the un-latch the exclude() covers)
+                    cache.settle()
                     if cache.degraded and (p.epochs > 1 or defer):
                         use_disk = (spill is not None
                                     and spill.n_records > holdout_chunks)
@@ -1379,7 +1762,7 @@ class StreamingHashedLinearEstimator(Estimator):
                 # hosts where each dispatch costs ~hundreds of ms. G is
                 # sized so current group + prefetched group + transient
                 # scan copies stay inside the cache budget.
-                rec_bytes = spill.record_floats * 4
+                rec_bytes = spill.payload_bytes
                 group = max(1, min(spill.n_records,
                                    cache_device_bytes // (4 * rec_bytes)))
                 if (p.fused_replay and checkpointer is None
@@ -1505,6 +1888,16 @@ class StreamingHashedLinearEstimator(Estimator):
             stage_times["emb_update"] = static_kw["emb_update"]
             stage_times["optim_update"] = optim_resolved
             stage_times["sparse_lowering"] = static_kw["sparse_lowering"]
+            # cache economics (io/codec.py): what the HBM cache actually
+            # held, and what the same chunks would cost at f32 — the
+            # bench's compression_ratio/capacity fields read these
+            stage_times["cache_dtype"] = codec.mode if codec else "f32"
+            if cache_device:
+                stage_times["cache_bytes"] = cache.nbytes
+                stage_times["cache_chunks"] = len(cache.batches)
+                stage_times["cache_raw_bytes"] = (
+                    len(cache.batches)
+                    * _raw_chunk_bytes(p, pad_rows, sparse_plan))
             stage_times["epoch_s"] = [round(t, 3) for t in epoch_walls]
             if pipe_stats.items:
                 # measured prefetch overlap (exec/pipeline.py): 100% = all
@@ -1533,6 +1926,7 @@ class StreamingHashedLinearEstimator(Estimator):
         model.final_loss_ = float(last_loss) if last_loss is not None else None
         model.device_chunks_ = cache.batches if cache_device else None
         model.holdout_chunks_ = holdout if holdout_chunks > 0 else None
+        model.cache_codec_ = codec   # evaluate_device's decode key
         if checkpointer is not None:
             checkpointer.delete()
         return model

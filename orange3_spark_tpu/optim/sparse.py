@@ -77,6 +77,8 @@ __all__ = [
     "sparse_updates_enabled", "resolve_optim_update",
     "resolve_sparse_lowering", "optim_kind", "is_sparse_update",
     "init_optim_state", "plan_slots", "build_plan_np", "plan_field_shapes",
+    "plan_pack_widths", "plan_packed_field_shapes", "pack_plan_np",
+    "unpack_plan",
     "occurrence_dead", "apply_rule", "dense_update",
     "sparse_embedding_update", "finalize_lazy_decay",
 ]
@@ -227,7 +229,8 @@ def plan_field_shapes(pad_rows: int, n_cat: int, n_dims: int,
 
 def build_plan_np(cats: np.ndarray, salts: np.ndarray, n_dims: int,
                   n_valid: int, *, vals: np.ndarray | None = None,
-                  impute_missing: bool = False) -> dict:
+                  impute_missing: bool = False,
+                  idx: np.ndarray | None = None) -> dict:
     """Host-side touched-row plan for one padded chunk — built ONCE on the
     prefetch thread (overlapping device steps) and replayed every epoch.
 
@@ -256,9 +259,13 @@ def build_plan_np(cats: np.ndarray, salts: np.ndarray, n_dims: int,
     from orange3_spark_tpu.ops.hashing import hash_columns_np
 
     cats = np.asarray(cats)
-    if impute_missing:
-        cats = np.where(np.isnan(cats), 0.0, cats)
-    idx = hash_columns_np(cats, salts, n_dims)            # [N, C] i32
+    if idx is None:
+        if impute_missing:
+            cats = np.where(np.isnan(cats), 0.0, cats)
+        idx = hash_columns_np(cats, salts, n_dims)        # [N, C] i32
+    # callers with the 'packed' chunk codec pass the idx their encode
+    # already hashed — the two host hashes of the same 26 columns per
+    # chunk were pure duplicated prefetch-thread work
     N, C = idx.shape
     M = N * C
     U = plan_slots(N, C, n_dims)
@@ -289,6 +296,107 @@ def build_plan_np(cats: np.ndarray, salts: np.ndarray, n_dims: int,
         plan["val"] = np.ascontiguousarray(
             np.asarray(vals, np.float32).reshape(-1)[order])
     return plan
+
+
+def plan_pack_widths(pad_rows: int, n_cat: int, n_dims: int) -> dict:
+    """STATIC bit widths of the bit-packed plan arrays (io/codec.py) —
+    every plan quantity is bounded by chunk/table shape, never by data:
+    'row' < pad_rows, 'uniq'+1 <= n_dims (the -1 dead sentinel shifts to
+    0), 'inv'+1 <= U. 'seg' is not packed at a width at all: it is
+    nondecreasing with 0/1 steps, so its information content is the
+    boundary BIT array — stored 1 bit per occurrence and rebuilt in-jit
+    by one cumsum (a 32x shrink on the largest plan array)."""
+    U = plan_slots(pad_rows, n_cat, n_dims)
+    from orange3_spark_tpu.io.codec import bit_width
+
+    return {"row": bit_width(pad_rows), "uniq": bit_width(n_dims + 1),
+            "inv": bit_width(U + 1)}
+
+
+def plan_packed_field_shapes(pad_rows: int, n_cat: int, n_dims: int) -> dict:
+    """name -> (shape, dtype) of the packed plan's u32 carrier arrays, in
+    spill declaration order — the one authority the spill layout and the
+    warm-path builders share (the packed twin of ``plan_field_shapes``).
+    'segb' holds per-word boundary anchors AND the boundary bits (see
+    ``pack_plan_np``), hence the 2x word count."""
+    from orange3_spark_tpu.io.codec import flat_words
+
+    M = pad_rows * n_cat
+    U = plan_slots(pad_rows, n_cat, n_dims)
+    wb = plan_pack_widths(pad_rows, n_cat, n_dims)
+    return {
+        "rowp": ((flat_words(M, wb["row"]),), np.uint32),
+        "segb": ((2 * -(-M // 32),), np.uint32),
+        "uniqp": ((flat_words(U, wb["uniq"]),), np.uint32),
+        "invp": ((flat_words(n_dims, wb["inv"]),), np.uint32),
+    }
+
+
+def pack_plan_np(plan: dict, pad_rows: int, n_cat: int, n_dims: int) -> dict:
+    """Host-side losslessly bit-packed form of a touched-row plan — built
+    on the prefetch thread right after ``build_plan_np`` and cached/
+    spilled/stacked in place of the raw i32 arrays under the 'packed'
+    cache dtype. ``unpack_plan`` is the bit-exact in-jit inverse, so the
+    plan-lowering update stays BITWISE identical to the raw-plan path."""
+    from orange3_spark_tpu.io.codec import pack_flat_np
+
+    wb = plan_pack_widths(pad_rows, n_cat, n_dims)
+    seg = plan["seg"]
+    M = seg.shape[0]
+    start = np.empty(M, np.uint32)
+    start[0] = 1
+    start[1:] = (seg[1:] != seg[:-1]).astype(np.uint32)
+    # 'seg' is nondecreasing with 0/1 steps: store the boundary BITS (32x
+    # smaller) plus one running anchor per word — seg[j] then rebuilds as
+    # anchor[word] + popcount(bits up to j) - 1, a single vectorized
+    # popcount at decode instead of a full-length cumsum (which cost more
+    # than every other plan decode combined on XLA:CPU)
+    bitwords = pack_flat_np(start, 1)
+    pops = _popcount_u32(bitwords)
+    anchors = np.zeros(bitwords.shape[0], np.uint32)
+    np.cumsum(pops[:-1], out=anchors[1:], dtype=np.uint32)
+    return {
+        "rowp": pack_flat_np(plan["row"], wb["row"]),
+        "segb": np.concatenate([anchors, bitwords]),
+        "uniqp": pack_flat_np(plan["uniq"] + 1, wb["uniq"]),
+        "invp": pack_flat_np(plan["inv"] + 1, wb["inv"]),
+    }
+
+
+def _popcount_u32(words: np.ndarray) -> np.ndarray:
+    """Vectorized host popcount (numpy<2.0 has no ``bitwise_count``)."""
+    if hasattr(np, "bitwise_count"):
+        return np.bitwise_count(words).astype(np.uint32)
+    v = words.copy()
+    v = v - ((v >> np.uint32(1)) & np.uint32(0x55555555))
+    v = (v & np.uint32(0x33333333)) + ((v >> np.uint32(2))
+                                       & np.uint32(0x33333333))
+    v = (v + (v >> np.uint32(4))) & np.uint32(0x0F0F0F0F)
+    return ((v * np.uint32(0x01010101)) >> np.uint32(24)).astype(np.uint32)
+
+
+def unpack_plan(enc: dict, pad_rows: int, n_cat: int, n_dims: int) -> dict:
+    """In-jit decode of ``pack_plan_np``'s output back to the raw plan
+    dict — static shifts/masks plus one i32 cumsum for 'seg'; XLA fuses
+    the widen into the consuming gathers/segment-sum."""
+    from orange3_spark_tpu.io.codec import unpack_flat
+
+    M = pad_rows * n_cat
+    U = plan_slots(pad_rows, n_cat, n_dims)
+    wb = plan_pack_widths(pad_rows, n_cat, n_dims)
+    B = enc["segb"].shape[0] // 2
+    anchors, bitwords = enc["segb"][:B], enc["segb"][B:]
+    # inclusive-prefix popcount within each word + the per-word anchor
+    # rebuilds seg without any sequential scan (see pack_plan_np)
+    masks = np.array([0xFFFFFFFF >> (31 - j) for j in range(32)], np.uint32)
+    pc = jax.lax.population_count(bitwords[:, None] & masks[None, :])
+    seg = (anchors[:, None] + pc).reshape(B * 32)[:M].astype(jnp.int32) - 1
+    return {
+        "row": unpack_flat(enc["rowp"], wb["row"], M),
+        "seg": seg,
+        "uniq": unpack_flat(enc["uniqp"], wb["uniq"], U) - 1,
+        "inv": unpack_flat(enc["invp"], wb["inv"], n_dims) - 1,
+    }
 
 
 def occurrence_dead(n_rows: int, n_cat: int, n_valid, raw_cats=None):

@@ -57,7 +57,13 @@ def _run(argv, timeout=420):
       # optimizer A/B self-description: the RESOLVED rule/lowerings and
       # the dense arm measured in the same run
       "optim_update", "sparse_lowering", "emb_update",
-      "pure_step_ms_dense", "optim_step_speedup"}),
+      "pure_step_ms_dense", "optim_step_speedup",
+      # cache-codec economics (ISSUE 4): resolved dtype, measured cache
+      # bytes, f32-equivalent compression and rows-at-budget capacity,
+      # plus the same-run f32-cache step arm
+      "cache_dtype", "cache_bytes", "compression_ratio",
+      "cache_rows_capacity", "pure_step_ms_f32cache",
+      "cache_step_speedup", "encode_s"}),
     (["bench_suite.py", "--config", "5", "--rows-scale", "0.002"],
      "taxi_kmeans_pca_pipeline",
      {"staged_speedup", "workflow_fit_s"}),
@@ -99,3 +105,11 @@ def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
         assert d["optim_update"] in OPTIM_UPDATES
         assert d["sparse_lowering"] in ("plan", "sort", "none")
         assert d["emb_update"] in ("fused", "per_column", "sorted")
+    if "cache_dtype" in extra_keys:
+        from orange3_spark_tpu.io.codec import CACHE_DTYPES
+
+        assert d["cache_dtype"] in CACHE_DTYPES
+        if d["cache_dtype"] == "packed" and d.get("compression_ratio"):
+            # the ISSUE-4 capacity criterion at the real criteo layout
+            # (sparse 'plan' lowering on the CPU fallback): >= 1.8x
+            assert d["compression_ratio"] >= 1.8, d["compression_ratio"]

@@ -1,0 +1,100 @@
+"""One-shot cache-codec A/B — f32 vs compressed chunk cache on a synthetic
+Criteo-shaped stream: fit wall per arm, measured cache bytes / compression
+ratio, and the max-|theta| divergence between the arms (the packed int
+layer is LOSSLESS, so with n_dense=0 the divergence must be exactly 0.0;
+with dense columns it is the bounded bf16 rounding).
+
+Sized to run inside the tier-1 test budget (a few seconds on the CPU test
+mesh) — tests/test_cache_codec.py runs it as a smoke. For the full ladder
+(f32/bf16/packed, replay walls, encode seconds) use
+``bench_suite.py --config 9``; for the Criteo-scale capacity record,
+``bench.py`` (``compression_ratio`` / ``cache_rows_capacity`` fields).
+
+Run: python tools/cache_ab.py [--rows 40960] [--dims 16384] [--n-dense 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(
+        globals().get("__file__", "tools/cache_ab.py"))))
+)
+
+
+def run(rows: int = 40960, dims: int = 1 << 14, n_dense: int = 4,
+        n_cat: int = 8, epochs: int = 5, chunk_rows: int = 1 << 13,
+        optim_update: str = "sparse_adagrad") -> dict:
+    import jax
+    import numpy as np
+
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.io.codec import force_cache_dtype
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+
+    session = TpuSession.builder_get_or_create()
+    rng = np.random.default_rng(23)
+    dense = rng.lognormal(size=(rows, n_dense)).astype(np.float32)
+    cats = rng.integers(0, 60_000, (rows, n_cat)).astype(np.float32)
+    y = (cats[:, 0] % 5 == 0).astype(np.float32)
+    Xall = np.concatenate([dense, cats], axis=1)
+    src = array_chunk_source(Xall, y, chunk_rows=chunk_rows)
+
+    def arm(cache: str) -> tuple:
+        with force_cache_dtype(cache):
+            est = StreamingHashedLinearEstimator(
+                n_dims=dims, n_dense=n_dense, n_cat=n_cat, epochs=epochs,
+                step_size=0.05, reg_param=1e-4, chunk_rows=chunk_rows,
+                optim_update=optim_update,
+            )
+            est.fit_stream(src, session=session, cache_device=True)  # warm
+            st: dict = {}
+            t0 = time.perf_counter()
+            model = est.fit_stream(src, session=session, cache_device=True,
+                                   stage_times=st)
+            jax.block_until_ready(model.theta["emb"])
+            return model, round(time.perf_counter() - t0, 3), st
+
+    m32, wall32, _ = arm("f32")
+    mpk, wallpk, st = arm("packed")
+    diff = float(np.abs(np.asarray(mpk.theta["emb"])
+                        - np.asarray(m32.theta["emb"])).max())
+    return {
+        "metric": "cache_codec_ab",
+        "rows": rows, "n_hashed_dims": dims, "epochs": epochs,
+        "n_dense": n_dense, "n_cat": n_cat,
+        "optim_update": st.get("optim_update"),
+        "cache_dtype": st.get("cache_dtype"),
+        "wall_s_f32": wall32, "wall_s_compressed": wallpk,
+        "cache_bytes_compressed": st.get("cache_bytes"),
+        "compression_ratio": (round(st["cache_raw_bytes"]
+                                    / st["cache_bytes"], 3)
+                              if st.get("cache_bytes") else None),
+        "max_theta_diff": diff,
+        # with no dense block every stored quantity is lossless-packed:
+        # the arms must agree BITWISE
+        "lossless_config": n_dense == 0,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=40960)
+    ap.add_argument("--dims", type=int, default=1 << 14)
+    ap.add_argument("--n-dense", type=int, default=4)
+    ap.add_argument("--epochs", type=int, default=5)
+    args = ap.parse_args()
+    print(json.dumps(run(rows=args.rows, dims=args.dims,
+                         n_dense=args.n_dense, epochs=args.epochs)))
+
+
+if __name__ == "__main__":
+    main()
