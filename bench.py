@@ -1104,10 +1104,116 @@ def bench_dense_logreg() -> dict:
     }
 
 
+def bench_fault(*, rows: int = 262_144, epochs: int = 4) -> dict:
+    """Resilience A/B (docs/resilience.md): the SAME small streaming fit
+    runs clean and then under injected faults (transient chunk-source
+    IOErrors absorbed by bounded retries + straggler chunks), reporting
+    ``recovery_overhead_pct`` — the wall-clock price of surviving the
+    faults — and asserting the recovered fit is BITWISE equal to the
+    fault-free one (the whole point: recovery must not change the
+    numbers). A third mini-fit demonstrates the dispatch watchdog: a
+    wedged dispatch raises a typed DispatchWedgedError within its budget
+    instead of hanging the harness (the round-4 rc=124 signature)."""
+    import jax
+    import numpy as np
+
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.io.streaming import (
+        StreamingLinearEstimator, array_chunk_source,
+    )
+    from orange3_spark_tpu.resilience import (
+        DispatchWedgedError, inject_faults,
+    )
+    from orange3_spark_tpu.utils.profiling import (
+        reset_resilience_counters, resilience_counters,
+    )
+
+    session = TpuSession.builder_get_or_create()
+    chunk_rows = 1 << 14
+    n_features = 16
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((rows, n_features)).astype(np.float32)
+    w_true = rng.standard_normal(n_features).astype(np.float32)
+    y = (X @ w_true > 0).astype(np.float32)
+    est_kw = dict(loss="logistic", epochs=epochs, step_size=0.05,
+                  chunk_rows=chunk_rows)
+    src = array_chunk_source(X, y, chunk_rows=chunk_rows)
+
+    def fit():
+        m = StreamingLinearEstimator(**est_kw).fit_stream(
+            src, n_features=n_features, session=session,
+            cache_device=True,
+        )
+        jax.block_until_ready(m.coef)
+        return m
+
+    fit()                                   # warm-up: compile out of band
+    t0 = time.perf_counter()
+    ref = fit()
+    wall_clean = time.perf_counter() - t0
+
+    reset_resilience_counters()
+    # transient faults on two epoch-1 chunks (fail-twice-then-succeed,
+    # absorbed by retry) + a mild straggler on every 8th chunk; short
+    # backoff so the overhead number measures RECOVERY, not sleep policy
+    os.environ.setdefault("OTPU_RETRY_BASE_S", "0.02")
+    t0 = time.perf_counter()
+    with inject_faults("source_io:every=7,fails=2;"
+                       "slow_source:every=8,delay_ms=5"):
+        faulted = fit()
+    wall_fault = time.perf_counter() - t0
+    res = resilience_counters()
+    parity = bool(np.array_equal(np.asarray(ref.coef),
+                                 np.asarray(faulted.coef)))
+
+    # watchdog demo: the first guarded sync of a tiny fit wedges for 30 s;
+    # the budget converts the hang into a typed error in ~0.25 s. The
+    # demo fit's chunk size guarantees >= 20 steps whatever --rows/
+    # --epochs chose, so the period-16 guarded sync always runs
+    watchdog_raised = False
+    wedge_kw = dict(est_kw, chunk_rows=max(256, rows * epochs // 20))
+    old_budget = os.environ.get("OTPU_DISPATCH_BUDGET_S")
+    os.environ["OTPU_DISPATCH_BUDGET_S"] = "0.25"
+    try:
+        with inject_faults("wedge:at=1,hold_s=30"):
+            try:
+                StreamingLinearEstimator(**wedge_kw).fit_stream(
+                    src, n_features=n_features, session=session)
+            except DispatchWedgedError:
+                watchdog_raised = True
+    finally:
+        if old_budget is None:
+            os.environ.pop("OTPU_DISPATCH_BUDGET_S", None)
+        else:
+            os.environ["OTPU_DISPATCH_BUDGET_S"] = old_budget
+
+    v = rows * epochs / wall_fault / session.n_devices
+    return {
+        "metric": "fault_recovery_streaming_fit_rows_per_sec_per_chip",
+        "value": round(v, 1),
+        "unit": "rows/s/chip",
+        # a resilience A/B has no external baseline: the clean arm IS the
+        # denominator, reported as recovery_overhead_pct
+        "vs_baseline": None,
+        "backend": jax.default_backend(),
+        "rows": rows,
+        "epochs": epochs,
+        "wall_clean_s": round(wall_clean, 3),
+        "wall_fault_s": round(wall_fault, 3),
+        "recovery_overhead_pct": round(
+            100.0 * (wall_fault - wall_clean) / max(wall_clean, 1e-9), 1),
+        "faults_injected": res["faults_injected"],
+        "retries": res["retries"],
+        "retry_wait_s": round(res["retry_wait_s"], 3),
+        "parity_bitwise": parity,
+        "watchdog_raised": watchdog_raised,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="criteo",
-                    choices=["criteo", "dense_logreg", "serving"])
+                    choices=["criteo", "dense_logreg", "serving", "fault"])
     ap.add_argument("--rows", type=int, default=N_ROWS)
     ap.add_argument("--epochs", type=int, default=EPOCHS)
     # None = per-config default (criteo N_DIMS, serving's lighter 1<<18 —
@@ -1393,6 +1499,12 @@ def _main_locked(args, rows, cpu_rows, lk, t_budget0, force_cpu=False):
             return bench_serving(
                 rows, backend=platform,
                 **({} if args.dims is None else {"dims": args.dims}))
+        if args.config == "fault":
+            # the --dims convention: an untouched global default means
+            # "use the fault config's own size", an explicit flag wins
+            return bench_fault(
+                rows=(args.rows if args.rows != N_ROWS else 262_144),
+                epochs=(args.epochs if args.epochs != EPOCHS else 4))
         return bench_dense_logreg()
 
     if args.profile:

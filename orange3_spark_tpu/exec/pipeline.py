@@ -50,6 +50,9 @@ class PipelineStats:
     # (io/codec.py) — a subset of prep_s, attributed by the prep callback
     # itself so the cache-codec cost is visible next to parse/DMA
     encode_s: float = 0.0
+    # transient source reads retried by the resilience layer
+    # (resilience/retry.resilient_source threads this stats object in)
+    retries: int = 0
     done: bool = False
 
     @property
@@ -67,6 +70,7 @@ class PipelineStats:
         self.wait_s += other.wait_s
         self.wall_s += other.wall_s
         self.encode_s += other.encode_s
+        self.retries += other.retries
         return self
 
 

@@ -46,10 +46,20 @@ import ml_dtypes
 import numpy as np
 
 __all__ = [
-    "CACHE_DTYPES", "BF16", "resolve_cache_dtype", "force_cache_dtype",
-    "bit_width", "pack_rows_np", "unpack_rows", "pack_flat_np",
-    "unpack_flat",
+    "CACHE_DTYPES", "BF16", "SpillCorruptionError", "resolve_cache_dtype",
+    "force_cache_dtype", "bit_width", "pack_rows_np", "unpack_rows",
+    "pack_flat_np", "unpack_flat",
 ]
+
+
+class SpillCorruptionError(RuntimeError):
+    """A spill record failed integrity verification (CRC mismatch,
+    truncated tail, or an impossible live-row count). Raised by
+    ``io.streaming.DiskChunkCache`` naming the record ordinal — the
+    alternative is silently decoding garbage into a 100-epoch replay.
+    Version-2 spill files carry a per-record CRC32; the check is skipped
+    under the ``OTPU_RESILIENCE=0`` kill-switch (legacy decode-anything
+    behavior) and for pre-CRC files (versions 0/1, which stay readable)."""
 
 CACHE_DTYPES = ("f32", "bf16", "packed")
 

@@ -324,6 +324,8 @@ def stream_feature_stats(source: Callable[[], Iterator[Chunk]],
     ``exec.pipeline``) and ``dispatches`` (fold programs dispatched)."""
     if missing_value is not None and gramian:
         raise ValueError("gramian=True and missing_value are incompatible")
+    from orange3_spark_tpu.resilience.retry import resilient_source
+
     session = session or TpuSession.builder_get_or_create()
     pad_rows = session.pad_rows(chunk_rows)
     row_sh = session.row_sharding
@@ -337,6 +339,9 @@ def stream_feature_stats(source: Callable[[], Iterator[Chunk]],
 
     acc = None
     pstats = PipelineStats()
+    # transient source-read faults are absorbed by bounded retries on the
+    # prefetch thread (resilience/retry.py; counted into pstats.retries)
+    source = resilient_source(source, stats=pstats)
     n_folds = 0
     for step, (Xd, wd) in enumerate(
             prefetch_map(prep, _rechunk(source(), pad_rows), depth=2,
@@ -453,7 +458,10 @@ def score_stream(score_fn, source: Callable[[], Iterator[Chunk]],
         raise NotImplementedError(
             "score_stream writes one local file; in multi-process mode "
             "score each process's shard to its own path explicitly")
+    from orange3_spark_tpu.resilience.retry import resilient_source
+
     session = session or TpuSession.builder_get_or_create()
+    source = resilient_source(source)
     pad_rows = session.pad_rows(chunk_rows)
     row_sh = session.row_sharding
 
@@ -569,6 +577,15 @@ class StreamingLinearParams(Params):
     # is preserved by clamping groups at snapshot boundaries
     # (run_epoch_replay). Ignored under granularity 'all'.
     epochs_per_dispatch: int = 1
+    # Crash-resumable fits (docs/resilience.md): with a checkpointer
+    # passed to fit_stream, K > 0 switches the snapshot cadence from
+    # per-step (checkpointer.every_steps) to EPOCH BOUNDARIES every K
+    # epochs — atomic write-to-temp + rename, so a fit SIGKILLed
+    # mid-epoch resumes at the last boundary and replays the identical
+    # step sequence (bitwise-equal final theta; pinned in
+    # tests/test_resilience.py). Inert under OTPU_RESILIENCE=0 (the
+    # legacy fail-fast ladder) and without a checkpointer.
+    checkpoint_every_epochs: int = 0
     # Cache/spill storage precision (io/codec.py; resolved ONCE at fit
     # entry, OTPU_CACHE_DTYPE kill-switch): 'f32' is the legacy layout,
     # bit-for-bit; 'bf16' stores the cached/spilled feature matrix as
@@ -710,15 +727,23 @@ class DiskChunkCache:
     epochs 2+ at disk/page-cache bandwidth — the fixed-shape records need
     zero parsing, just a read + DMA.
 
-    Format (version 1, self-describing): an ``OTPUSPL1`` magic + JSON
+    Format (version 2, self-describing): an ``OTPUSPL1`` magic + JSON
     header (shapes + dtypes, 8-byte padded), then fixed-size records —
-    each a little-endian u32 live-row count followed by the fields'
-    raw bytes in declaration order, every field 8-byte aligned. ``dtypes``
-    defaults to all-f32 (the legacy layout); the cache-codec path stores
-    bf16 / u8 / bit-packed-u32 fields directly, so spill I/O shrinks with
-    the cache (io/codec.py). Headerless flat-f32 files — the pre-header
-    format (version 0) — remain readable through :meth:`attach`, which
-    sniffs the magic and falls back to caller-supplied shapes.
+    each a little-endian u32 live-row count, a u32 CRC32 of the record's
+    payload bytes, then the fields' raw bytes in declaration order, every
+    field 8-byte aligned. The CRC occupies what version 1 left as pad
+    bytes, so the record layout (and every field offset) is IDENTICAL to
+    v1 — v2 only gives meaning to four zero bytes. ``read`` verifies the
+    CRC (resilience kill-switch-gated) and raises a descriptive
+    ``SpillCorruptionError`` naming the record ordinal instead of
+    decoding a truncated or bit-flipped record into a 100-epoch replay;
+    ``finalize``/``attach`` likewise refuse a file whose size is not a
+    whole number of records (a crash mid-write). ``dtypes`` defaults to
+    all-f32 (the legacy layout); the cache-codec path stores bf16 / u8 /
+    bit-packed-u32 fields directly, so spill I/O shrinks with the cache
+    (io/codec.py). Version-1 files (same layout, no CRC) and headerless
+    flat-f32 files (version 0) remain readable through :meth:`attach`,
+    which sniffs the magic/header and skips verification for them.
 
     Single writer (the prefetch thread), then ``finalize()`` flips it to a
     read-only memmap. By default the file is unlinked the moment it is
@@ -743,11 +768,12 @@ class DiskChunkCache:
         if len(self.dtypes) != len(self.shapes):
             raise ValueError("one dtype per field")
         self._init_layout()
+        self._version = 2
         os.makedirs(dir_path, exist_ok=True)
         self.path = os.path.join(dir_path, f"spill_{uuid.uuid4().hex}.otpu")
         self._f: object | None = open(self.path, "w+b")
         header = _json.dumps({
-            "version": 1,
+            "version": 2,
             "shapes": self.shapes,
             "dtypes": [dt.name for dt in self.dtypes],
         }).encode()
@@ -762,11 +788,13 @@ class DiskChunkCache:
             self, _spill_cleanup, self._f, self.path, self._named)
         self.n_valid: list[int] = []
         self._mm: np.memmap | None = None
+        self._crc_ok: set[int] = set()   # record ordinals already verified
 
     def _init_layout(self) -> None:
-        """Record layout: u32 n_valid (+4 pad), then each field at the next
-        8-aligned offset — alignment keeps the read-side dtype views (and
-        the DMA they feed) on natural boundaries."""
+        """Record layout: u32 n_valid + u32 payload CRC32 (v1 wrote pad
+        zeros there — same offsets), then each field at the next 8-aligned
+        offset — alignment keeps the read-side dtype views (and the DMA
+        they feed) on natural boundaries."""
         self._field_bytes = [int(np.prod(s)) * dt.itemsize
                              for s, dt in zip(self.shapes, self.dtypes)]
         #: bytes of one record's ARRAYS — what a device_put of the record
@@ -799,6 +827,7 @@ class DiskChunkCache:
         obj._finalizer = weakref.finalize(
             obj, _spill_cleanup, obj._f, path, obj._named)
         obj._mm = None
+        obj._crc_ok = set()
         magic = obj._f.read(len(cls.MAGIC))
         if magic == cls.MAGIC:
             (hlen,) = struct.unpack("<I", obj._f.read(4))
@@ -812,7 +841,7 @@ class DiskChunkCache:
             obj._init_layout()
             head = len(cls.MAGIC) + 4 + hlen
             obj._data_start = head + (-head % 8)
-            obj._version = 1
+            obj._version = int(layout.get("version", 1))
         else:
             if shapes is None:
                 raise ValueError(
@@ -833,10 +862,25 @@ class DiskChunkCache:
             obj._version = 0
         n_bytes = os.path.getsize(path) - obj._data_start
         n_rec = n_bytes // obj.record_bytes if obj.record_bytes else 0
+        if obj._version >= 1 and obj.record_bytes \
+                and n_bytes % obj.record_bytes:
+            # a versioned file is written in whole records; a ragged tail
+            # means the writer crashed mid-record (or the file was cut) —
+            # refuse rather than silently drop/garble the final record.
+            # Version-0 files keep the legacy lenient floor: they carry
+            # no contract to check against.
+            from orange3_spark_tpu.io.codec import SpillCorruptionError
+
+            raise SpillCorruptionError(
+                f"spill file {path!r} is truncated: {n_bytes} data bytes "
+                f"is not a whole number of {obj.record_bytes}-byte "
+                f"records — record {n_rec} (of {n_rec + 1} started) was "
+                "cut mid-write"
+            )
         obj._mm = np.memmap(obj._f, dtype=np.uint8, mode="r",
                             offset=obj._data_start,
                             shape=(n_rec, obj.record_bytes))
-        if obj._version == 1:
+        if obj._version >= 1:
             import struct as _s
 
             obj.n_valid = [
@@ -849,14 +893,40 @@ class DiskChunkCache:
 
     def append(self, arrays: tuple, n_valid: int) -> None:
         import struct
+        import zlib
 
-        self._f.write(struct.pack("<Ixxxx", int(n_valid)))
-        written = 8
-        for a, shape, dt, ofs, nb in zip(arrays, self.shapes, self.dtypes,
-                                         self._offsets, self._field_bytes):
+        arrs = []
+        for a, shape, dt in zip(arrays, self.shapes, self.dtypes):
             a = np.ascontiguousarray(a, dtype=dt)
             if a.shape != shape:
                 raise ValueError(f"spill record shape {a.shape} != {shape}")
+            arrs.append(a)
+        # one extra pass over the record's bytes BEFORE writing: the CRC
+        # must land in the header word, and crc32 runs at memory speed —
+        # noise against the disk write it guards
+        crc = 0
+        written = 8
+        for a, ofs, nb in zip(arrs, self._offsets, self._field_bytes):
+            pad = ofs - written
+            if pad:
+                crc = zlib.crc32(b"\0" * pad, crc)
+            crc = zlib.crc32(a, crc)
+            written = ofs + nb
+        tail = self.record_bytes - written
+        if tail:
+            crc = zlib.crc32(b"\0" * tail, crc)
+        # write-side fault injection (resilience/faults.py spill_corrupt):
+        # the CRC above covers the TRUE bytes, so a flipped byte trips the
+        # read-side check exactly like real silent corruption would
+        from orange3_spark_tpu.resilience.faults import active_fault_spec
+
+        spec = active_fault_spec()
+        action = (spec.take_spill_corrupt(len(self.n_valid))
+                  if spec is not None else None)
+        rec_start = self._f.tell()
+        self._f.write(struct.pack("<II", int(n_valid), crc & 0xFFFFFFFF))
+        written = 8
+        for a, ofs, nb in zip(arrs, self._offsets, self._field_bytes):
             pad = ofs - written
             if pad:
                 self._f.write(b"\0" * pad)
@@ -865,6 +935,20 @@ class DiskChunkCache:
         tail = self.record_bytes - written
         if tail:
             self._f.write(b"\0" * tail)
+        if action == "flip":
+            end = self._f.tell()
+            pos = rec_start + self._offsets[0]
+            self._f.seek(pos)
+            b = self._f.read(1)
+            self._f.seek(pos)
+            self._f.write(bytes([b[0] ^ 0x01]))
+            self._f.seek(end)
+        elif action == "truncate":
+            # a crash mid-write: only half the record reaches disk (the
+            # bookkeeping below still counts it, as the dead writer's
+            # in-memory state did) — caught by finalize/attach
+            self._f.truncate(rec_start + self.record_bytes // 2)
+            self._f.seek(rec_start + self.record_bytes // 2)
         self.n_valid.append(int(n_valid))
 
     @property
@@ -874,14 +958,67 @@ class DiskChunkCache:
     def finalize(self) -> None:
         if self._mm is None and self._f is not None and self.n_valid:
             self._f.flush()
+            expected = (self._data_start
+                        + self.n_records * self.record_bytes)
+            actual = os.fstat(self._f.fileno()).st_size
+            if actual != expected:
+                # a record the writer believes it appended never fully
+                # reached disk (crash/injection mid-write) — refuse to
+                # replay a stream that is missing bytes
+                from orange3_spark_tpu.io.codec import SpillCorruptionError
+
+                raise SpillCorruptionError(
+                    f"spill file {self.path!r} holds {actual} bytes where "
+                    f"{expected} were written ({self.n_records} records x "
+                    f"{self.record_bytes} B): record "
+                    f"{max(0, (actual - self._data_start) // self.record_bytes)}"
+                    " was truncated mid-write"
+                )
             self._mm = np.memmap(self._f, dtype=np.uint8, mode="r",
                                  offset=self._data_start,
                                  shape=(self.n_records, self.record_bytes))
 
     def read(self, i: int) -> tuple[tuple, int]:
         """Record i as typed array views into the memmap (the device_put
-        reads pages straight out of it — no intermediate host copy)."""
+        reads pages straight out of it — no intermediate host copy).
+        Version-2 records verify their payload CRC32 first (skipped under
+        ``OTPU_RESILIENCE=0`` and for pre-CRC versions): a mismatch
+        raises ``SpillCorruptionError`` naming the record ordinal instead
+        of decoding garbage into the replay."""
         rec = self._mm[i]
+        if getattr(self, "_version", 0) >= 2 and i not in self._crc_ok:
+            from orange3_spark_tpu.resilience.faults import (
+                resilience_enabled,
+            )
+
+            if resilience_enabled():
+                import struct
+                import zlib
+
+                stored = struct.unpack_from("<I", rec[4:8].tobytes())[0]
+                computed = zlib.crc32(rec[8:]) & 0xFFFFFFFF
+                if stored != computed:
+                    from orange3_spark_tpu.io.codec import (
+                        SpillCorruptionError,
+                    )
+                    from orange3_spark_tpu.utils.profiling import (
+                        record_crc_failure,
+                    )
+
+                    record_crc_failure()
+                    raise SpillCorruptionError(
+                        f"spill record {i} of {self.n_records} in "
+                        f"{self.path!r} failed CRC verification (stored "
+                        f"0x{stored:08x} != computed 0x{computed:08x}): "
+                        "the record was corrupted on disk. Delete the "
+                        "spill and re-run the fit (OTPU_RESILIENCE=0 "
+                        "skips verification)."
+                    )
+                # the file is immutable after finalize(): verify each
+                # record ONCE, not once per replay epoch — a 100-epoch
+                # disk replay must not pay a 99x recurring CRC tax on a
+                # path whose whole value is "read + DMA, no parse"
+                self._crc_ok.add(i)
         out = []
         for shape, dt, ofs, nb in zip(self.shapes, self.dtypes,
                                       self._offsets, self._field_bytes):
@@ -1079,9 +1216,37 @@ def check_replay_granularity(value: str) -> None:
         )
 
 
+def resolve_epoch_checkpointing(params, checkpointer) -> int:
+    """THE resolver for ``checkpoint_every_epochs`` (docs/resilience.md),
+    shared by the linear and hashed estimators so the arming rule cannot
+    drift: the epoch cadence is live only with a checkpointer, a positive
+    K, and outside the ``OTPU_RESILIENCE=0`` kill-switch. Returns K (the
+    cadence) or 0 (legacy per-step ``maybe_save`` cadence)."""
+    from orange3_spark_tpu.resilience.faults import resilience_enabled
+
+    k = getattr(params, "checkpoint_every_epochs", 0)
+    return (k if (checkpointer is not None and k > 0
+                  and resilience_enabled()) else 0)
+
+
+def epoch_boundary_snapshot(checkpointer, every_epochs: int, epoch: int,
+                            defer: bool, n_steps: int, resume_from: int,
+                            snapshot, meta) -> None:
+    """One epoch-boundary save decision for every streaming epoch path
+    (live stream / HBM replay / disk replay) in every estimator — the
+    fused-replay twin lives in ``run_epoch_replay``. A defer fit's
+    step-free ingest pass contributes zero trained epochs; pure
+    fast-forward epochs (``n_steps <= resume_from``) rewrite nothing."""
+    trained = epoch + 1 - (1 if defer else 0)
+    if (every_epochs and trained > 0 and trained % every_epochs == 0
+            and n_steps > resume_from):
+        checkpointer.save(n_steps, snapshot(), meta=meta)
+
+
 def run_epoch_replay(n_replay, spe, n_steps, resume_from, checkpointer,
                      dispatch_epochs, snapshot, ckpt_meta,
-                     epochs_per_dispatch: int = 1):
+                     epochs_per_dispatch: int = 1,
+                     every_epochs: int = 0):
     """The per-epoch replay protocol shared by the streaming estimators
     (linear, hashed, kmeans): fast-forward whole checkpointed epochs
     without dispatching them, dispatch the remaining epochs in groups of
@@ -1100,8 +1265,12 @@ def run_epoch_replay(n_replay, spe, n_steps, resume_from, checkpointer,
     ``dispatch_epochs(k)`` runs k epochs in one dispatch and returns the
     value to block on; ``snapshot()`` returns the state dict to
     checkpoint. Returns ``(n_steps, last, n_dispatched)`` — ``last`` is
-    None when every epoch was fast-forwarded (resume-at-completion)."""
-    save_every = (max(1, checkpointer.every_steps // spe)
+    None when every epoch was fast-forwarded (resume-at-completion).
+
+    ``every_epochs``: explicit epoch-cadence snapshots (the params'
+    ``checkpoint_every_epochs`` knob, docs/resilience.md) — overrides the
+    every_steps-derived cadence when > 0."""
+    save_every = ((every_epochs or max(1, checkpointer.every_steps // spe))
                   if checkpointer is not None else 0)
     group = max(1, int(epochs_per_dispatch))
     last = None
@@ -1205,6 +1374,9 @@ class StreamingKMeans(Estimator):
 
         p = self.params
         check_replay_granularity(p.replay_granularity)
+        from orange3_spark_tpu.resilience.retry import resilient_source
+
+        source = resilient_source(source)
         session = session or TpuSession.active()
         pad_rows = session.pad_rows(p.chunk_rows)
         row_sh = session.row_sharding
@@ -1391,6 +1563,11 @@ class StreamingLinearEstimator(Estimator):
         re-parse); without it, every epoch re-runs the source, loudly."""
         p = self.params
         check_replay_granularity(p.replay_granularity)
+        from orange3_spark_tpu.resilience.retry import resilient_source
+
+        # THE source chokepoint (docs/resilience.md): fault injection +
+        # bounded transient-read retries wrap every epoch's stream
+        source = resilient_source(source)
         session = session or TpuSession.active()
         if p.loss == "logistic":
             if class_values is not None:
@@ -1412,6 +1589,11 @@ class StreamingLinearEstimator(Estimator):
         opt_state = _ADAM_UNIT.init(theta)
         resume_from = 0
         ckpt_meta = {"params": p.to_dict(), "n_features": n_features, "k": k}
+        # epoch-cadence snapshots (checkpoint_every_epochs, the
+        # crash-resume contract): when armed, per-step maybe_save is
+        # replaced by atomic saves at epoch boundaries every K epochs.
+        # Inert under the OTPU_RESILIENCE=0 kill-switch (legacy cadence).
+        ckpt_epochs = resolve_epoch_checkpointing(p, checkpointer)
         if checkpointer is not None:
             step0, saved = checkpointer.load(expect_meta=ckpt_meta)
             if saved is not None:
@@ -1469,11 +1651,21 @@ class StreamingLinearEstimator(Estimator):
             n_steps += 1
             last_loss = loss
             bound_dispatch(n_steps, loss)  # utils/dispatch.py: queue cap
-            if checkpointer is not None:
+            if checkpointer is not None and not ckpt_epochs:
                 checkpointer.maybe_save(
                     n_steps, {"theta": theta, "opt_state": opt_state},
                     meta=ckpt_meta,
                 )
+
+        def epoch_snapshot(epoch):
+            # one shared save decision (epoch_boundary_snapshot) — called
+            # at the end of every trained epoch, whatever path ran it
+            epoch_boundary_snapshot(
+                checkpointer, ckpt_epochs, epoch, defer, n_steps,
+                resume_from,
+                lambda: {"theta": theta, "opt_state": opt_state},
+                ckpt_meta,
+            )
 
         for epoch in range(p.epochs + (1 if defer else 0)):
             if epoch > 0 and cache.enabled:
@@ -1483,6 +1675,7 @@ class StreamingLinearEstimator(Estimator):
                         n_steps += 1
                         continue
                     run_step(Xd, yd, wd)
+                epoch_snapshot(epoch)
                 continue
             if epoch > 0 and use_disk:
                 # overflow epoch off the disk spill: read + DMA, no parse.
@@ -1500,6 +1693,7 @@ class StreamingLinearEstimator(Estimator):
                 for Xd, yd, wd in prefetch_map(
                         _rec, iter(range(skip, spill.n_records)), depth=2):
                     run_step(Xd, yd, wd)
+                epoch_snapshot(epoch)
                 continue
             for X_np, y_np, w_np in _rechunk(source(), pad_rows):
                 if n_steps < resume_from and not (
@@ -1544,6 +1738,7 @@ class StreamingLinearEstimator(Estimator):
                     n_steps += 1  # fast-forward past checkpointed batches
                     continue
                 run_step(Xd, yd, wd)
+            epoch_snapshot(epoch)
             if epoch == 0:
                 if spill is not None:
                     spill.finalize()
@@ -1596,6 +1791,7 @@ class StreamingLinearEstimator(Estimator):
                         lambda: {"theta": theta, "opt_state": opt_state},
                         ckpt_meta,
                         epochs_per_dispatch=p.epochs_per_dispatch,
+                        every_epochs=ckpt_epochs,
                     )
                     if last is not None:
                         last_loss = last

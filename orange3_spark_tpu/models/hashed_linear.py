@@ -154,6 +154,14 @@ class HashedLinearParams(Params):
     # 'all' a checkpointered fit silently keeps the default schedule,
     # whose per-chunk dispatches give step-granular snapshots.
     defer_epoch1: bool = False
+    # Crash-resumable fits (docs/resilience.md): with a checkpointer
+    # passed to fit_stream, K > 0 switches the snapshot cadence from
+    # per-step (checkpointer.every_steps) to EPOCH BOUNDARIES every K
+    # epochs — atomic write-to-temp + rename, so a fit SIGKILLed
+    # mid-epoch resumes at the last boundary and replays the identical
+    # step sequence. Inert under OTPU_RESILIENCE=0 and without a
+    # checkpointer (same contract as StreamingLinearParams).
+    checkpoint_every_epochs: int = 0
     # value-weighted sparse rows (MLlib SparseVector semantics): chunks
     # carry n_cat (index, value) PAIRS — [label?, idx..., val...] — and the
     # forward is sum(emb[hash(idx)] * val), io/libsvm.py's fixed-nnz
@@ -1290,6 +1298,7 @@ class StreamingHashedLinearEstimator(Estimator):
         """
         from orange3_spark_tpu.io.streaming import (
             DiskChunkCache, _pad_chunk, _rechunk, check_replay_granularity,
+            epoch_boundary_snapshot, resolve_epoch_checkpointing,
             warn_cache_overflow,
         )
 
@@ -1303,6 +1312,9 @@ class StreamingHashedLinearEstimator(Estimator):
         )
         resume_from = 0
         ckpt_meta = {"params": p.to_dict(), "k": k}
+        # epoch-cadence snapshots (checkpoint_every_epochs): the shared
+        # arming rule — see StreamingLinearParams for the contract
+        ckpt_epochs = resolve_epoch_checkpointing(p, checkpointer)
         if checkpointer is not None:
             step0, saved = checkpointer.load(expect_meta=ckpt_meta)
             if saved is not None:
@@ -1341,6 +1353,12 @@ class StreamingHashedLinearEstimator(Estimator):
         # disk replay, grouped disk replay) folds in, so overlap_pct is the
         # measured host-prep/device-compute overlap of the WHOLE fit
         pipe_stats = PipelineStats()
+        # THE source chokepoint (docs/resilience.md): fault injection +
+        # bounded transient-read retries on the prefetch thread; retries
+        # count into pipe_stats (the bench line's `retries` field)
+        from orange3_spark_tpu.resilience.retry import resilient_source
+
+        source = resilient_source(source, stats=pipe_stats)
 
         def put_payload(payload):
             """Device-put one chunk payload: the raw [N, cols] array, or
@@ -1578,7 +1596,7 @@ class StreamingHashedLinearEstimator(Estimator):
             n_steps += 1
             last_loss = loss
             bound_dispatch(n_steps, loss, period=step_period)
-            if checkpointer is not None:
+            if checkpointer is not None and not ckpt_epochs:
                 checkpointer.maybe_save(
                     n_steps, {"theta": theta, "opt_state": opt_state},
                     meta=ckpt_meta,
@@ -1797,6 +1815,14 @@ class StreamingHashedLinearEstimator(Estimator):
                             n_steps += 1
                             continue
                         run_step(dev_chunk)
+            # epoch-boundary snapshot (checkpoint_every_epochs cadence):
+            # the shared save decision covers every epoch path above
+            epoch_boundary_snapshot(
+                checkpointer, ckpt_epochs, epoch, defer, n_steps,
+                resume_from,
+                lambda: {"theta": theta, "opt_state": opt_state},
+                ckpt_meta,
+            )
             if stage_times is not None:
                 if last_loss is not None:
                     jax.block_until_ready(last_loss)  # honest epoch wall
@@ -1855,6 +1881,7 @@ class StreamingHashedLinearEstimator(Estimator):
                         lambda: {"theta": theta, "opt_state": opt_state},
                         ckpt_meta,
                         epochs_per_dispatch=p.epochs_per_dispatch,
+                        every_epochs=ckpt_epochs,
                     )
                     if last is not None:
                         last_loss = last

@@ -30,6 +30,23 @@ from typing import Any, Callable
 from orange3_spark_tpu.utils.profiling import record_serve
 
 
+def _build_resilient(key, build):
+    """One AOT build with the resilience wrap: fault injection inside the
+    attempt (so a retried attempt consumes the injected budget) and
+    bounded transient-error retries around it. ``retry_call`` is a plain
+    single attempt under the kill-switch."""
+    from orange3_spark_tpu.resilience.faults import active_fault_spec
+    from orange3_spark_tpu.resilience.retry import retry_call
+
+    def attempt():
+        spec = active_fault_spec()
+        if spec is not None:
+            spec.maybe_fail_aot_build(key)
+        return build()
+
+    return retry_call(attempt, cause="aot_build")
+
+
 class ExecutableCache:
     """Thread-safe LRU of compiled executables (or any build product).
 
@@ -44,6 +61,12 @@ class ExecutableCache:
     ``on_evict(key)`` (optional) fires outside the lock for every entry
     the LRU drops — the owning context uses it to release per-model /
     per-graph pins whose executables are all gone.
+
+    Builds retry transient failures with bounded backoff
+    (resilience/retry.py): a tunnel blip during a warmup compile costs a
+    retry instead of blacklisting the model for the process lifetime.
+    Fail-fast under ``OTPU_RESILIENCE=0``; the ``aot_build`` fault kind
+    injects the transient failure deterministically for tests/bench.
     """
 
     def __init__(self, max_entries: int = 64,
@@ -88,7 +111,7 @@ class ExecutableCache:
             return entry
         t0 = time.perf_counter()
         try:
-            entry = build()
+            entry = _build_resilient(key, build)
         except BaseException as e:
             with self._lock:
                 del self._building[key]

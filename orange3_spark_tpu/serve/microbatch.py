@@ -21,14 +21,24 @@ participating future (callers see the real error, not a hang). ``submit``
 and ``close`` are mutually exclusive, so the shutdown sentinel is always
 the LAST item the worker sees — everything ahead of it flushes normally
 and no future is ever abandoned behind it.
+
+Deadline semantics (resilience/): every returned future carries a hard
+deadline (``deadline_s``, env ``OTPU_MB_DEADLINE_S``, default 30 s) — if
+the worker thread dies or its dispatch wedges, ``result()`` raises a
+typed ``MicroBatchTimeoutError`` naming the request's group key instead
+of blocking the caller forever. A worker found dead at ``submit`` time
+sheds the request to direct dispatch (``submit`` returns None). Disabled
+(legacy block-forever futures) under ``OTPU_RESILIENCE=0``.
 """
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutTimeout
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -38,6 +48,50 @@ from orange3_spark_tpu.utils.dispatch import beat
 from orange3_spark_tpu.utils.profiling import record_serve
 
 _SENTINEL = object()
+
+
+class MicroBatchTimeoutError(TimeoutError):
+    """A micro-batched request's future missed its hard deadline — the
+    coalescer thread died or its merged dispatch wedged. Carries the
+    request's ``group_key`` (model fingerprint / schema / session) so the
+    stuck endpoint is identifiable from the error alone."""
+
+    def __init__(self, group_key, waited_s: float):
+        self.group_key = group_key
+        self.waited_s = waited_s
+        super().__init__(
+            f"micro-batched request (group_key={group_key!r}) got no "
+            f"result within its {waited_s:.3g}s deadline: the dispatch "
+            "thread died or its device dispatch wedged. Direct dispatch "
+            "(micro_batch=False) or OTPU_MB_DEADLINE_S tune the deadline; "
+            "OTPU_RESILIENCE=0 restores unbounded waits."
+        )
+
+
+class _DeadlineFuture(Future):
+    """A Future whose no-timeout ``result()``/``exception()`` default to
+    the micro-batcher's hard deadline instead of blocking forever."""
+
+    _deadline_s: float | None = None
+    _group_key = None
+
+    def result(self, timeout=None):
+        eff = timeout if timeout is not None else self._deadline_s
+        if eff is None:
+            return super().result()
+        try:
+            return super().result(eff)
+        except _FutTimeout:
+            raise MicroBatchTimeoutError(self._group_key, eff) from None
+
+    def exception(self, timeout=None):
+        eff = timeout if timeout is not None else self._deadline_s
+        if eff is None:
+            return super().exception()
+        try:
+            return super().exception(eff)
+        except _FutTimeout:
+            raise MicroBatchTimeoutError(self._group_key, eff) from None
 
 
 @dataclass
@@ -66,10 +120,23 @@ class MicroBatcher:
     """Bounded background coalescer; see module docstring."""
 
     def __init__(self, ctx, *, max_batch: int = 4096,
-                 max_wait_ms: float = 2.0, queue_depth: int = 1024):
+                 max_wait_ms: float = 2.0, queue_depth: int = 1024,
+                 deadline_s: float | None = None):
         self.ctx = ctx
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1e3
+        # hard future deadline; None = legacy block-forever (kill-switch)
+        from orange3_spark_tpu.resilience.faults import resilience_enabled
+
+        if deadline_s is None and resilience_enabled():
+            try:
+                deadline_s = float(
+                    os.environ.get("OTPU_MB_DEADLINE_S", "") or 30.0)
+            except ValueError:
+                deadline_s = 30.0   # malformed knob: default, don't crash
+                #                     the serving-context activation path
+        self.deadline_s = (deadline_s if deadline_s and deadline_s > 0
+                           and resilience_enabled() else None)
         self._q: queue.Queue = queue.Queue(maxsize=queue_depth)
         self._closed = False
         self._close_lock = threading.Lock()
@@ -81,15 +148,21 @@ class MicroBatcher:
     def submit(self, kind: str, rec, arrays, n: int, *,
                meta) -> Future | None:
         """Enqueue one request; returns its Future, or None when this
-        request cannot micro-batch (oversized, full queue, or the batcher
-        is closed / called from its own worker — the caller then
-        direct-dispatches)."""
+        request cannot micro-batch (oversized, full queue, dead worker
+        thread, or the batcher is closed / called from its own worker —
+        the caller then direct-dispatches)."""
         if (self._closed or n > self.max_batch
-                or threading.current_thread() is self._thread):
+                or threading.current_thread() is self._thread
+                # a dead worker would never drain the queue: shed to
+                # direct dispatch instead of parking a doomed future
+                or not self._thread.is_alive()):
             return None
+        fut = _DeadlineFuture()
+        fut._deadline_s = self.deadline_s
         req = _Request(kind, rec, tuple(
             np.asarray(a) if a is not None else None for a in arrays
-        ), n, meta)
+        ), n, meta, future=fut)
+        fut._group_key = req.group_key
         # atomic with close(): no request can land BEHIND the shutdown
         # sentinel, where the worker would exit without resolving its
         # future and the caller would block in fut.result() forever

@@ -14,8 +14,6 @@ from __future__ import annotations
 
 import time
 
-import jax
-
 from orange3_spark_tpu.utils.profiling import count_dispatch
 
 #: steps between synchronizations; small enough to cap rendezvous pressure,
@@ -47,9 +45,18 @@ def bound_dispatch(step: int, token, period: int = DISPATCH_SYNC_PERIOD) -> None
     every sequential step loop calls this once per dispatched program, so
     the counter is the bench line's ``dispatches`` field for free — only
     the one-shot fused-scan sites (which never loop) tick it explicitly.
+
+    The periodic sync is the ONE place every step loop can block forever
+    on a wedged device, so it routes through the resilience watchdog
+    (resilience/watchdog.py): with ``OTPU_DISPATCH_BUDGET_S`` set, a sync
+    exceeding the budget raises a typed ``DispatchWedgedError`` with
+    diagnostics instead of hanging the process (no budget/no fault spec =
+    a plain ``block_until_ready``, same as ever).
     """
     beat()
     count_dispatch()
     if step % period == 0:
-        jax.block_until_ready(token)
+        from orange3_spark_tpu.resilience.watchdog import maybe_guarded_block
+
+        maybe_guarded_block(token, step=step)
         beat()

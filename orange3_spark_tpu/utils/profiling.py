@@ -36,6 +36,7 @@ _exec_counts = {
     "prefetch_items": 0,    # items through PipelinedExecutor streams
     "prefetch_prep_s": 0.0,  # producer busy seconds (parse/pad/device_put)
     "prefetch_wait_s": 0.0,  # consumer blocked seconds
+    "prefetch_retries": 0,   # transient source reads retried (resilience/)
 }
 
 
@@ -51,6 +52,7 @@ def record_pipeline(stats) -> None:
         _exec_counts["prefetch_items"] += stats.items
         _exec_counts["prefetch_prep_s"] += stats.prep_s
         _exec_counts["prefetch_wait_s"] += stats.wait_s
+        _exec_counts["prefetch_retries"] += stats.retries
 
 
 def exec_counters() -> dict:
@@ -123,6 +125,64 @@ def reset_serve_counters() -> None:
     with _exec_lock:
         for k in _serve_counts:
             _serve_counts[k] = type(_serve_counts[k])()
+
+
+# --------------------------------------------------- resilience/ counters
+# Process-wide aggregates for the resilience subsystem (docs/resilience.md):
+# the fault injectors tick faults_injected per kind, the retry policy ticks
+# retries per CAUSE ('source' = chunk-source reads, 'aot_build' = serving
+# executable builds) plus the backoff seconds it cost, the dispatch
+# watchdog ticks wedges, and the spill CRC verifier ticks crc_failures —
+# the source of the bench fault arm's retries/faults_injected fields.
+_res_counts = {
+    "faults_injected": 0,   # injector firings (all kinds)
+    "retries": 0,           # transient-failure retries (all causes)
+    "retry_wait_s": 0.0,    # total backoff slept
+    "wedges": 0,            # DispatchWedgedError raised by the watchdog
+    "crc_failures": 0,      # spill records failing CRC verification
+}
+_res_by_cause: dict = {}    # retries per cause
+_fault_by_kind: dict = {}   # injections per fault kind
+
+
+def record_retry(cause: str, wait_s: float = 0.0) -> None:
+    with _exec_lock:
+        _res_counts["retries"] += 1
+        _res_counts["retry_wait_s"] += wait_s
+        _res_by_cause[cause] = _res_by_cause.get(cause, 0) + 1
+
+
+def record_fault(kind: str) -> None:
+    with _exec_lock:
+        _res_counts["faults_injected"] += 1
+        _fault_by_kind[kind] = _fault_by_kind.get(kind, 0) + 1
+
+
+def record_wedge() -> None:
+    with _exec_lock:
+        _res_counts["wedges"] += 1
+
+
+def record_crc_failure() -> None:
+    with _exec_lock:
+        _res_counts["crc_failures"] += 1
+
+
+def resilience_counters() -> dict:
+    """Snapshot: the flat counters plus per-cause/per-kind breakdowns."""
+    with _exec_lock:
+        out = dict(_res_counts)
+        out["retries_by_cause"] = dict(_res_by_cause)
+        out["faults_by_kind"] = dict(_fault_by_kind)
+    return out
+
+
+def reset_resilience_counters() -> None:
+    with _exec_lock:
+        for k in _res_counts:
+            _res_counts[k] = type(_res_counts[k])()
+        _res_by_cause.clear()
+        _fault_by_kind.clear()
 
 
 # -------------------------------------------- XLA compilation counter

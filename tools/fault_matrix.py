@@ -1,0 +1,185 @@
+"""One-shot fault-injection matrix — every injector against a small fit.
+
+Runs each resilience/faults.py injector kind against the same tiny
+streaming fit and prints a table of outcome / retries / overhead, plus
+one JSON line (the capture-watcher banking convention). The matrix is the
+quick "is the whole resilience surface wired?" probe:
+
+  clean           no faults — the overhead denominator
+  source_io       fail-twice-then-succeed chunk read -> recovered,
+                  bitwise-equal theta, 2 retries
+  source_fatal    fail-always chunk read -> bounded attempts, then raises
+  straggler       slow chunks -> recovered, measured overhead
+  spill_corrupt   bit-flipped spill record -> SpillCorruptionError naming
+                  the ordinal (fit with an overflowed cache + disk spill)
+  wedge           never-returning dispatch -> DispatchWedgedError within
+                  the watchdog budget
+  aot_build       transient serving AOT build failure -> recovered with
+                  one retry through ExecutableCache
+
+Importable: ``run_matrix(rows=..., session=...)`` returns the row dicts
+(the not-slow smoke test in tests/test_resilience.py calls it directly).
+
+Usage:
+    python tools/fault_matrix.py [--rows 16384]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_matrix(rows: int = 16384, session=None) -> list:
+    import numpy as np
+
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.io.codec import SpillCorruptionError
+    from orange3_spark_tpu.io.streaming import (
+        StreamingLinearEstimator, array_chunk_source,
+    )
+    from orange3_spark_tpu.resilience import (
+        DispatchWedgedError, TransientSourceError, inject_faults,
+    )
+    from orange3_spark_tpu.utils.profiling import (
+        reset_resilience_counters, resilience_counters,
+    )
+
+    session = session or TpuSession.builder_get_or_create()
+    chunk_rows = 512
+    n_features = 4
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((rows, n_features)).astype(np.float32)
+    y = (X @ rng.standard_normal(n_features).astype(np.float32) > 0
+         ).astype(np.float32)
+    src = array_chunk_source(X, y, chunk_rows=chunk_rows)
+    # epochs x chunks must clear the period-16 dispatch sync (rows/512
+    # chunks per epoch) or the wedge cell's guarded sync never runs
+    est_kw = dict(loss="logistic", epochs=max(4, (17 * 512) // rows + 1),
+                  step_size=0.1, chunk_rows=chunk_rows)
+    # short backoff: the matrix measures recovery, not sleep policy
+    os.environ.setdefault("OTPU_RETRY_BASE_S", "0.005")
+
+    def fit(**kw):
+        return StreamingLinearEstimator(**est_kw).fit_stream(
+            src, n_features=n_features, session=session, **kw)
+
+    import jax
+
+    jax.block_until_ready(fit().coef)     # compile out of band
+
+    rows_out: list = []
+    t0 = time.perf_counter()
+    ref = fit()
+    wall_clean = time.perf_counter() - t0
+    rows_out.append({"cell": "clean", "outcome": "ok", "retries": 0,
+                     "faults_injected": 0,
+                     "wall_s": round(wall_clean, 3), "overhead_pct": 0.0})
+
+    def cell(name, spec, fn, expect=None):
+        reset_resilience_counters()
+        t0 = time.perf_counter()
+        outcome = "recovered"
+        try:
+            with inject_faults(spec):
+                fn()
+        except Exception as e:  # noqa: BLE001 - the outcome under test
+            outcome = f"raised:{type(e).__name__}"
+            if expect is not None and not isinstance(e, expect):
+                outcome = f"UNEXPECTED:{type(e).__name__}: {e}"
+        else:
+            if expect is not None:
+                outcome = "UNEXPECTED:no error raised"
+        wall = time.perf_counter() - t0
+        res = resilience_counters()
+        rows_out.append({
+            "cell": name, "outcome": outcome,
+            "retries": res["retries"],
+            "faults_injected": res["faults_injected"],
+            "wall_s": round(wall, 3),
+            "overhead_pct": round(
+                100.0 * (wall - wall_clean) / max(wall_clean, 1e-9), 1),
+        })
+
+    def parity_fit():
+        m = fit()
+        import numpy as _np
+
+        if not _np.array_equal(_np.asarray(m.coef), _np.asarray(ref.coef)):
+            raise AssertionError("recovered fit != fault-free fit")
+
+    cell("source_io", "source_io:chunk=2,fails=2", parity_fit)
+    cell("source_fatal", "source_io:chunk=1,fails=-1", fit,
+         expect=TransientSourceError)
+    cell("straggler", "slow_source:every=4,delay_ms=5", parity_fit)
+
+    spill_dir = tempfile.mkdtemp(prefix="otpu_fault_matrix_")
+
+    def spill_fit():
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")   # the overflow warning is
+            #                                   the scenario, not a bug
+            fit(cache_device=True, cache_device_bytes=1,
+                cache_spill_dir=spill_dir)
+
+    cell("spill_corrupt", "spill_corrupt:record=1,mode=flip", spill_fit,
+         expect=SpillCorruptionError)
+
+    old = os.environ.get("OTPU_DISPATCH_BUDGET_S")
+    os.environ["OTPU_DISPATCH_BUDGET_S"] = "0.2"
+    try:
+        cell("wedge", "wedge:at=1,hold_s=20", fit,
+             expect=DispatchWedgedError)
+    finally:
+        if old is None:
+            os.environ.pop("OTPU_DISPATCH_BUDGET_S", None)
+        else:
+            os.environ["OTPU_DISPATCH_BUDGET_S"] = old
+
+    def aot_fit():
+        from orange3_spark_tpu.serve.cache import ExecutableCache
+
+        cache = ExecutableCache(max_entries=4)
+        built = cache.get_or_build(("fault-matrix-key",), lambda: "entry")
+        if built != "entry":
+            raise AssertionError(f"unexpected build product {built!r}")
+
+    cell("aot_build", "aot_build:fails=1", aot_fit)
+    return rows_out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=16384)
+    args = ap.parse_args()
+    sys.path.insert(0, REPO)
+    results = run_matrix(rows=args.rows)
+    w = max(len(r["cell"]) for r in results)
+    print(f"{'cell':<{w}}  {'outcome':<28} {'retries':>7} "
+          f"{'faults':>6} {'wall_s':>7} {'overhead%':>9}", file=sys.stderr)
+    for r in results:
+        print(f"{r['cell']:<{w}}  {r['outcome']:<28} {r['retries']:>7} "
+              f"{r['faults_injected']:>6} {r['wall_s']:>7.3f} "
+              f"{r['overhead_pct']:>9.1f}", file=sys.stderr)
+    bad = [r for r in results if r["outcome"].startswith("UNEXPECTED")]
+    print(json.dumps({
+        "metric": "fault_matrix",
+        "value": len(results),
+        "unit": "cells_run",
+        "vs_baseline": None,
+        "cells_ok": len(results) - len(bad),
+        "cells": results,
+    }))
+    sys.exit(1 if bad else 0)
+
+
+if __name__ == "__main__":
+    main()

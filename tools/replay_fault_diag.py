@@ -106,13 +106,25 @@ CELLS = [
 ]
 
 
+# --smoke cell: exercises the subprocess/JSON plumbing (spawn, STAGE_OK
+# parsing, verdict emission) without importing jax or touching a device —
+# the not-slow tier-1 smoke test runs this so a refactor that breaks the
+# matrix harness fails in CI instead of in a scarce tunnel window
+_SMOKE_SRC = r"""
+import time
+print("STAGE_OK noop 0.0s", flush=True)
+print("CELL_OK", flush=True)
+"""
+
+
 def run_cell(name: str, emb: str, stages: list, chunk_rows: int,
-             wall_s: float) -> dict:
-    src = (_CELL_SRC
-           .replace("__REPO__", repr(REPO))
-           .replace("__CHUNK_ROWS__", str(chunk_rows))
-           .replace("__EMB__", repr(emb))
-           .replace("__STAGES__", repr(list(stages))))
+             wall_s: float, src_override: str | None = None) -> dict:
+    src = src_override if src_override is not None else (
+        _CELL_SRC
+        .replace("__REPO__", repr(REPO))
+        .replace("__CHUNK_ROWS__", str(chunk_rows))
+        .replace("__EMB__", repr(emb))
+        .replace("__STAGES__", repr(list(stages))))
     t0 = time.time()
     try:
         r = subprocess.run([sys.executable, "-c", src],
@@ -145,7 +157,17 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--chunk-rows", type=int, default=1 << 18)
     ap.add_argument("--wall-s", type=float, default=420.0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="plumbing smoke: one trivial no-jax cell, no "
+                         "device lock (the tier-1 not-slow smoke test)")
     args = ap.parse_args()
+
+    if args.smoke:
+        res = run_cell("smoke", "none", ["noop"], args.chunk_rows,
+                       60.0, src_override=_SMOKE_SRC)
+        print(json.dumps(res), flush=True)
+        print(json.dumps(_verdict([res], backend="none")), flush=True)
+        sys.exit(0 if res["ok"] else 1)
 
     # serialize against any other TPU harness for the WHOLE matrix (the
     # cells are this process's children and take no lock of their own —
@@ -157,14 +179,15 @@ def main() -> None:
         _main_locked(args)
 
 
-def _main_locked(args) -> None:
-    results = []
-    for name, emb, stages in CELLS:
-        res = run_cell(name, emb, stages, args.chunk_rows, args.wall_s)
-        print(json.dumps(res), flush=True)
-        results.append(res)
+def _verdict(results: list, backend: str = "tpu") -> dict:
     by = {r["cell"]: r for r in results}
-    verdict = {
+
+    def ok(cell):
+        r = by.get(cell)
+        return None if r is None else r["ok"]
+
+    base = by.get("base")
+    return {
         "metric": "replay_fault_diag",
         # value = cells RUN (nonzero whenever the matrix executed), so an
         # all-cells-fault outcome — a perfectly valid result — still
@@ -173,17 +196,26 @@ def _main_locked(args) -> None:
         "unit": "cells_run",
         "cells_ok": sum(r["ok"] for r in results),
         "vs_baseline": None,
-        "backend": "tpu",
-        "reproduced": not by["base"]["ok"] and by["base"]["device_fault"],
-        "fixed_by_fused_emb": by["embfused"]["ok"],
-        "fixed_by_epoch_granularity": by["epochwise"]["ok"],
-        "fixed_by_precompile": by["cached"]["ok"],
-        "fixed_by_freeing_warm": by["delwarm"]["ok"],
+        "backend": backend,
+        "reproduced": (None if base is None
+                       else (not base["ok"] and base["device_fault"])),
+        "fixed_by_fused_emb": ok("embfused"),
+        "fixed_by_epoch_granularity": ok("epochwise"),
+        "fixed_by_precompile": ok("cached"),
+        "fixed_by_freeing_warm": ok("delwarm"),
         # full per-cell records ride inside the banked line — the watcher
         # keeps only '"metric"' lines, and stdout is otherwise discarded
         "cells": results,
     }
-    print(json.dumps(verdict), flush=True)
+
+
+def _main_locked(args) -> None:
+    results = []
+    for name, emb, stages in CELLS:
+        res = run_cell(name, emb, stages, args.chunk_rows, args.wall_s)
+        print(json.dumps(res), flush=True)
+        results.append(res)
+    print(json.dumps(_verdict(results)), flush=True)
 
 
 if __name__ == "__main__":
