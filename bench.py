@@ -592,6 +592,7 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
     #     the TRUE DMA bandwidth (in-fit h2d_s only times the async enqueue)
     pure_step_ms = h2d_blocked_gbps = pure_step_ms_dense = None
     pure_step_ms_f32cache = None
+    obs_overhead_pct = pure_step_ms_obs = None
     probe_error = None
     if model.device_chunks_:
         # the probes run AFTER the timed window and the JSON must survive
@@ -621,9 +622,11 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
             h2d_blocked_gbps = round(
                 buf.nbytes / (time.perf_counter() - t0) / 1e9, 3)
 
-            def step_rate(est_arm, n_probe, chs):
-                """Per-chunk step time of one arm over device-cached
-                chunks — compile outside the timing, block once."""
+            def probe_setup(est_arm):
+                """Shared step-probe state (step_rate + the obs A/B arm):
+                a fresh theta/opt for the arm's resolved rule and the
+                per-chunk arg builder — ONE definition so the two probes
+                cannot drift onto different calling conventions."""
                 theta = jax.tree.map(jnp.copy, model.theta)
                 _, _, _, _, kw = _init_fit_state(est_arm.params, session)
                 opt = (_ADAM_UNIT.init(theta)
@@ -637,6 +640,12 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
                             jnp.float32(reg), jnp.float32(step_size),
                             plan, jnp.float32(0.0))
 
+                return theta, opt, kw, args
+
+            def step_rate(est_arm, n_probe, chs):
+                """Per-chunk step time of one arm over device-cached
+                chunks — compile outside the timing, block once."""
+                theta, opt, kw, args = probe_setup(est_arm)
                 theta, opt, loss = _hashed_step(
                     theta, opt, *args(chs[0]), **kw)
                 jax.block_until_ready(loss)
@@ -648,6 +657,71 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
                 return round((time.perf_counter() - t0) / n_probe * 1e3, 2)
 
             pure_step_ms = step_rate(est, 10, chunks)
+
+            # ---- obs A/B arm (obs/ subsystem) ----
+            # the SAME instrumented step loop, spans+registry ON vs the
+            # OTPU_OBS=0 kill-switch. Per-step blocked walls, compared by
+            # their MINIMUM: scheduler noise only ever ADDS time, so the
+            # per-arm floor converges on the true step cost and the
+            # difference isolates the instrumentation itself. The
+            # acceptance criterion is < 2% step-time overhead.
+            from orange3_spark_tpu.obs import trace as obs_trace
+            from orange3_spark_tpu.obs.trace import span as obs_span
+            from orange3_spark_tpu.utils.profiling import count_dispatch
+
+            def obs_ab_floors_ms(n_pairs, chs):
+                """Interleaved per-step blocked walls: one obs-on step,
+                one obs-off step, alternating, min per arm. Interleaving
+                exposes both arms to the SAME load window (a preempted
+                stretch inflates both, not just one), and the minimum
+                discards the inflated samples — the difference of the two
+                floors isolates the instrumentation itself."""
+                theta, opt, kw, args = probe_setup(est)
+                # no warm step: the pure_step_ms probe above already
+                # compiled this exact program, and min-of-N absorbs any
+                # residual first-iteration jitter
+                best_on = best_off = None
+                for i in range(2 * n_pairs):
+                    on = i % 2 == 0
+                    # pair the arms on the SAME chunk: sparse-plan step
+                    # time is data-dependent, and with an even chunk
+                    # count i % len(chs) would hand each arm a disjoint
+                    # chunk set — workload bias masquerading as overhead
+                    c = chs[(i // 2) % len(chs)]
+                    t0 = time.perf_counter()
+                    if on:
+                        # force-enable symmetrically with the off arm's
+                        # force_disabled: under ambient OTPU_OBS=0 the
+                        # span would no-op and the A/B would bank a
+                        # vacuous no-op-vs-no-op overhead claim
+                        with obs_trace.force_enabled():
+                            with obs_span("chunk", i):
+                                theta, opt, loss = _hashed_step(
+                                    theta, opt, *args(c), **kw)
+                                count_dispatch()
+                    else:
+                        with obs_trace.force_disabled():
+                            with obs_span("chunk", i):   # no-op arm
+                                theta, opt, loss = _hashed_step(
+                                    theta, opt, *args(c), **kw)
+                                count_dispatch()
+                    jax.block_until_ready(loss)
+                    dt = time.perf_counter() - t0
+                    if on:
+                        best_on = dt if best_on is None else min(best_on, dt)
+                    else:
+                        best_off = (dt if best_off is None
+                                    else min(best_off, dt))
+                return best_on * 1e3, best_off * 1e3
+
+            # contract-sized runs keep the probe cheap (the number is a
+            # smoke there, not a record — the f32 arm's convention)
+            n_pairs = 6 if n_rows > 100_000 else 3
+            on_ms, off_ms = obs_ab_floors_ms(n_pairs, chunks)
+            pure_step_ms_obs = round(on_ms, 2)
+            if off_ms:
+                obs_overhead_pct = round(
+                    100.0 * (on_ms - off_ms) / off_ms, 2)
             if est.params.optim_update != "adam":
                 # dense A/B arm: the legacy dense-adam path over the SAME
                 # cached chunks, same probe mechanics — the like-for-like
@@ -844,6 +918,11 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
         # degrading mid-run, not the program
         "epoch_walls_s": [round(t, 2) for t in epoch_s],
         "pure_step_ms": pure_step_ms,
+        # ---- obs A/B (obs/ subsystem): spans+registry on vs OTPU_OBS=0
+        # over the same instrumented step loop; the < 2% criterion rides
+        # obs_overhead_pct (negative = measurement noise, spans free)
+        "pure_step_ms_obs": pure_step_ms_obs,
+        "obs_overhead_pct": obs_overhead_pct,
         "h2d_blocked_gbps": h2d_blocked_gbps,
         **({"probe_error": probe_error} if probe_error else {}),
         **({"warm_skipped": warm_skipped} if warm_skipped else {}),
@@ -1514,6 +1593,12 @@ def _main_locked(args, rows, cpu_rows, lk, t_budget0, force_cpu=False):
             out = run()
     else:
         out = run()
+    # every config's record carries the full metrics-registry snapshot
+    # (obs/ subsystem) — the same structure /metrics exposes, embedded so
+    # a banked JSON line is self-diagnosing without a live process
+    from orange3_spark_tpu.obs import REGISTRY
+
+    out["obs"] = REGISTRY.snapshot()
     if fell_back:
         out["backend_note"] = (
             f"{mid_run_death}; measured on host cpu instead"

@@ -53,7 +53,9 @@ def donation_enabled() -> bool:
     """Global donation switch — ``OTPU_DONATE=0`` disables every
     ``donating_jit`` donation at once (read per call, so a test can flip
     it mid-process)."""
-    return os.environ.get("OTPU_DONATE", "1") != "0"
+    from orange3_spark_tpu.utils import knobs
+
+    return knobs.get_bool("OTPU_DONATE")
 
 
 def donating_jit(fn=None, *, donate_argnums=(), static_argnames=(),

@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Callable, Iterator
 
+from orange3_spark_tpu.obs.trace import span
 from orange3_spark_tpu.utils.dispatch import beat
 
 _EOF = object()
@@ -113,11 +114,12 @@ class PipelinedExecutor:
                     # and both run on this thread — prep_s must carry the
                     # whole host-side cost or overlap_pct overstates waits
                     t0 = time.perf_counter()
-                    try:
-                        item = next(it)
-                    except StopIteration:
-                        break
-                    out = prep(item)
+                    with span("prefetch", stats.items):
+                        try:
+                            item = next(it)
+                        except StopIteration:
+                            break
+                        out = prep(item)
                     stats.prep_s += time.perf_counter() - t0
                     beat()  # parse/DMA progress feeds the stall watchdog
                     while not stop.is_set():

@@ -42,6 +42,9 @@ from orange3_spark_tpu.core.session import TpuSession
 from orange3_spark_tpu.exec.donate import donating_jit
 from orange3_spark_tpu.exec.pipeline import PipelineStats, prefetch_iter
 from orange3_spark_tpu.io.multihost import put_sharded
+from orange3_spark_tpu.obs.report import RunReport
+from orange3_spark_tpu.obs.trace import refreshed_enabled as obs_enabled
+from orange3_spark_tpu.obs.trace import span, span_iter, traced
 from orange3_spark_tpu.utils.dispatch import bound_dispatch
 from orange3_spark_tpu.utils.profiling import count_dispatch
 from orange3_spark_tpu.models.base import Estimator, Params
@@ -1358,6 +1361,7 @@ class StreamingKMeans(Estimator):
             n_features=X.shape[1], session=table.session,
         )
 
+    @traced("fit", model="streaming_kmeans")
     def fit_stream(self, source: Callable[[], Iterator[Chunk]], *,
                    n_features: int, session: TpuSession | None = None,
                    cache_device: bool = False,
@@ -1374,6 +1378,9 @@ class StreamingKMeans(Estimator):
 
         p = self.params
         check_replay_granularity(p.replay_granularity)
+        report = (RunReport("fit_stream", estimator=type(self).__name__,
+                            k=p.k, epochs=p.epochs)
+                  if obs_enabled() else None)
         from orange3_spark_tpu.resilience.retry import resilient_source
 
         source = resilient_source(source)
@@ -1400,7 +1407,7 @@ class StreamingKMeans(Estimator):
                 cache_spill_dir, ((pad_rows, n_features), (pad_rows,))
             )
         use_disk = False
-        for epoch in range(p.epochs + (1 if defer else 0)):
+        for epoch in span_iter("epoch", range(p.epochs + (1 if defer else 0))):
             if epoch > 0 and (cache.enabled or use_disk):
                 if centers is None:
                     raise ValueError("stream produced no live rows")
@@ -1421,11 +1428,12 @@ class StreamingKMeans(Estimator):
                     batches = prefetch_map(_rec, iter(range(spill.n_records)),
                                            depth=2)
                 for Xd, wd, _pre_seed in batches:
-                    centers, counts, cost = _kmeans_stream_step(
-                        centers, counts, Xd, wd, decay, k=p.k
-                    )
-                    n_steps += 1
-                    bound_dispatch(n_steps, cost)
+                    with span("chunk", n_steps):
+                        centers, counts, cost = _kmeans_stream_step(
+                            centers, counts, Xd, wd, decay, k=p.k
+                        )
+                        n_steps += 1
+                        bound_dispatch(n_steps, cost)
                 continue
             for X_np, _, w_np in _rechunk(source(), pad_rows):
                 n = X_np.shape[0]
@@ -1460,11 +1468,12 @@ class StreamingKMeans(Estimator):
                     cache.offer((Xd, wd, pre_seed))
                 if pre_seed or (epoch == 0 and defer):
                     continue        # defer: ingest-only pass, no update
-                centers, counts, cost = _kmeans_stream_step(
-                    centers, counts, Xd, wd, decay, k=p.k
-                )
-                n_steps += 1
-                bound_dispatch(n_steps, cost)  # utils/dispatch.py: queue cap
+                with span("chunk", n_steps):
+                    centers, counts, cost = _kmeans_stream_step(
+                        centers, counts, Xd, wd, decay, k=p.k
+                    )
+                    n_steps += 1
+                    bound_dispatch(n_steps, cost)  # queue cap (dispatch.py)
             if epoch == 0:
                 if spill is not None:
                     spill.finalize()
@@ -1513,6 +1522,9 @@ class StreamingKMeans(Estimator):
             raise ValueError("stream produced no live rows")
         model = KMeansModel(KMeansParams(k=p.k), centers)
         model.n_iter_ = n_steps
+        if report is not None:
+            report.stage_times["n_steps"] = n_steps
+            model.run_report_ = report.finish()
         # training_cost_ stays None: a per-chunk cost is NOT the full-dataset
         # trainingCost the attribute means — use model.compute_cost(table)
         return model
@@ -1543,6 +1555,7 @@ class StreamingLinearEstimator(Estimator):
             class_values=class_values,
         )
 
+    @traced("fit", model="streaming_linear")
     def fit_stream(self, source: Callable[[], Iterator[Chunk]], *,
                    n_features: int, session: TpuSession | None = None,
                    class_values: tuple | None = None, checkpointer=None,
@@ -1563,6 +1576,11 @@ class StreamingLinearEstimator(Estimator):
         re-parse); without it, every epoch re-runs the source, loudly."""
         p = self.params
         check_replay_granularity(p.replay_granularity)
+        # the run report rides the OTPU_OBS kill-switch (its two counter
+        # snapshots are this path's only per-fit obs cost)
+        report = (RunReport("fit_stream", estimator=type(self).__name__,
+                            loss=p.loss, epochs=p.epochs)
+                  if obs_enabled() else None)
         from orange3_spark_tpu.resilience.retry import resilient_source
 
         # THE source chokepoint (docs/resilience.md): fault injection +
@@ -1644,13 +1662,14 @@ class StreamingLinearEstimator(Estimator):
 
         def run_step(Xd, yd, wd):
             nonlocal theta, opt_state, n_steps, last_loss
-            theta, opt_state, loss = _stream_step(
-                theta, opt_state, Xd, yd, wd, reg, lr,
-                loss_kind=p.loss,
-            )
-            n_steps += 1
-            last_loss = loss
-            bound_dispatch(n_steps, loss)  # utils/dispatch.py: queue cap
+            with span("chunk", n_steps):
+                theta, opt_state, loss = _stream_step(
+                    theta, opt_state, Xd, yd, wd, reg, lr,
+                    loss_kind=p.loss,
+                )
+                n_steps += 1
+                last_loss = loss
+                bound_dispatch(n_steps, loss)  # utils/dispatch.py: queue cap
             if checkpointer is not None and not ckpt_epochs:
                 checkpointer.maybe_save(
                     n_steps, {"theta": theta, "opt_state": opt_state},
@@ -1667,7 +1686,7 @@ class StreamingLinearEstimator(Estimator):
                 ckpt_meta,
             )
 
-        for epoch in range(p.epochs + (1 if defer else 0)):
+        for epoch in span_iter("epoch", range(p.epochs + (1 if defer else 0))):
             if epoch > 0 and cache.enabled:
                 # pure-HBM epoch: replay cached batches, zero host work
                 for Xd, yd, wd in cache.batches:
@@ -1810,6 +1829,11 @@ class StreamingLinearEstimator(Estimator):
         model = self._wrap_model(theta, k, class_values)
         model.n_steps_ = n_steps
         model.final_loss_ = float(last_loss) if last_loss is not None else None
+        if report is not None:
+            report.stage_times["n_steps"] = n_steps
+            report.stage_times["replay_source"] = (
+                "disk" if use_disk else "hbm" if cache.enabled else "stream")
+            model.run_report_ = report.finish()
         if checkpointer is not None:
             # a finished fit's snapshot must not fast-forward a FUTURE fit
             # (same path, same config, different data) past its early batches

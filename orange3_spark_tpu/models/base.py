@@ -188,13 +188,29 @@ class Estimator:
         self.last_fit_metrics: dict[str, float] = {}
 
     def fit(self, table: TpuTable) -> Model:
+        from orange3_spark_tpu.obs.trace import refreshed_enabled as obs_enabled
+        from orange3_spark_tpu.obs.trace import span
+
+        # the outer obs bracket rides the OTPU_OBS kill-switch: under
+        # OTPU_OBS=0 no report is built (its counter snapshots are the
+        # only per-fit obs cost here). unique=True: a streaming _fit's
+        # fit_stream opens its own richer "fit" span — record only the
+        # outermost so traces never show fit ⊃ fit.
+        report = None
+        if obs_enabled():
+            from orange3_spark_tpu.obs.report import RunReport
+
+            report = RunReport("fit", estimator=type(self).__name__,
+                               n_rows=table.n_rows)
         t0 = time.perf_counter()
-        model = self._fit(table)
-        if isinstance(model, Model):
-            try:
-                jax.block_until_ready(model.state_pytree)  # don't time async dispatch
-            except NotImplementedError:
-                pass
+        with span("fit", unique=True, estimator=type(self).__name__):
+            model = self._fit(table)
+            if isinstance(model, Model):
+                try:
+                    # don't time async dispatch
+                    jax.block_until_ready(model.state_pytree)
+                except NotImplementedError:
+                    pass
         # else: stateless result (e.g. QuantileDiscretizer -> Bucketizer)
         dt = time.perf_counter() - t0
         # rows/sec/chip is THE baseline metric (BASELINE.json "metric").
@@ -205,6 +221,11 @@ class Estimator:
             "fit_seconds": dt,
             "rows_per_sec_per_chip": table.n_rows / dt / max(n_chips, 1),
         }
+        if report is not None and isinstance(model, Model):
+            # a streaming _fit already attached its richer fit_stream
+            # report — the outer bracket must not clobber it
+            if getattr(model, "run_report_", None) is None:
+                model.run_report_ = report.finish()
         return model
 
     def _fit(self, table: TpuTable) -> Model:

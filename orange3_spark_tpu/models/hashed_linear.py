@@ -72,6 +72,9 @@ from orange3_spark_tpu.optim.sparse import (
     plan_packed_field_shapes, resolve_optim_update, resolve_sparse_lowering,
     sparse_embedding_update, unpack_plan,
 )
+from orange3_spark_tpu.obs.report import RunReport
+from orange3_spark_tpu.obs.trace import span, span_iter, traced
+from orange3_spark_tpu.obs.trace import refreshed_enabled as obs_enabled
 from orange3_spark_tpu.utils.dispatch import bound_dispatch
 from orange3_spark_tpu.utils.profiling import count_dispatch
 
@@ -1249,6 +1252,7 @@ class StreamingHashedLinearEstimator(Estimator):
         jax.block_until_ready(losses)
         return theta, np.asarray(salts)
 
+    @traced("fit", model="hashed_linear")
     def fit_stream(
         self,
         source: Callable[[], Iterator],
@@ -1304,6 +1308,11 @@ class StreamingHashedLinearEstimator(Estimator):
 
         p = self.params
         check_replay_granularity(p.replay_granularity)
+        # the run report rides the OTPU_OBS kill-switch (its two counter
+        # snapshots are this path's only per-fit obs cost)
+        report = (RunReport("fit_stream", estimator=type(self).__name__,
+                            n_dims=p.n_dims, epochs=p.epochs)
+                  if obs_enabled() else None)
         session = session or TpuSession.active()
         k = _effective_k(p)
         n_cols = _chunk_cols(p)
@@ -1348,7 +1357,16 @@ class StreamingHashedLinearEstimator(Estimator):
         # categorical block offset in the padded chunk ([label?] + dense +
         # cats, or [label?] + idx pairs; n_dense == 0 in vw mode)
         cats_off = (1 if p.label_in_chunk else 0) + p.n_dense
-        times = {"parse_s": 0.0, "h2d_s": 0.0} if stage_times is not None else None
+        # stage timings collect for the caller's stage_times= dict AND for
+        # the run report (obs/report.py) — under OTPU_OBS=0 with no caller
+        # dict, collection reverts to the legacy zero-instrumentation path.
+        # honest_walls: only an EXPLICIT stage_times= caller (bench) pays
+        # the per-epoch block_until_ready that makes epoch walls exact;
+        # report-only collection must not add epoch-boundary device syncs
+        # to every default fit
+        times = ({"parse_s": 0.0, "h2d_s": 0.0}
+                 if stage_times is not None or obs_enabled() else None)
+        honest_walls = stage_times is not None
         # fit-level pipeline counters: every prefetch stream (live ingest,
         # disk replay, grouped disk replay) folds in, so overlap_pct is the
         # measured host-prep/device-compute overlap of the WHOLE fit
@@ -1589,13 +1607,14 @@ class StreamingHashedLinearEstimator(Estimator):
             nonlocal theta, opt_state, n_steps, last_loss
             Xd, n_valid, yd, wd = dev_chunk[:4]
             plan = dev_chunk[4] if len(dev_chunk) > 4 else None
-            theta, opt_state, loss = _hashed_step(
-                theta, opt_state, Xd, n_valid, yd, wd, salts, reg, lr,
-                plan, l1, **static_kw,
-            )
-            n_steps += 1
-            last_loss = loss
-            bound_dispatch(n_steps, loss, period=step_period)
+            with span("chunk", n_steps):
+                theta, opt_state, loss = _hashed_step(
+                    theta, opt_state, Xd, n_valid, yd, wd, salts, reg, lr,
+                    plan, l1, **static_kw,
+                )
+                n_steps += 1
+                last_loss = loss
+                bound_dispatch(n_steps, loss, period=step_period)
             if checkpointer is not None and not ckpt_epochs:
                 checkpointer.maybe_save(
                     n_steps, {"theta": theta, "opt_state": opt_state},
@@ -1709,7 +1728,7 @@ class StreamingHashedLinearEstimator(Estimator):
                 for s in starts:
                     yield grp_to_device(s)
 
-        for epoch in range(p.epochs + (1 if defer else 0)):
+        for epoch in span_iter("epoch", range(p.epochs + (1 if defer else 0))):
             t_epoch = time.perf_counter()
             if epoch == 0 or not (cache.enabled or use_disk):
                 # stream from the source; a look-ahead window keeps the LAST
@@ -1823,8 +1842,8 @@ class StreamingHashedLinearEstimator(Estimator):
                 lambda: {"theta": theta, "opt_state": opt_state},
                 ckpt_meta,
             )
-            if stage_times is not None:
-                if last_loss is not None:
+            if times is not None:
+                if honest_walls and last_loss is not None:
                     jax.block_until_ready(last_loss)  # honest epoch wall
                 epoch_walls.append(time.perf_counter() - t_epoch)
             if (epoch == 0 and fuse_replay and cache.enabled
@@ -1896,7 +1915,7 @@ class StreamingHashedLinearEstimator(Estimator):
                 del stacks
                 jax.block_until_ready(last_loss)
                 replay_fused_s = time.perf_counter() - t_rep
-                if stage_times is not None:
+                if times is not None:
                     epoch_walls.append(replay_fused_s)
                 break
 
@@ -1908,35 +1927,35 @@ class StreamingHashedLinearEstimator(Estimator):
             # dense schedule's — predictions/serving read theta directly
             theta = finalize_lazy_decay(
                 theta, opt_state, p.step_size, p.reg_param, optim_resolved)
-        if stage_times is not None and times is not None:
-            stage_times.update(times)
+        if times is not None:
+            st = dict(times)
             # the resolved lowerings, so A/B records are self-describing
             # (the 'auto' decisions are otherwise invisible post-hoc)
-            stage_times["emb_update"] = static_kw["emb_update"]
-            stage_times["optim_update"] = optim_resolved
-            stage_times["sparse_lowering"] = static_kw["sparse_lowering"]
+            st["emb_update"] = static_kw["emb_update"]
+            st["optim_update"] = optim_resolved
+            st["sparse_lowering"] = static_kw["sparse_lowering"]
             # cache economics (io/codec.py): what the HBM cache actually
             # held, and what the same chunks would cost at f32 — the
             # bench's compression_ratio/capacity fields read these
-            stage_times["cache_dtype"] = codec.mode if codec else "f32"
+            st["cache_dtype"] = codec.mode if codec else "f32"
             if cache_device:
-                stage_times["cache_bytes"] = cache.nbytes
-                stage_times["cache_chunks"] = len(cache.batches)
-                stage_times["cache_raw_bytes"] = (
+                st["cache_bytes"] = cache.nbytes
+                st["cache_chunks"] = len(cache.batches)
+                st["cache_raw_bytes"] = (
                     len(cache.batches)
                     * _raw_chunk_bytes(p, pad_rows, sparse_plan))
-            stage_times["epoch_s"] = [round(t, 3) for t in epoch_walls]
+            st["epoch_s"] = [round(t, 3) for t in epoch_walls]
             if pipe_stats.items:
                 # measured prefetch overlap (exec/pipeline.py): 100% = all
                 # host prep hidden behind device work, 0% = serial
-                stage_times["overlap_pct"] = round(pipe_stats.overlap_pct, 1)
-                stage_times["prefetch_prep_s"] = round(pipe_stats.prep_s, 3)
-                stage_times["prefetch_wait_s"] = round(pipe_stats.wait_s, 3)
+                st["overlap_pct"] = round(pipe_stats.overlap_pct, 1)
+                st["prefetch_prep_s"] = round(pipe_stats.prep_s, 3)
+                st["prefetch_wait_s"] = round(pipe_stats.wait_s, 3)
             if replay_fused_s is not None:
                 # one wall for ALL replay epochs (single fused dispatch)
-                stage_times["replay_fused_s"] = round(replay_fused_s, 3)
-            stage_times["cache_overflow"] = cache.degraded
-            stage_times["replay_source"] = (
+                st["replay_fused_s"] = round(replay_fused_s, 3)
+            st["cache_overflow"] = cache.degraded
+            st["replay_source"] = (
                 None if (p.epochs <= 1 and not defer)
                 else ("fused" if p.replay_granularity != "epoch"
                       else "fused_epoch") if replay_fused_s is not None
@@ -1944,6 +1963,12 @@ class StreamingHashedLinearEstimator(Estimator):
                 else "hbm" if cache.enabled
                 else "stream"
             )
+            # ONE stage dict feeds both consumers: the caller's legacy
+            # stage_times= plumbing and the structured run report below
+            if report is not None:
+                report.stage_times.update(st)
+            if stage_times is not None:
+                stage_times.update(st)
         model = HashedLinearModel(
             p, theta, salts_np,
             class_values or (tuple(str(i) for i in range(p.n_classes))
@@ -1954,6 +1979,8 @@ class StreamingHashedLinearEstimator(Estimator):
         model.device_chunks_ = cache.batches if cache_device else None
         model.holdout_chunks_ = holdout if holdout_chunks > 0 else None
         model.cache_codec_ = codec   # evaluate_device's decode key
+        if report is not None:
+            model.run_report_ = report.add(n_steps=n_steps).finish()
         if checkpointer is not None:
             checkpointer.delete()
         return model

@@ -1,0 +1,34 @@
+"""Unified observability subsystem (docs/observability.md).
+
+Four pieces, one kill-switch (``OTPU_OBS=0``):
+
+* ``registry``  — typed thread-safe metrics (counters/gauges/histograms,
+  labels, JSON snapshot, Prometheus text exposition). Always live: the
+  legacy ``utils.profiling`` counter shims are views over it.
+* ``trace``     — low-overhead structured spans (lock-free ring buffer,
+  Chrome trace-event export, ``jax.profiler`` alignment). No-ops under
+  the kill-switch.
+* ``report``    — per-run structured reports (``model.run_report_``,
+  ``ServingContext.report()``).
+* ``server``    — opt-in stdlib ``/metrics`` + ``/healthz`` endpoint on
+  serving processes (``OTPU_OBS_PORT``). Never binds under the
+  kill-switch.
+"""
+
+from orange3_spark_tpu.obs.registry import (  # noqa: F401
+    REGISTRY, Counter, Gauge, Histogram, MetricsRegistry, get_registry,
+)
+from orange3_spark_tpu.obs.report import RunReport  # noqa: F401
+from orange3_spark_tpu.obs.server import (  # noqa: F401
+    TelemetryServer, maybe_start_from_env,
+)
+from orange3_spark_tpu.obs.trace import (  # noqa: F401
+    export_chrome_trace, instant, span, span_iter, validate_chrome_trace,
+)
+from orange3_spark_tpu.obs import trace  # noqa: F401
+
+
+def obs_enabled() -> bool:
+    """The master switch (``OTPU_OBS``): spans/endpoint on or off. The
+    registry and the legacy counter shims stay live either way."""
+    return trace.enabled()

@@ -1,0 +1,122 @@
+"""Per-run structured reports — one object instead of scattered plumbing.
+
+Before this subsystem, answering "where did this fit's time go" meant
+threading a ``stage_times=`` dict through the estimator, diffing three
+process-global counter dicts around the call yourself, and knowing which
+keys each PR happened to add. A ``RunReport`` does the bracketing once:
+
+* created at run entry, it snapshots the process counters;
+* the run's stage timings / resolved decisions land in ``stage_times``
+  (the estimators keep accepting a caller ``stage_times=`` dict — it gets
+  the same keys, so no bench/test call site changed);
+* ``finish()`` freezes the wall clock and the COUNTER DELTAS attributable
+  to this run (dispatches, prefetch overlap, cache economics, retries,
+  faults, compiles);
+* the result rides the artifact: ``model.run_report_`` on every fitted
+  model, ``ctx.report()`` on a ServingContext — JSON-dumpable via
+  ``to_json()``.
+
+Deltas are per-RUN attribution only insofar as runs don't overlap: two
+concurrent fits in one process both see the shared counters move (the
+registry is process-global by design — same caveat the legacy dicts had).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+__all__ = ["RunReport", "counter_families"]
+
+#: derived ratio fields recomputed by the shims — meaningless to delta
+_DERIVED = {"overlap_pct", "pad_overhead", "mb_merge_factor"}
+
+
+def counter_families() -> dict:
+    """Current {family: counters} view of the three legacy shim families
+    plus the compile counter."""
+    from orange3_spark_tpu.utils.profiling import (
+        exec_counters, resilience_counters, serve_counters,
+        xla_compile_count,
+    )
+
+    return {
+        "exec": exec_counters(),
+        "serve": serve_counters(),
+        "resilience": resilience_counters(),
+        "xla_compiles": xla_compile_count(),
+    }
+
+
+def _delta(before, after):
+    if isinstance(after, dict):
+        out = {}
+        for k, v in after.items():
+            if k in _DERIVED:
+                out[k] = v          # end-state ratio, not a difference
+                continue
+            d = _delta((before or {}).get(k), v)
+            if d or not isinstance(d, dict):
+                out[k] = d
+        return out
+    if isinstance(after, (int, float)) and isinstance(
+            before, (int, float)):
+        d = after - before
+        return round(d, 9) if isinstance(d, float) else d
+    return after
+
+
+class RunReport:
+    """See module docstring. ``kind`` names the run ("fit_stream",
+    "serving", ...); free-form ``meta`` identifies the subject."""
+
+    def __init__(self, kind: str, **meta):
+        self.kind = kind
+        self.meta = dict(meta)
+        self.stage_times: dict = {}
+        self.started_at = time.time()
+        self._t0 = time.perf_counter()
+        self._c0 = counter_families()
+        self.wall_s: float | None = None
+        self.counters: dict | None = None
+
+    def add(self, **fields) -> "RunReport":
+        """Merge run-level facts (resolved decisions, warmup info)."""
+        self.meta.update(fields)
+        return self
+
+    def finish(self) -> "RunReport":
+        """Freeze the wall clock and counter deltas (idempotent: the first
+        call wins, so a fit's report isn't re-bracketed by its caller)."""
+        if self.wall_s is None:
+            self.wall_s = round(time.perf_counter() - self._t0, 6)
+            self.counters = _delta(self._c0, counter_families())
+        return self
+
+    def to_dict(self) -> dict:
+        """Current view — a finished report's frozen numbers, a live one's
+        deltas-so-far (``ctx.report()`` polls a long-lived context)."""
+        if self.wall_s is not None:
+            wall, counters = self.wall_s, self.counters
+        else:
+            wall = round(time.perf_counter() - self._t0, 6)
+            counters = _delta(self._c0, counter_families())
+        return {
+            "kind": self.kind,
+            "meta": dict(self.meta),
+            "started_at": self.started_at,
+            "wall_s": wall,
+            "stage_times": dict(self.stage_times),
+            "counters": counters,
+        }
+
+    def to_json(self, path: str | None = None, **dump_kw) -> str:
+        text = json.dumps(self.to_dict(), default=str, **dump_kw)
+        if path is not None:
+            with open(path, "w") as f:
+                f.write(text)
+        return text
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        state = "finished" if self.wall_s is not None else "live"
+        return f"RunReport({self.kind!r}, {state}, meta={self.meta!r})"

@@ -1,0 +1,333 @@
+"""Structured span tracing — the host-side timeline a Spark UI would show.
+
+``jax.profiler.trace`` already captures DEVICE time (PAPER §5's
+``profile_trace``); what it cannot show is the framework's own structure —
+which fit, which epoch, which chunk, which dispatch the host was inside
+when the device stalled. This module records that structure as spans:
+
+    with span("epoch", i):          # or: for i in span_iter("epoch", rng)
+        ...
+    instant("retry", cause="source")   # point events (retries, wedges)
+
+Design constraints, in order:
+
+* **lock-free fast path** — recording a span is one ``perf_counter_ns``
+  pair, one atomic-under-the-GIL ``itertools.count`` bump and one list
+  slot store; no lock anywhere on the hot path. With ``OTPU_OBS=0`` the
+  ``span()`` call returns a shared no-op context manager (one global read,
+  zero allocation) — the bench obs A/B arm pins the overhead < 2%.
+* **bounded** — events land in a ring buffer (``OTPU_OBS_TRACE_CAP``,
+  default 65536); a week-long serving process overwrites, never grows.
+* **standard export** — ``export_chrome_trace()`` emits Chrome
+  trace-event JSON (loads in Perfetto / ``chrome://tracing``); span
+  nesting is by time containment per thread, the viewer convention.
+* **device alignment** — when recording, each span also enters a
+  ``jax.profiler.TraceAnnotation``, so running a fit under
+  ``utils.profiling.profile_trace`` shows the SAME host span names lined
+  up against the XLA device timeline.
+
+Span taxonomy (docs/observability.md): ``fit`` ⊃ ``epoch`` ⊃ ``chunk`` ⊃
+``dispatch`` for the streaming estimators, ``prefetch`` on the pipeline
+worker thread, ``serve``/``mb_flush`` on the serving path, ``timed:*``
+for ``@timed`` functions; instants ``retry``/``fault``/``wedge``/
+``crc_failure`` from the resilience subsystem.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Iterable, Iterator
+
+from orange3_spark_tpu.utils import knobs
+
+__all__ = [
+    "clear",
+    "enabled",
+    "events",
+    "export_chrome_trace",
+    "force_disabled",
+    "force_enabled",
+    "instant",
+    "refresh",
+    "refreshed_enabled",
+    "set_enabled",
+    "span",
+    "span_iter",
+    "validate_chrome_trace",
+]
+
+_enabled: bool = knobs.get_bool("OTPU_OBS")
+_cap: int = max(16, int(knobs.get_int("OTPU_OBS_TRACE_CAP")))
+_ring: list = [None] * _cap
+_seq = itertools.count()
+
+# TraceAnnotation is a cheap TraceMe when no profiler is active; resolved
+# once so a jax build without it degrades to pure-host spans
+try:
+    import jax
+
+    _ANNOTATION = getattr(jax.profiler, "TraceAnnotation", None)
+except Exception:  # noqa: BLE001 - obs must import anywhere
+    _ANNOTATION = None
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> None:
+    """Programmatic switch — env-backed (writes ``OTPU_OBS``) so the
+    fit-entry re-resolve (``refreshed_enabled``) cannot silently unwind
+    an explicit override at the next fit."""
+    global _enabled
+    os.environ["OTPU_OBS"] = "1" if on else "0"
+    _enabled = bool(on)
+
+
+def refresh() -> None:
+    """Re-read ``OTPU_OBS`` (tests and the bench A/B flip it mid-process)."""
+    global _enabled
+    _enabled = knobs.get_bool("OTPU_OBS")
+
+
+def refreshed_enabled() -> bool:
+    """Re-resolve the knob, then report it — the fit-entry/activation
+    chokepoints use this so a mid-process env flip takes effect at the
+    next run (the OTPU_DONATE/OTPU_SPARSE_UPDATE convention), while the
+    per-span hot path keeps reading the cached flag lock-free. A
+    ``set_enabled``/``force_disabled`` override is env-backed too (the
+    bench A/B uses force_disabled around whole probe arms), so the
+    re-read cannot unwind an active override mid-arm: spans and entry
+    points flip together."""
+    refresh()
+    return _enabled
+
+
+@contextlib.contextmanager
+def _force(value: str):
+    """Env-backed temporary override — so the fit-entry re-resolve
+    (``refreshed_enabled``) agrees with the cached flag instead of
+    silently unwinding the override mid-window."""
+    prev = os.environ.get("OTPU_OBS")
+    os.environ["OTPU_OBS"] = value
+    refresh()
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop("OTPU_OBS", None)
+        else:
+            os.environ["OTPU_OBS"] = prev
+        refresh()
+
+
+def force_disabled():
+    """Temporarily no-op spans (the bench A/B's OTPU_OBS=0 arm)."""
+    return _force("0")
+
+
+def force_enabled():
+    """Temporarily force spans ON (the bench A/B's obs-on arm must
+    measure real instrumentation even when the ambient env carries
+    OTPU_OBS=0 — a no-op-vs-no-op comparison would bank a vacuous
+    overhead claim)."""
+    return _force("1")
+
+
+def _record(ph: str, name: str, t0_ns: int, dur_ns: int, args) -> None:
+    # single slot store — atomic under the GIL, no lock
+    _ring[next(_seq) % _cap] = (
+        ph, name, t0_ns, dur_ns, threading.get_ident(), args or None)
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+_TLS = threading.local()
+
+
+class _Span:
+    __slots__ = ("name", "args", "t0", "ann", "uniq")
+
+    def __init__(self, name: str, args: dict | None, uniq: bool = False):
+        self.name = name
+        self.args = args
+        self.ann = None
+        self.uniq = uniq
+
+    def __enter__(self):
+        if self.uniq:
+            open_names = getattr(_TLS, "open", None)
+            if open_names is None:
+                open_names = _TLS.open = set()
+            open_names.add(self.name)
+        if _ANNOTATION is not None:
+            try:
+                self.ann = _ANNOTATION(self.name)
+                self.ann.__enter__()
+            except Exception:  # noqa: BLE001 - annotation is best-effort
+                self.ann = None
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        t0 = self.t0
+        _record("X", self.name, t0, time.perf_counter_ns() - t0, self.args)
+        if self.ann is not None:
+            self.ann.__exit__(*exc)
+        if self.uniq:
+            _TLS.open.discard(self.name)
+        return False
+
+
+def span(name: str, index=None, unique: bool = False, **args):
+    """Context manager timing one named region; ``index`` is shorthand for
+    the ``i=`` arg (``span("epoch", 3)``). No-op (shared instance, zero
+    allocation) when obs is disabled. ``unique=True`` records only the
+    OUTERMOST same-named span per thread — ``Estimator.fit`` brackets a
+    streaming ``fit_stream`` that opens its own "fit" span, and a trace
+    with fit ⊃ fit would double-count fit time for anyone aggregating by
+    span name."""
+    if not _enabled:
+        return _NULL
+    if unique and name in getattr(_TLS, "open", ()):
+        return _NULL
+    if index is not None:
+        args["i"] = index
+    return _Span(name, args or None, uniq=unique)
+
+
+def span_iter(name: str, iterable: Iterable) -> Iterator:
+    """Wrap each ITERATION of a for-loop body in a span — the one-line way
+    to instrument an existing loop without re-indenting it::
+
+        for epoch in span_iter("epoch", range(n)):   # body spans "epoch"
+
+    The span covers the loop body (yield -> resume), indexed per pass."""
+    if not _enabled:
+        yield from iterable
+        return
+    for i, item in enumerate(iterable):
+        sp = span(name, i)
+        sp.__enter__()
+        try:
+            yield item
+        finally:
+            sp.__exit__(None, None, None)
+
+
+def instant(name: str, **args) -> None:
+    """Record a point event (retries, wedges, faults) on the timeline."""
+    if not _enabled:
+        return
+    _record("i", name, time.perf_counter_ns(), 0, args or None)
+
+
+def traced(name: str, **fixed_args):
+    """Decorator form: the call body becomes one ``name`` span (unique
+    per thread — a re-entrant/bracketed call records only the outermost,
+    see ``span(unique=)``)."""
+
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            # fit entries are the chokepoint where a mid-process
+            # OTPU_OBS flip takes effect (the kill-switch convention)
+            if not refreshed_enabled():
+                return fn(*a, **kw)
+            with span(name, unique=True, **fixed_args):
+                return fn(*a, **kw)
+
+        return wrapper
+
+    return deco
+
+
+def events() -> list:
+    """Recorded events, oldest first (chronological even after ring wrap)."""
+    evs = [e for e in list(_ring) if e is not None]
+    evs.sort(key=lambda e: e[2])
+    return evs
+
+
+def clear() -> None:
+    """Drop every recorded event (benches/tests bracket with this)."""
+    global _ring, _seq
+    _ring = [None] * _cap
+    _seq = itertools.count()
+
+
+def export_chrome_trace(path: str | None = None) -> dict:
+    """Chrome trace-event JSON of every recorded event. Loads in Perfetto
+    / ``chrome://tracing``; ``ts``/``dur`` are microseconds on the
+    process-local ``perf_counter`` clock. Writes to ``path`` when given;
+    returns the trace object either way."""
+    pid = os.getpid()
+    tid_map: dict[int, int] = {}
+    trace_events: list[dict] = []
+    for ph, name, t_ns, dur_ns, ident, args in events():
+        tid = tid_map.setdefault(ident, len(tid_map))
+        ev: dict = {
+            "name": name, "ph": ph, "cat": "otpu",
+            "pid": pid, "tid": tid, "ts": t_ns / 1e3,
+        }
+        if ph == "X":
+            ev["dur"] = dur_ns / 1e3
+        elif ph == "i":
+            ev["s"] = "t"
+        if args:
+            ev["args"] = dict(args)
+        trace_events.append(ev)
+    # thread-name metadata rows make the Perfetto view self-describing
+    for ident, tid in tid_map.items():
+        trace_events.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": f"thread-{ident}"},
+        })
+    out = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(out, f)
+    return out
+
+
+def validate_chrome_trace(obj) -> list[dict]:
+    """Raise ValueError unless ``obj`` (a dict or a JSON string) is valid
+    Chrome trace-event JSON by the format's object-form rules; returns the
+    event list. Used by tools/obs_dump.py and the trace tests."""
+    if isinstance(obj, (str, bytes)):
+        obj = json.loads(obj)
+    if not isinstance(obj, dict) or not isinstance(
+            obj.get("traceEvents"), list):
+        raise ValueError("not object-form Chrome trace JSON "
+                         "(missing 'traceEvents' list)")
+    for ev in obj["traceEvents"]:
+        if not isinstance(ev, dict):
+            raise ValueError(f"non-object trace event: {ev!r}")
+        for field in ("name", "ph", "pid", "tid"):
+            if field not in ev:
+                raise ValueError(f"trace event missing {field!r}: {ev!r}")
+        if ev["ph"] in ("X", "B", "E", "i") and not isinstance(
+                ev.get("ts"), (int, float)):
+            raise ValueError(f"trace event missing numeric ts: {ev!r}")
+        if ev["ph"] == "X" and not isinstance(
+                ev.get("dur"), (int, float)):
+            raise ValueError(f"complete event missing dur: {ev!r}")
+    return obj["traceEvents"]

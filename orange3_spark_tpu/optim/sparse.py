@@ -99,7 +99,9 @@ def sparse_updates_enabled() -> bool:
     every ``sparse_*`` rule to its ``dense_*`` twin (read per resolution,
     i.e. per fit entry, so a test can flip it mid-process; already-running
     fits keep their resolved program)."""
-    return os.environ.get("OTPU_SPARSE_UPDATE", "1") != "0"
+    from orange3_spark_tpu.utils import knobs
+
+    return knobs.get_bool("OTPU_SPARSE_UPDATE")
 
 
 def resolve_optim_update(value: str) -> str:

@@ -60,7 +60,9 @@ def resilience_enabled() -> bool:
     ``OTPU_RESILIENCE=0`` restores legacy fail-fast behavior — no
     retries, no watchdog budget, no spill CRC verification, no
     epoch-cadence snapshots. Injection stays active (see module doc)."""
-    return os.environ.get("OTPU_RESILIENCE", "1") != "0"
+    from orange3_spark_tpu.utils import knobs
+
+    return knobs.get_bool("OTPU_RESILIENCE")
 
 
 class TransientSourceError(IOError):

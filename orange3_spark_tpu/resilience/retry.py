@@ -25,7 +25,6 @@ threaded in, ``stats.retries`` — the bench's ``retries`` field.
 from __future__ import annotations
 
 import dataclasses
-import os
 import time
 import zlib
 from typing import Callable, Iterator
@@ -56,16 +55,14 @@ class RetryPolicy:
 
     @classmethod
     def from_env(cls, **overrides) -> "RetryPolicy":
-        def _f(name, default, cast=float):
-            v = os.environ.get(name, "")
-            return cast(v) if v else default
+        from orange3_spark_tpu.utils import knobs
 
         kw = dict(
-            max_attempts=_f("OTPU_RETRY_ATTEMPTS", cls.max_attempts, int),
-            base_delay_s=_f("OTPU_RETRY_BASE_S", cls.base_delay_s),
-            max_delay_s=_f("OTPU_RETRY_MAX_S", cls.max_delay_s),
-            multiplier=_f("OTPU_RETRY_MULTIPLIER", cls.multiplier),
-            jitter=_f("OTPU_RETRY_JITTER", cls.jitter),
+            max_attempts=knobs.get_int("OTPU_RETRY_ATTEMPTS"),
+            base_delay_s=knobs.get_float("OTPU_RETRY_BASE_S"),
+            max_delay_s=knobs.get_float("OTPU_RETRY_MAX_S"),
+            multiplier=knobs.get_float("OTPU_RETRY_MULTIPLIER"),
+            jitter=knobs.get_float("OTPU_RETRY_JITTER"),
         )
         kw.update(overrides)
         return cls(**kw)

@@ -26,7 +26,6 @@ must be able to demonstrate the fail-fast ladder without hanging CI).
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 
@@ -75,13 +74,13 @@ class DispatchWedgedError(RuntimeError):
 
 def dispatch_budget_s() -> float:
     """Seconds a guarded sync may block (0 = watchdog disabled). Env
-    ``OTPU_DISPATCH_BUDGET_S``; forced to 0 by the kill-switch."""
+    ``OTPU_DISPATCH_BUDGET_S`` (utils/knobs.py — malformed values fall
+    back to the declared 0 default); forced to 0 by the kill-switch."""
     if not resilience_enabled():
         return 0.0
-    try:
-        return float(os.environ.get("OTPU_DISPATCH_BUDGET_S", "0") or 0.0)
-    except ValueError:
-        return 0.0
+    from orange3_spark_tpu.utils import knobs
+
+    return float(knobs.get_float("OTPU_DISPATCH_BUDGET_S"))
 
 
 def _diagnostics() -> dict:

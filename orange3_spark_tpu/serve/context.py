@@ -56,8 +56,18 @@ from orange3_spark_tpu.core.table import TpuTable
 from orange3_spark_tpu.serve.bucketing import (
     BucketLadder, domain_sig, pad_rows_np, table_to_host,
 )
+from orange3_spark_tpu.obs.registry import REGISTRY
+from orange3_spark_tpu.obs.trace import span
 from orange3_spark_tpu.serve.cache import ExecutableCache
+from orange3_spark_tpu.utils.dispatch import beat
 from orange3_spark_tpu.utils.profiling import record_serve
+
+# routed serve calls currently executing — /healthz (obs/server.py) only
+# treats a stale heartbeat as unhealthy while this is > 0: a wedged
+# dispatch holds it up with no progress beats (the 503 case), while an
+# IDLE process (zero in flight, nothing to beat about) stays healthy
+_M_INFLIGHT = REGISTRY.gauge(
+    "otpu_serve_inflight", "routed serve calls currently in flight")
 
 log = logging.getLogger("orange3_spark_tpu")
 
@@ -101,9 +111,21 @@ def route(kind: str, raw_fn: Callable, model, *args, **kwargs):
             or not isinstance(args[0], TpuTable)):
         return raw_fn(model, *args, **kwargs)
     table = args[0]
-    if kind == "transform":
-        return ctx.served_transform(model, table, raw_fn)
-    return ctx.served_predict(model, table, raw_fn)
+    # serving progress feeds the liveness heartbeat (obs/server.py
+    # /healthz): without this, a direct-dispatch (non-micro-batched)
+    # serving process under steady traffic would read as stale. The
+    # in-flight gauge brackets the dispatch so /healthz can tell a
+    # wedged call (in flight, heartbeat stale) from an idle process.
+    beat()
+    _M_INFLIGHT.inc()
+    try:
+        with span("serve", kind=kind, rows=table.n_rows):
+            if kind == "transform":
+                return ctx.served_transform(model, table, raw_fn)
+            return ctx.served_predict(model, table, raw_fn)
+    finally:
+        _M_INFLIGHT.dec()
+        beat()
 
 
 def _mesh_key(session) -> tuple:
@@ -174,6 +196,8 @@ class ServingContext:
         self._max_wait_ms = max_wait_ms
         self._activations = 0
         self.micro_batcher = None
+        self._telemetry = None       # obs/server.py, OTPU_OBS_PORT opt-in
+        self._run_report = None      # obs/report.py, per-activation window
 
     # ------------------------------------------------------ context stack
     def __enter__(self) -> "ServingContext":
@@ -191,6 +215,24 @@ class ServingContext:
                     max_wait_ms=self._max_wait_ms,
                 )
             self._activations += 1
+            if self._activations == 1:
+                from orange3_spark_tpu.obs.server import maybe_start_from_env
+                from orange3_spark_tpu.obs.trace import refreshed_enabled
+
+                # per-activation-window observability: a fresh run report
+                # brackets the serve counters, and the opt-in telemetry
+                # endpoint (OTPU_OBS_PORT) binds for the window's lifetime.
+                # Both ride the OTPU_OBS kill-switch (report() degrades to
+                # the process-absolute view when no window report exists).
+                if refreshed_enabled():
+                    from orange3_spark_tpu.obs.report import RunReport
+
+                    self._run_report = RunReport(
+                        "serving", ladder=list(self.ladder.buckets()),
+                        micro_batch=self._micro_batch)
+                else:
+                    self._run_report = None
+                self._telemetry = maybe_start_from_env(self)
             _ACTIVE.append(self)
         return self
 
@@ -204,8 +246,28 @@ class ServingContext:
             mb = self.micro_batcher if self._activations == 0 else None
             if mb is not None:
                 self.micro_batcher = None
-        if mb is not None:
-            mb.close()    # outside the lock: close() joins the worker
+            srv = self._telemetry if self._activations == 0 else None
+            if srv is not None:
+                self._telemetry = None
+            rep = self._run_report if self._activations == 0 else None
+        # all outside the lock (close/stop join threads), and chained so
+        # a close() that raises can neither leak the bound HTTP listener
+        # nor leave the window report unfrozen
+        try:
+            if mb is not None:
+                mb.close()
+        finally:
+            try:
+                if srv is not None:
+                    srv.stop()
+            finally:
+                if rep is not None:
+                    # freeze the window: report() read after __exit__
+                    # must show the WINDOW's wall/deltas, not everything
+                    # the process did since (finish() is idempotent — a
+                    # poll mid-window that raced this sees live numbers,
+                    # the frozen ones after)
+                    rep.finish()
 
     # ------------------------------------------------------------ records
     def _record_for(self, model) -> _ModelRecord:
@@ -615,6 +677,36 @@ class ServingContext:
                 )
                 compiled += 0 if hit else 1
         return {"compiled": compiled, "buckets": buckets}
+
+    # ------------------------------------------------------------- report
+    def report(self) -> dict:
+        """Structured serving report (obs/report.py): counter deltas since
+        the first activation of the current window, live cache/batcher
+        state, and the telemetry endpoint if one is bound. Poll it on a
+        long-lived context or read it after __exit__ — the window's report
+        is frozen at the last deactivation."""
+        rep = self._run_report
+        if rep is None:
+            # never entered: no window to delta against — report the
+            # ABSOLUTE process counters so the numbers are still real
+            from orange3_spark_tpu.obs.report import counter_families
+
+            out = {
+                "kind": "serving",
+                "meta": {"ladder": list(self.ladder.buckets()),
+                         "micro_batch": self._micro_batch,
+                         "window": "process-absolute"},
+                "started_at": None, "wall_s": None, "stage_times": {},
+                "counters": counter_families(),
+            }
+        else:
+            out = rep.to_dict()
+        out["cache_entries"] = len(self.cache)
+        out["unservable"] = len(self._unservable)
+        out["micro_batcher_active"] = self.micro_batcher is not None
+        out["telemetry_url"] = (self._telemetry.url
+                                if self._telemetry is not None else None)
+        return out
 
     # ------------------------------------------------- staged-graph reuse
     def staged_executable(self, staged, example_args):

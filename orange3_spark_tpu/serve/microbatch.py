@@ -33,7 +33,6 @@ sheds the request to direct dispatch (``submit`` returns None). Disabled
 
 from __future__ import annotations
 
-import os
 import queue
 import threading
 import time
@@ -43,6 +42,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from orange3_spark_tpu.obs.trace import span
 from orange3_spark_tpu.serve.bucketing import domain_sig
 from orange3_spark_tpu.utils.dispatch import beat
 from orange3_spark_tpu.utils.profiling import record_serve
@@ -129,12 +129,13 @@ class MicroBatcher:
         from orange3_spark_tpu.resilience.faults import resilience_enabled
 
         if deadline_s is None and resilience_enabled():
-            try:
-                deadline_s = float(
-                    os.environ.get("OTPU_MB_DEADLINE_S", "") or 30.0)
-            except ValueError:
-                deadline_s = 30.0   # malformed knob: default, don't crash
-                #                     the serving-context activation path
+            from orange3_spark_tpu.utils import knobs
+
+            # knobs.get_float falls back to the declared 30 s default on a
+            # malformed/unset value — never crash serving-context
+            # activation. An EXPLICIT 0 must survive (deadline disabled,
+            # the legacy block-forever contract), so no `or` collapse.
+            deadline_s = float(knobs.get_float("OTPU_MB_DEADLINE_S"))
         self.deadline_s = (deadline_s if deadline_s and deadline_s > 0
                            and resilience_enabled() else None)
         self._q: queue.Queue = queue.Queue(maxsize=queue_depth)
@@ -215,6 +216,10 @@ class MicroBatcher:
 
     def _flush(self, batch: list, rows: int) -> None:
         record_serve(mb_requests=len(batch), mb_batches=1)
+        with span("mb_flush", requests=len(batch), rows=rows):
+            self._flush_inner(batch, rows)
+
+    def _flush_inner(self, batch: list, rows: int) -> None:
         try:
             first = batch[0]
             if len(batch) == 1:

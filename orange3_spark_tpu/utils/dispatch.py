@@ -56,7 +56,12 @@ def bound_dispatch(step: int, token, period: int = DISPATCH_SYNC_PERIOD) -> None
     beat()
     count_dispatch()
     if step % period == 0:
+        from orange3_spark_tpu.obs.trace import span
         from orange3_spark_tpu.resilience.watchdog import maybe_guarded_block
 
-        maybe_guarded_block(token, step=step)
+        # the one place a step loop blocks on the device: a "dispatch"
+        # span here puts the device-pacing wait on the obs timeline,
+        # nested under the surrounding chunk/epoch/fit spans
+        with span("dispatch", step):
+            maybe_guarded_block(token, step=step)
         beat()

@@ -1,0 +1,200 @@
+"""Central registry of every ``OTPU_*`` environment knob.
+
+Six PRs grew ten-plus env switches (donation, compile cache, cache dtype,
+sparse updates, resilience, retry schedule, watchdog, micro-batch deadline,
+obs...) each resolved ad hoc at its call site — nothing an operator could
+enumerate, and nothing a test could hold complete. This module is the one
+table: every knob declares its name, type, default, owning subsystem and a
+one-line doc here, call sites resolve through the typed getters below, and
+``docs/observability.md`` embeds the table ``knob_table_md()`` renders
+(pinned by tests/test_knobs.py, which also greps the source tree and fails
+on any ``OTPU_`` literal missing from this registry).
+
+Types: ``flag`` = "0" disables, anything else (or unset) enables;
+``str``/``int``/``float`` parse with fallback to the declared default on
+malformed values (an operator typo must never crash a fit); ``marker`` =
+presence-only process markers the harness sets for its children (never
+user-tuned).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+__all__ = [
+    "KNOBS",
+    "Knob",
+    "get_bool",
+    "get_float",
+    "get_int",
+    "get_raw",
+    "get_str",
+    "knob_table_md",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str
+    type: str            # 'flag' | 'str' | 'int' | 'float' | 'marker'
+    default: Any
+    subsystem: str
+    doc: str
+
+
+_ALL = [
+    # ----------------------------------------------------------- exec/
+    Knob("OTPU_DONATE", "flag", "1", "exec",
+         "Buffer-donation sweep kill-switch; 0 restores copying dispatch."),
+    Knob("OTPU_COMPILE_CACHE", "str", "",
+         "exec", "Persistent XLA compilation-cache dir; 0 disables."),
+    Knob("OTPU_FUSED_REPLAY", "str", "1", "exec",
+         "Replay lowering: 1 = one fused scan, 'epoch' = per-epoch scans, "
+         "0 = per-chunk steps (bench hardware-retry ladder)."),
+    Knob("OTPU_EPOCHS_PER_DISPATCH", "int", 4, "exec",
+         "Epochs folded into each replay scan dispatch under "
+         "granularity 'epoch' (bench default)."),
+    # ------------------------------------------------------------- io/
+    Knob("OTPU_CACHE_DTYPE", "str", "", "io",
+         "Chunk-cache codec override: f32 | bf16 | packed "
+         "(outranks the params' cache_dtype; f32 = legacy bitwise)."),
+    # ----------------------------------------------------------- optim/
+    Knob("OTPU_SPARSE_UPDATE", "flag", "1", "optim",
+         "Sparse touched-row optimizer kill-switch; 0 resolves sparse_* "
+         "rules to their dense twins at fit entry."),
+    Knob("OTPU_OPTIM_UPDATE", "str", "sparse_adagrad", "optim",
+         "bench.py criteo optimizer rule ('adam' reproduces the legacy "
+         "records)."),
+    # ------------------------------------------------------------- ops/
+    Knob("OTPU_HISTOGRAM_BACKEND", "str", "", "ops",
+         "Force the histogram lowering: 'xla' or 'interpret'."),
+    # ------------------------------------------------------ resilience/
+    Knob("OTPU_RESILIENCE", "flag", "1", "resilience",
+         "Resilience kill-switch; 0 restores fail-fast everywhere while "
+         "fault injection stays live."),
+    Knob("OTPU_FAULT_SPEC", "str", "", "resilience",
+         "Fault-injection spec grammar (docs/resilience.md), e.g. "
+         "'source_io:every=7,fails=2'."),
+    Knob("OTPU_DISPATCH_BUDGET_S", "float", 0.0, "resilience",
+         "Watchdog budget for the periodic dispatch sync; 0 = unbounded "
+         "waits (a long compile must never be misread as a wedge)."),
+    Knob("OTPU_RETRY_ATTEMPTS", "int", 4, "resilience",
+         "Total attempts per transient failure (1 first + N-1 retries)."),
+    Knob("OTPU_RETRY_BASE_S", "float", 0.05, "resilience",
+         "Exponential-backoff base delay."),
+    Knob("OTPU_RETRY_MAX_S", "float", 2.0, "resilience",
+         "Backoff delay ceiling."),
+    Knob("OTPU_RETRY_MULTIPLIER", "float", 2.0, "resilience",
+         "Backoff growth factor per retry."),
+    Knob("OTPU_RETRY_JITTER", "float", 0.25, "resilience",
+         "Deterministic-jitter fraction added to each delay."),
+    Knob("OTPU_MB_DEADLINE_S", "float", 30.0, "resilience",
+         "Hard deadline on micro-batched futures; a dead/wedged coalescer "
+         "raises MicroBatchTimeoutError instead of hanging the caller."),
+    # ----------------------------------------------------------- serve/
+    Knob("OTPU_SERVE_REQUESTS", "int", 120, "serve",
+         "bench.py serving-trace request count."),
+    # ------------------------------------------------------------- obs/
+    Knob("OTPU_OBS", "flag", "1", "obs",
+         "Observability master switch; 0 = spans no-op, the telemetry "
+         "endpoint never binds, the registry still serves the legacy "
+         "counter shims."),
+    Knob("OTPU_OBS_PORT", "int", None, "obs",
+         "Bind the /metrics + /healthz telemetry server on this port when "
+         "a ServingContext activates (0 = ephemeral port); unset = no "
+         "server."),
+    Knob("OTPU_OBS_STALE_S", "float", 60.0, "obs",
+         "/healthz degrades to 503 when the liveness heartbeat is older "
+         "than this many seconds."),
+    Knob("OTPU_OBS_TRACE_CAP", "int", 65536, "obs",
+         "Span ring-buffer capacity (oldest events overwrite past it)."),
+    # --------------------------------------------------------- harness
+    Knob("OTPU_BENCH_DIR", "str", "/tmp/otpu_bench", "harness",
+         "Bench scratch dir (generated CSVs, spills)."),
+    Knob("OTPU_BENCH_BUDGET_S", "float", 1500.0, "harness",
+         "Hard wall budget for one bench run incl. the CPU-fallback "
+         "reserve."),
+    Knob("OTPU_CHILD_WALL_S", "float", 3600.0, "harness",
+         "Wall timeout for one hardware-attempt child process."),
+    Knob("OTPU_CPU_FALLBACK_ROWS", "int", 2_000_000, "harness",
+         "Row cap for the labeled CPU-fallback measurement."),
+    Knob("OTPU_STALL_S", "float", 900.0, "harness",
+         "bench stall watchdog: no liveness beat for this long = the "
+         "tunnel died mid-run (exit rc=3)."),
+    Knob("OTPU_LOCK_WAIT_S", "float", 5400.0, "harness",
+         "Max wait on the TPU device lock before falling back."),
+    Knob("OTPU_TUNNEL_WAIT_S", "float", 300.0, "harness",
+         "Accelerator probe window before surrendering to CPU."),
+    Knob("OTPU_TUNNEL_RETRY_S", "float", 60.0, "harness",
+         "Probe retry period inside the tunnel wait window."),
+    Knob("OTPU_CHILD", "marker", None, "harness",
+         "Set by the bench parent on its hardware-attempt children "
+         "(suppresses preemption/locking recursion)."),
+    Knob("OTPU_WATCHER", "marker", None, "harness",
+         "Set by the capture watcher on its probe/step children."),
+]
+
+KNOBS: dict[str, Knob] = {k.name: k for k in _ALL}
+
+#: OTPU_-prefixed STDOUT markers (subprocess probe/liveness protocol
+#: lines, e.g. "OTPU_PROBE tpu 4") — not environment variables; the
+#: source-tree completeness test exempts exactly these.
+NON_KNOB_MARKERS = frozenset({"OTPU_PROBE", "OTPU_LIVE"})
+
+
+def get_raw(name: str) -> str | None:
+    """The raw env string for a REGISTERED knob (KeyError otherwise)."""
+    KNOBS[name]
+    return os.environ.get(name)
+
+
+def get_bool(name: str) -> bool:
+    """Flag semantics: "0" disables, anything else (or unset-with-truthy-
+    default) enables."""
+    knob = KNOBS[name]
+    v = os.environ.get(name)
+    if v is None:
+        return str(knob.default) != "0"
+    return v != "0"
+
+
+def get_str(name: str) -> str:
+    knob = KNOBS[name]
+    v = os.environ.get(name)
+    return v if v not in (None, "") else (knob.default or "")
+
+
+def _num(name: str, cast):
+    knob = KNOBS[name]
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return knob.default
+    try:
+        return cast(float(v)) if cast is int else cast(v)
+    except (TypeError, ValueError):
+        return knob.default
+
+
+def get_int(name: str) -> int | None:
+    return _num(name, int)
+
+
+def get_float(name: str) -> float | None:
+    return _num(name, float)
+
+
+def knob_table_md() -> str:
+    """The markdown knob-reference table docs/observability.md embeds
+    (tests pin the doc against this exact rendering)."""
+    lines = [
+        "| knob | type | default | subsystem | effect |",
+        "|---|---|---|---|---|",
+    ]
+    for k in sorted(KNOBS.values(), key=lambda k: (k.subsystem, k.name)):
+        default = "–" if k.default is None else str(k.default)
+        lines.append(
+            f"| `{k.name}` | {k.type} | `{default}` | {k.subsystem} "
+            f"| {k.doc} |")
+    return "\n".join(lines) + "\n"

@@ -2,65 +2,81 @@
 
 * ``profile_trace(dir)`` — wraps ``jax.profiler.trace``: the Spark-UI
   replacement; open the dump in TensorBoard/XProf to see per-op device time.
+  Host-side ``obs.trace`` spans annotate the same timeline, so the fit/
+  epoch/chunk/dispatch structure lines up against the XLA ops.
 * ``timed`` — structured per-call wall-clock logging (the per-widget logging
-  the reference gets from Spark event logs).
-* ``debug_unjitted()`` — run any workflow eagerly op-by-op with jit disabled:
-  the "debug mode running the whole graph un-jitted" SURVEY §5 calls for
-  (XLA is deterministic, so this replaces a race detector: divergence between
-  jitted and unjitted runs localizes compiler-boundary bugs).
-* execution-pipeline counters — process-wide aggregates for the exec/
-  subsystem: ``count_dispatch`` ticks once per device dispatch (wired into
-  ``utils.dispatch.bound_dispatch``, which every step loop already calls,
-  plus the one-shot fused-scan sites), ``record_pipeline`` folds each
-  ``exec.pipeline.PipelinedExecutor`` stream's overlap counters in, and
-  ``exec_counters()`` snapshots both — the source of the bench line's
-  ``dispatches`` and ``overlap_pct`` fields.
+  the reference gets from Spark event logs). Now also records an
+  ``obs.trace`` span (the call shows up in trace dumps) and observes the
+  ``otpu_timed_seconds`` registry histogram; the log line is unchanged.
+* ``debug_unjitted()`` — run any workflow eagerly op-by-op with jit disabled.
+* counter shims — the legacy ``exec_counters()`` / ``serve_counters()`` /
+  ``resilience_counters()`` families, field-compatible with their pre-obs
+  dict forms, now VIEWS over the typed ``obs.registry`` metrics (per-metric
+  locking, labels, Prometheus exposition). New code should tick the
+  registry metrics directly; these shims exist so no bench/test call site
+  had to move.
 """
 
 from __future__ import annotations
 
 import contextlib
 import logging
-import threading
 import time
 from functools import wraps
 
 import jax
 
+from orange3_spark_tpu.obs import trace as _trace
+from orange3_spark_tpu.obs.registry import REGISTRY
+
 log = logging.getLogger("orange3_spark_tpu")
 
-# ------------------------------------------------------- exec/ counters
-_exec_lock = threading.Lock()
-_exec_counts = {
-    "dispatches": 0,        # device dispatches ticked via count_dispatch
-    "prefetch_items": 0,    # items through PipelinedExecutor streams
-    "prefetch_prep_s": 0.0,  # producer busy seconds (parse/pad/device_put)
-    "prefetch_wait_s": 0.0,  # consumer blocked seconds
-    "prefetch_retries": 0,   # transient source reads retried (resilience/)
+# ------------------------------------------------------- exec/ metrics
+# one registry metric per legacy field; the shim dicts below are views
+_M_DISPATCHES = REGISTRY.counter(
+    "otpu_dispatches_total",
+    "device programs dispatched (ticked by utils.dispatch.bound_dispatch "
+    "and the one-shot fused-scan sites)")
+_M_PREFETCH_ITEMS = REGISTRY.counter(
+    "otpu_prefetch_items_total",
+    "chunks through PipelinedExecutor streams")
+_M_PREFETCH_PREP_S = REGISTRY.counter(
+    "otpu_prefetch_prep_seconds_total",
+    "producer busy seconds (parse/pad/device_put) on prefetch threads")
+_M_PREFETCH_WAIT_S = REGISTRY.counter(
+    "otpu_prefetch_wait_seconds_total",
+    "consumer seconds blocked waiting on the prefetch queue")
+_M_PREFETCH_RETRIES = REGISTRY.counter(
+    "otpu_prefetch_retries_total",
+    "transient source reads retried on prefetch threads (resilience/)")
+
+_EXEC_FIELDS = {
+    "dispatches": (_M_DISPATCHES, int),
+    "prefetch_items": (_M_PREFETCH_ITEMS, int),
+    "prefetch_prep_s": (_M_PREFETCH_PREP_S, float),
+    "prefetch_wait_s": (_M_PREFETCH_WAIT_S, float),
+    "prefetch_retries": (_M_PREFETCH_RETRIES, int),
 }
 
 
 def count_dispatch(n: int = 1) -> None:
     """Tick the process-wide device-dispatch counter."""
-    with _exec_lock:
-        _exec_counts["dispatches"] += n
+    _M_DISPATCHES.inc(n)
 
 
 def record_pipeline(stats) -> None:
     """Fold one finished ``PipelineStats`` into the process aggregate."""
-    with _exec_lock:
-        _exec_counts["prefetch_items"] += stats.items
-        _exec_counts["prefetch_prep_s"] += stats.prep_s
-        _exec_counts["prefetch_wait_s"] += stats.wait_s
-        _exec_counts["prefetch_retries"] += stats.retries
+    _M_PREFETCH_ITEMS.inc(stats.items)
+    _M_PREFETCH_PREP_S.inc(stats.prep_s)
+    _M_PREFETCH_WAIT_S.inc(stats.wait_s)
+    _M_PREFETCH_RETRIES.inc(stats.retries)
 
 
 def exec_counters() -> dict:
     """Snapshot of the exec counters, plus the derived ``overlap_pct``
     (share of total producer time hidden behind consumer compute across
     every recorded pipeline — see ``exec.pipeline.PipelineStats``)."""
-    with _exec_lock:
-        out = dict(_exec_counts)
+    out = {k: cast(m.total()) for k, (m, cast) in _EXEC_FIELDS.items()}
     prep = out["prefetch_prep_s"]
     out["overlap_pct"] = (
         100.0 * min(max(1.0 - out["prefetch_wait_s"] / prep, 0.0), 1.0)
@@ -71,46 +87,78 @@ def exec_counters() -> dict:
 
 def reset_exec_counters() -> None:
     """Zero the counters (benches bracket their timed window with this)."""
-    with _exec_lock:
-        for k in _exec_counts:
-            _exec_counts[k] = type(_exec_counts[k])()
+    for m, _ in _EXEC_FIELDS.values():
+        m.reset()
 
 
-# ------------------------------------------------------- serve/ counters
+# ------------------------------------------------------- serve/ metrics
 # Process-wide aggregates for the serving subsystem (serve/): the AOT
 # executable cache ticks hits/misses/evictions and accumulates compile
-# seconds; the bucketing layer ticks bucket_hits (dispatch landed on an
-# already-compiled bucket) vs bucket_misses (first touch of a bucket) —
-# per DEVICE DISPATCH, so coalesced requests sharing one merged dispatch
-# tick once — and the padding overhead (padded vs requested rows); the
-# micro-batcher reports its merge factor (requests per dispatched batch).
-_serve_counts = {
-    "aot_hits": 0,           # executable served from the in-process cache
-    "aot_misses": 0,         # lower+compile paid (first touch / evicted)
-    "aot_evictions": 0,      # LRU evictions from the executable cache
-    "aot_compile_s": 0.0,    # seconds inside lower().compile()
-    "bucket_hits": 0,        # dispatch mapped to an already-seen bucket
-    "bucket_misses": 0,      # dispatch was a bucket's first touch
-    "request_rows": 0,       # logical rows requested through serve/
-    "padded_rows": 0,        # total rows dispatched (incl. bucket padding)
-    "mb_requests": 0,        # predict() calls through the micro-batcher
-    "mb_batches": 0,         # coalesced device dispatches it issued
+# seconds; the bucketing layer ticks bucket_hits vs bucket_misses — per
+# DEVICE DISPATCH, so coalesced requests sharing one merged dispatch tick
+# once — and the padding overhead; the micro-batcher reports its merge
+# factor (requests per dispatched batch).
+_SERVE_FIELDS = {
+    "aot_hits": (REGISTRY.counter(
+        "otpu_serve_aot_hits_total",
+        "executables served from the in-process AOT cache"), int),
+    "aot_misses": (REGISTRY.counter(
+        "otpu_serve_aot_misses_total",
+        "lower+compile paid (first touch / evicted)"), int),
+    "aot_evictions": (REGISTRY.counter(
+        "otpu_serve_aot_evictions_total",
+        "LRU evictions from the executable cache"), int),
+    "aot_compile_s": (REGISTRY.counter(
+        "otpu_serve_aot_compile_seconds_total",
+        "seconds inside lower().compile()"), float),
+    "bucket_hits": (REGISTRY.counter(
+        "otpu_serve_bucket_hits_total",
+        "dispatches that landed on an already-seen bucket"), int),
+    "bucket_misses": (REGISTRY.counter(
+        "otpu_serve_bucket_misses_total",
+        "dispatches that were a bucket's first touch"), int),
+    "request_rows": (REGISTRY.counter(
+        "otpu_serve_request_rows_total",
+        "logical rows requested through serve/"), int),
+    "padded_rows": (REGISTRY.counter(
+        "otpu_serve_padded_rows_total",
+        "total rows dispatched (incl. bucket padding)"), int),
+    "mb_requests": (REGISTRY.counter(
+        "otpu_serve_mb_requests_total",
+        "predict() calls through the micro-batcher"), int),
+    "mb_batches": (REGISTRY.counter(
+        "otpu_serve_mb_batches_total",
+        "coalesced device dispatches the micro-batcher issued"), int),
 }
 
 
 def record_serve(**deltas) -> None:
-    """Fold counter deltas into the process-wide serve aggregate."""
-    with _exec_lock:
-        for k, v in deltas.items():
-            _serve_counts[k] += v
+    """Fold counter deltas into the process-wide serve aggregate. Unknown
+    keys raise immediately WITH the registered set — a typo'd counter name
+    must fail loudly at the call site, not as a bare KeyError from a hot
+    path's stack."""
+    for k, v in deltas.items():
+        field = _SERVE_FIELDS.get(k)
+        if field is None:
+            raise KeyError(
+                f"record_serve: unknown serve counter {k!r}; registered "
+                f"counters: {sorted(_SERVE_FIELDS)}")
+        field[0].inc(v)
 
 
 def serve_counters() -> dict:
     """Snapshot of the serve counters plus derived ratios: ``pad_overhead``
     (dispatched/requested rows — 1.0 means zero padding waste) and
-    ``mb_merge_factor`` (requests per micro-batch dispatch)."""
-    with _exec_lock:
-        out = dict(_serve_counts)
+    ``mb_merge_factor`` (requests per micro-batch dispatch).
+
+    Cross-FIELD atomicity note: each metric locks independently (the
+    per-metric-locking design, obs/registry.py), so a snapshot taken
+    concurrently with a multi-counter tick (e.g. the micro-batcher's
+    requests+batches pair) can momentarily tear by one event — derived
+    ratios here are monitoring-grade, not transactional. The old shared
+    _exec_lock made snapshots atomic at the price of serializing every
+    subsystem's hot-path ticks on one lock."""
+    out = {k: cast(m.total()) for k, (m, cast) in _SERVE_FIELDS.items()}
     out["pad_overhead"] = (
         out["padded_rows"] / out["request_rows"]
         if out["request_rows"] else None
@@ -122,83 +170,93 @@ def serve_counters() -> dict:
 
 
 def reset_serve_counters() -> None:
-    with _exec_lock:
-        for k in _serve_counts:
-            _serve_counts[k] = type(_serve_counts[k])()
+    for m, _ in _SERVE_FIELDS.values():
+        m.reset()
 
 
-# --------------------------------------------------- resilience/ counters
-# Process-wide aggregates for the resilience subsystem (docs/resilience.md):
-# the fault injectors tick faults_injected per kind, the retry policy ticks
-# retries per CAUSE ('source' = chunk-source reads, 'aot_build' = serving
-# executable builds) plus the backoff seconds it cost, the dispatch
-# watchdog ticks wedges, and the spill CRC verifier ticks crc_failures —
-# the source of the bench fault arm's retries/faults_injected fields.
-_res_counts = {
-    "faults_injected": 0,   # injector firings (all kinds)
-    "retries": 0,           # transient-failure retries (all causes)
-    "retry_wait_s": 0.0,    # total backoff slept
-    "wedges": 0,            # DispatchWedgedError raised by the watchdog
-    "crc_failures": 0,      # spill records failing CRC verification
-}
-_res_by_cause: dict = {}    # retries per cause
-_fault_by_kind: dict = {}   # injections per fault kind
+# --------------------------------------------------- resilience/ metrics
+# The fault injectors tick faults_injected per kind (label), the retry
+# policy ticks retries per CAUSE ('source' = chunk-source reads,
+# 'aot_build' = serving executable builds) plus the backoff seconds it
+# cost, the dispatch watchdog ticks wedges, and the spill CRC verifier
+# ticks crc_failures. Each event also lands as an instant on the obs
+# trace timeline, so an injected-fault run's retries/wedges appear in the
+# exported Chrome trace next to the spans they interrupted.
+_M_RETRIES = REGISTRY.counter(
+    "otpu_retries_total", "transient-failure retries, by cause")
+_M_RETRY_WAIT_S = REGISTRY.counter(
+    "otpu_retry_wait_seconds_total", "total backoff slept")
+_M_FAULTS = REGISTRY.counter(
+    "otpu_faults_injected_total", "fault-injector firings, by kind")
+_M_WEDGES = REGISTRY.counter(
+    "otpu_wedges_total", "DispatchWedgedError raised by the watchdog")
+_M_CRC_FAILURES = REGISTRY.counter(
+    "otpu_spill_crc_failures_total",
+    "spill records failing CRC verification")
 
 
 def record_retry(cause: str, wait_s: float = 0.0) -> None:
-    with _exec_lock:
-        _res_counts["retries"] += 1
-        _res_counts["retry_wait_s"] += wait_s
-        _res_by_cause[cause] = _res_by_cause.get(cause, 0) + 1
+    if not isinstance(cause, str) or not cause:
+        raise TypeError(
+            f"record_retry: cause must be a non-empty label string "
+            f"(e.g. 'source', 'aot_build'), got {cause!r}")
+    _M_RETRIES.inc(1, cause=cause)
+    _M_RETRY_WAIT_S.inc(wait_s)
+    _trace.instant("retry", cause=cause, wait_s=round(wait_s, 6))
 
 
 def record_fault(kind: str) -> None:
-    with _exec_lock:
-        _res_counts["faults_injected"] += 1
-        _fault_by_kind[kind] = _fault_by_kind.get(kind, 0) + 1
+    _M_FAULTS.inc(1, kind=kind)
+    _trace.instant("fault", kind=kind)
 
 
 def record_wedge() -> None:
-    with _exec_lock:
-        _res_counts["wedges"] += 1
+    _M_WEDGES.inc()
+    _trace.instant("wedge")
 
 
 def record_crc_failure() -> None:
-    with _exec_lock:
-        _res_counts["crc_failures"] += 1
+    _M_CRC_FAILURES.inc()
+    _trace.instant("crc_failure")
 
 
 def resilience_counters() -> dict:
     """Snapshot: the flat counters plus per-cause/per-kind breakdowns."""
-    with _exec_lock:
-        out = dict(_res_counts)
-        out["retries_by_cause"] = dict(_res_by_cause)
-        out["faults_by_kind"] = dict(_fault_by_kind)
-    return out
+    return {
+        "faults_injected": int(_M_FAULTS.total()),
+        "retries": int(_M_RETRIES.total()),
+        "retry_wait_s": float(_M_RETRY_WAIT_S.total()),
+        "wedges": int(_M_WEDGES.total()),
+        "crc_failures": int(_M_CRC_FAILURES.total()),
+        "retries_by_cause": {k: int(v) for k, v
+                             in _M_RETRIES.per_label("cause").items()},
+        "faults_by_kind": {k: int(v) for k, v
+                           in _M_FAULTS.per_label("kind").items()},
+    }
 
 
 def reset_resilience_counters() -> None:
-    with _exec_lock:
-        for k in _res_counts:
-            _res_counts[k] = type(_res_counts[k])()
-        _res_by_cause.clear()
-        _fault_by_kind.clear()
+    for m in (_M_FAULTS, _M_RETRIES, _M_RETRY_WAIT_S, _M_WEDGES,
+              _M_CRC_FAILURES):
+        m.reset()
 
 
 # -------------------------------------------- XLA compilation counter
 # One process-wide backend-compile counter fed by jax.monitoring (the
 # serving bench's ``recompiles`` field and the tests' recompile-regression
 # guard). Registered lazily and exactly once — jax has no unregister, so
-# the listener must be a permanent, cheap tick.
-_compile_count = 0
+# the listener must be a permanent, cheap tick. The tick goes to its OWN
+# registry counter (per-metric lock): a compile event never contends with
+# dispatch/serve ticks the way the old shared ``_exec_lock`` made it.
+_M_XLA_COMPILES = REGISTRY.counter(
+    "otpu_xla_compiles_total",
+    "XLA backend compiles observed via jax.monitoring")
 _compile_listener_installed = False
 
 
 def _on_compile_event(key: str, _dur: float, **_kw) -> None:
-    global _compile_count
     if "backend_compile" in key:
-        with _exec_lock:
-            _compile_count += 1
+        _M_XLA_COMPILES.inc()
 
 
 def install_compile_counter() -> bool:
@@ -220,8 +278,7 @@ def install_compile_counter() -> bool:
 def xla_compile_count() -> int:
     """Backend compiles observed since ``install_compile_counter`` (0 until
     installed — call install first, before the jits you want counted)."""
-    with _exec_lock:
-        return _compile_count
+    return int(_M_XLA_COMPILES.total())
 
 
 @contextlib.contextmanager
@@ -238,8 +295,16 @@ def debug_unjitted():
         yield
 
 
+_M_TIMED_S = REGISTRY.histogram(
+    "otpu_timed_seconds", "wall seconds of @timed-decorated calls")
+
+
 def timed(fn=None, *, name: str | None = None):
-    """Decorator: log wall-clock (+ rows/sec when the first arg is a table)."""
+    """Decorator: log wall-clock (+ rows/sec when the first arg is a table).
+
+    Also spans the call (``timed:<label>`` in obs trace dumps) and
+    observes ``otpu_timed_seconds{label=...}``; the log line itself is
+    byte-compatible with the pre-obs format."""
 
     def deco(f):
         label = name or f.__qualname__
@@ -247,8 +312,10 @@ def timed(fn=None, *, name: str | None = None):
         @wraps(f)
         def wrapper(*args, **kwargs):
             t0 = time.perf_counter()
-            out = f(*args, **kwargs)
+            with _trace.span(f"timed:{label}"):
+                out = f(*args, **kwargs)
             dt = time.perf_counter() - t0
+            _M_TIMED_S.observe(dt, label=label)
             extra = ""
             for a in args:
                 n = getattr(a, "n_rows", None)

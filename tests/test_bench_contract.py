@@ -63,7 +63,10 @@ def _run(argv, timeout=420):
       # plus the same-run f32-cache step arm
       "cache_dtype", "cache_bytes", "compression_ratio",
       "cache_rows_capacity", "pure_step_ms_f32cache",
-      "cache_step_speedup", "encode_s"}),
+      "cache_step_speedup", "encode_s",
+      # obs A/B (ISSUE 7): the same-run spans+registry-on vs OTPU_OBS=0
+      # step arm, and the embedded registry snapshot
+      "obs_overhead_pct", "pure_step_ms_obs", "obs"}),
     (["bench_suite.py", "--config", "5", "--rows-scale", "0.002"],
      "taxi_kmeans_pca_pipeline",
      {"staged_speedup", "workflow_fit_s"}),
@@ -122,6 +125,26 @@ def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
             # the ISSUE-4 capacity criterion at the real criteo layout
             # (sparse 'plan' lowering on the CPU fallback): >= 1.8x
             assert d["compression_ratio"] >= 1.8, d["compression_ratio"]
+    if argv[0] == "bench.py":
+        # every bench.py config embeds the full metrics-registry snapshot
+        # (obs/ subsystem) so banked records are self-diagnosing
+        assert isinstance(d.get("obs"), dict) and d["obs"], "obs key missing"
+        assert "otpu_dispatches_total" in d["obs"]
+        for name, m in d["obs"].items():
+            assert m["type"] in ("counter", "gauge", "histogram"), name
+            assert isinstance(m["values"], list), name
+    if "obs_overhead_pct" in extra_keys:
+        # the ISSUE-7 criterion: spans+registry measurably free (< 2%
+        # step-time overhead vs the OTPU_OBS=0 arm of the SAME run;
+        # negative = noise, i.e. indistinguishable from free). A dead
+        # post-window probe must not cost the measured line (bench.py's
+        # probe_error convention) — but a silently-missing arm must.
+        if d.get("obs_overhead_pct") is not None:
+            assert d["obs_overhead_pct"] < 2.0, d["obs_overhead_pct"]
+            assert d["pure_step_ms_obs"] and d["pure_step_ms_obs"] > 0
+        else:
+            assert d.get("probe_error"), \
+                "obs A/B arm missing without a probe_error explanation"
     if "parity_bitwise" in extra_keys:
         # the resilience claims, not just the schema: injected faults were
         # absorbed (retries happened, output bitwise-identical) and the

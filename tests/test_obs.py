@@ -1,0 +1,640 @@
+"""Observability subsystem (obs/): registry, spans, reports, endpoint.
+
+Covers the ISSUE-7 acceptance surface:
+* registry correctness under concurrency + histogram percentiles +
+  Prometheus text-format grammar;
+* legacy counter-shim parity (field-for-field vs the pre-migration dict
+  contract);
+* streaming-fit trace export = valid Chrome trace-event JSON with nested
+  fit -> epoch -> chunk -> dispatch spans, and retry/wedge instants from
+  an injected-fault run on the same timeline;
+* /metrics + /healthz on an ephemeral port, with the stale-heartbeat 503;
+* run reports on fits and serving contexts;
+* the obs_dump tool smoke and the @timed byte-compat contract.
+"""
+
+import json
+import logging
+import os
+import re
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.obs import trace
+from orange3_spark_tpu.obs.registry import (
+    Counter, Histogram, MetricsRegistry,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------- registry
+def test_counter_gauge_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "doc")
+    c.inc()
+    c.inc(2, cause="a")
+    assert c.value() == 1 and c.value(cause="a") == 2
+    assert c.total() == 3
+    assert c.per_label("cause") == {"a": 2}
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("t_gauge")
+    g.set(5)
+    g.dec(2)
+    assert g.value() == 3
+    # type collisions are programming errors, loudly
+    with pytest.raises(TypeError):
+        reg.gauge("t_total")
+    assert isinstance(reg.counter("t_total"), Counter)  # get-or-create
+
+
+def test_registry_concurrent_hammer_with_snapshots():
+    reg = MetricsRegistry()
+    c = reg.counter("h_total")
+    h = reg.histogram("h_seconds", buckets=(0.1, 1.0, 10.0))
+    n_threads, per = 8, 2000
+    stop = threading.Event()
+    snaps = []
+
+    def hammer(tid):
+        for i in range(per):
+            c.inc(1, thread=str(tid % 2))
+            h.observe((i % 30) / 10.0)
+
+    def snapshotter():
+        while not stop.is_set():
+            snaps.append(reg.snapshot())
+            reg.to_prometheus()
+
+    ts = [threading.Thread(target=hammer, args=(i,))
+          for i in range(n_threads)]
+    snap_t = threading.Thread(target=snapshotter)
+    snap_t.start()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    stop.set()
+    snap_t.join()
+    assert c.total() == n_threads * per
+    assert h.count() == n_threads * per
+    assert snaps, "snapshotter never ran"
+    # reset under a fresh hammer must not crash and must end consistent
+    def reset_racer():
+        for _ in range(50):
+            reg.reset(["h_total"])
+
+    ts = [threading.Thread(target=hammer, args=(0,)) for _ in range(4)]
+    rt = threading.Thread(target=reset_racer)
+    for t in ts + [rt]:
+        t.start()
+    for t in ts + [rt]:
+        t.join()
+    assert 0 <= c.total() <= 4 * per
+    reg.reset()
+    assert c.total() == 0 and h.count() == 0
+
+
+def test_histogram_percentiles_on_known_distribution():
+    h = Histogram("p_seconds", buckets=[i / 100 for i in range(1, 101)])
+    # uniform grid on (0, 1): percentiles are known analytically
+    for v in np.linspace(0.005, 0.995, 1000):
+        h.observe(float(v))
+    assert h.count() == 1000
+    assert abs(h.sum() - 500.0) < 1.0
+    for q in (10, 25, 50, 75, 90, 99):
+        est = h.percentile(q)
+        assert abs(est - q / 100) <= 0.02, (q, est)
+    assert h.percentile(50, other="label") is None   # empty child
+    with pytest.raises(ValueError):
+        h.percentile(101)
+    # values past the last bound land in +Inf and clamp to the top bound
+    h2 = Histogram("p2", buckets=(1.0,))
+    h2.observe(50.0)
+    assert h2.percentile(50) == 1.0
+
+
+# one metric line:  name{label="v",...} value   (exposition format 0.0.4)
+_PROM_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*")*\})?'
+    r' (?:[-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?)|\+Inf|-Inf|NaN)$')
+
+
+def test_prometheus_exposition_grammar():
+    reg = MetricsRegistry()
+    c = reg.counter("g_requests_total", 'doc with "quotes" and \\slash')
+    c.inc(3, path='/a"b\\c', verb="GET")
+    reg.gauge("g_depth", "queue depth").set(2.5)
+    h = reg.histogram("g_lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05, route="x")
+    h.observe(5.0, route="x")
+    text = reg.to_prometheus()
+    assert text.endswith("\n")
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert re.match(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+$",
+                            line), line
+            continue
+        assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
+    # histogram contract: cumulative buckets, +Inf == count, sum present
+    bl = [ln for ln in text.splitlines()
+          if ln.startswith("g_lat_seconds_bucket")]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in bl]
+    assert counts == sorted(counts) and counts[-1] == 2
+    assert 'le="+Inf"' in bl[-1]
+    assert "g_lat_seconds_count" in text and "g_lat_seconds_sum" in text
+    # TYPE lines present for each metric
+    for name in ("g_requests_total", "g_depth", "g_lat_seconds"):
+        assert f"# TYPE {name} " in text
+
+
+# ---------------------------------------------------------- shim parity
+def test_exec_counter_shim_parity():
+    from orange3_spark_tpu.exec.pipeline import PipelineStats
+    from orange3_spark_tpu.utils import profiling as P
+
+    P.reset_exec_counters()
+    base = P.exec_counters()
+    assert set(base) == {"dispatches", "prefetch_items", "prefetch_prep_s",
+                         "prefetch_wait_s", "prefetch_retries",
+                         "overlap_pct"}
+    assert base == {"dispatches": 0, "prefetch_items": 0,
+                    "prefetch_prep_s": 0.0, "prefetch_wait_s": 0.0,
+                    "prefetch_retries": 0, "overlap_pct": 0.0}
+    P.count_dispatch()
+    P.count_dispatch(2)
+    st = PipelineStats(items=3, prep_s=2.0, wait_s=0.5, retries=1)
+    P.record_pipeline(st)
+    out = P.exec_counters()
+    assert out["dispatches"] == 3 and isinstance(out["dispatches"], int)
+    assert out["prefetch_items"] == 3
+    assert out["prefetch_prep_s"] == 2.0
+    assert isinstance(out["prefetch_prep_s"], float)
+    assert out["prefetch_retries"] == 1
+    # the derived overlap formula: 100 * (1 - wait/prep), clamped
+    assert out["overlap_pct"] == pytest.approx(75.0)
+    P.reset_exec_counters()
+    assert P.exec_counters() == base
+
+
+def test_serve_counter_shim_parity_and_validation():
+    from orange3_spark_tpu.utils import profiling as P
+
+    P.reset_serve_counters()
+    base = P.serve_counters()
+    legacy_keys = {"aot_hits", "aot_misses", "aot_evictions",
+                   "aot_compile_s", "bucket_hits", "bucket_misses",
+                   "request_rows", "padded_rows", "mb_requests",
+                   "mb_batches"}
+    assert set(base) == legacy_keys | {"pad_overhead", "mb_merge_factor"}
+    assert base["pad_overhead"] is None          # zero-request semantics
+    assert base["mb_merge_factor"] is None
+    P.record_serve(aot_hits=1, aot_compile_s=0.5, request_rows=100,
+                   padded_rows=128, mb_requests=8, mb_batches=2)
+    out = P.serve_counters()
+    assert out["aot_hits"] == 1 and isinstance(out["aot_hits"], int)
+    assert out["aot_compile_s"] == 0.5
+    assert isinstance(out["aot_compile_s"], float)
+    assert out["pad_overhead"] == pytest.approx(1.28)
+    assert out["mb_merge_factor"] == pytest.approx(4.0)
+    # the satellite fix: unknown keys fail loudly NAMING key + registry
+    with pytest.raises(KeyError, match=r"buckets_hit.*registered"):
+        P.record_serve(buckets_hit=1)
+    P.reset_serve_counters()
+
+
+def test_resilience_counter_shim_parity_and_validation():
+    from orange3_spark_tpu.utils import profiling as P
+
+    P.reset_resilience_counters()
+    base = P.resilience_counters()
+    assert base == {"faults_injected": 0, "retries": 0,
+                    "retry_wait_s": 0.0, "wedges": 0, "crc_failures": 0,
+                    "retries_by_cause": {}, "faults_by_kind": {}}
+    assert isinstance(base["retry_wait_s"], float)
+    P.record_retry("source", 0.05)
+    P.record_retry("source", 0.1)
+    P.record_retry("aot_build")
+    P.record_fault("source_io")
+    P.record_wedge()
+    P.record_crc_failure()
+    out = P.resilience_counters()
+    assert out["retries"] == 3
+    assert out["retries_by_cause"] == {"source": 2, "aot_build": 1}
+    assert out["retry_wait_s"] == pytest.approx(0.15)
+    assert out["faults_injected"] == 1
+    assert out["faults_by_kind"] == {"source_io": 1}
+    assert out["wedges"] == 1 and out["crc_failures"] == 1
+    with pytest.raises(TypeError, match="non-empty label string"):
+        P.record_retry(None)
+    P.reset_resilience_counters()
+
+
+# --------------------------------------------------------------- spans
+def _fit_with_trace(session, *, epochs=2, chunks=40, chunk_rows=256,
+                    fault_spec=None, budget=None):
+    from orange3_spark_tpu.io.streaming import (
+        StreamingLinearEstimator, array_chunk_source,
+    )
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((chunks * chunk_rows, 8)).astype(np.float32)
+    y = (X @ rng.standard_normal(8).astype(np.float32) > 0
+         ).astype(np.float32)
+    src = array_chunk_source(X, y, chunk_rows=chunk_rows)
+    est = StreamingLinearEstimator(loss="logistic", epochs=epochs,
+                                   chunk_rows=chunk_rows)
+    trace.clear()
+    if fault_spec is None:
+        return est.fit_stream(src, n_features=8, session=session,
+                              cache_device=True)
+    from orange3_spark_tpu.resilience import (
+        DispatchWedgedError, inject_faults,
+    )
+
+    with inject_faults(fault_spec):
+        try:
+            return est.fit_stream(src, n_features=8, session=session,
+                                  cache_device=True)
+        except DispatchWedgedError:
+            if budget is None:
+                raise
+            return None
+
+
+def test_streaming_fit_trace_is_valid_nested_chrome_json(session, tmp_path):
+    model = _fit_with_trace(session)
+    path = str(tmp_path / "trace.json")
+    trace.export_chrome_trace(path)
+    with open(path) as f:
+        obj = json.load(f)                     # loads as REAL JSON
+    events = trace.validate_chrome_trace(obj)  # and as valid trace format
+    spans = [e for e in events if e["ph"] == "X"]
+    by = {}
+    for e in spans:
+        by.setdefault(e["name"], []).append(e)
+    for name in ("fit", "epoch", "chunk", "dispatch"):
+        assert by.get(name), f"no {name!r} spans in the fit trace"
+
+    def contains(outer, inner):
+        return (outer["tid"] == inner["tid"]
+                and outer["ts"] <= inner["ts"]
+                and inner["ts"] + inner["dur"]
+                <= outer["ts"] + outer["dur"] + 1e-3)
+
+    fit = by["fit"][0]
+    ep = by["epoch"][0]
+    assert contains(fit, ep), "epoch span not nested inside fit"
+    chunk = by["chunk"][0]
+    assert any(contains(e, chunk) for e in by["epoch"]), \
+        "chunk span not nested inside an epoch"
+    disp = by["dispatch"][0]
+    assert any(contains(c, disp) for c in by["chunk"]), \
+        "dispatch span not nested inside a chunk"
+    assert model.run_report_ is not None
+
+
+def test_injected_fault_run_puts_retry_events_on_the_timeline(
+        session, monkeypatch):
+    monkeypatch.setenv("OTPU_RETRY_BASE_S", "0.01")
+    _fit_with_trace(session, chunks=8,
+                    fault_spec="source_io:every=3,fails=1")
+    evs = trace.events()
+    instants = {e[1] for e in evs if e[0] == "i"}
+    assert "fault" in instants, "injected faults missing from timeline"
+    assert "retry" in instants, "retries missing from timeline"
+    retry = next(e for e in evs if e[0] == "i" and e[1] == "retry")
+    assert retry[5]["cause"] == "source"
+    # and they export as instant events in the Chrome JSON
+    events = trace.validate_chrome_trace(trace.export_chrome_trace())
+    assert any(e["ph"] == "i" and e["name"] == "retry" for e in events)
+
+
+def test_wedge_event_appears_on_the_timeline(session, monkeypatch):
+    monkeypatch.setenv("OTPU_DISPATCH_BUDGET_S", "0.2")
+    _fit_with_trace(session, chunks=20, epochs=1,
+                    fault_spec="wedge:at=1,hold_s=2", budget=0.2)
+    instants = {e[1] for e in trace.events() if e[0] == "i"}
+    assert "wedge" in instants, "watchdog wedge missing from timeline"
+
+
+def test_kill_switch_makes_spans_noops(monkeypatch):
+    trace.clear()
+    with trace.force_disabled():
+        with trace.span("fit"):
+            trace.instant("retry", cause="x")
+        for _ in trace.span_iter("epoch", range(3)):
+            pass
+    assert trace.events() == []
+    # env-driven path: OTPU_OBS=0 + refresh()
+    monkeypatch.setenv("OTPU_OBS", "0")
+    trace.refresh()
+    try:
+        assert not trace.enabled()
+        assert trace.span("x") is trace.span("y")   # shared no-op object
+    finally:
+        monkeypatch.setenv("OTPU_OBS", "1")
+        trace.refresh()
+    assert trace.enabled()
+
+
+def test_kill_switch_skips_run_reports(session, monkeypatch):
+    monkeypatch.setenv("OTPU_OBS", "0")
+    trace.refresh()
+    try:
+        model = _fit_with_trace(session, chunks=4, epochs=1)
+        # the report rides the kill-switch like spans and the endpoint
+        assert getattr(model, "run_report_", None) is None
+    finally:
+        monkeypatch.setenv("OTPU_OBS", "1")
+        trace.refresh()
+
+
+def test_table_fit_of_streaming_estimator_records_one_fit_span(session):
+    from orange3_spark_tpu.core.domain import ContinuousVariable, Domain
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.io.streaming import StreamingKMeans
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((600, 4)).astype(np.float32)
+    domain = Domain([ContinuousVariable(f"f{i}") for i in range(4)], None)
+    t = TpuTable.from_numpy(domain, X, session=session)
+    trace.clear()
+    # Estimator.fit brackets _fit -> fit_stream, which opens its own
+    # "fit" span: only the OUTERMOST must record (no fit ⊃ fit)
+    StreamingKMeans(k=2, epochs=1, chunk_rows=256).fit(t)
+    fits = [e for e in trace.events() if e[0] == "X" and e[1] == "fit"]
+    assert len(fits) == 1, f"expected exactly one fit span, got {fits}"
+
+
+def test_mb_deadline_zero_still_disables(session, monkeypatch):
+    from orange3_spark_tpu.serve.microbatch import MicroBatcher
+
+    monkeypatch.setenv("OTPU_MB_DEADLINE_S", "0")
+    mb = MicroBatcher(None, max_batch=64, max_wait_ms=1.0)
+    try:
+        # the PR-6 contract: an explicit 0 = legacy block-forever futures
+        assert mb.deadline_s is None
+    finally:
+        mb.close()
+
+
+def test_trace_ring_buffer_is_bounded():
+    trace.clear()
+    cap = len(trace._ring)
+    for i in range(cap + 100):
+        trace.instant("tick", i=i)
+    evs = trace.events()
+    assert len(evs) == cap
+    # oldest events were overwritten: the survivors are the LAST cap
+    assert evs[0][5]["i"] == 100 and evs[-1][5]["i"] == cap + 99
+    trace.clear()
+
+
+# ------------------------------------------------------------- reports
+def test_fit_stream_report_structure(session):
+    model = _fit_with_trace(session, chunks=6)
+    rep = model.run_report_
+    d = rep.to_dict()
+    assert d["kind"] == "fit_stream"
+    assert d["meta"]["estimator"] == "StreamingLinearEstimator"
+    assert d["wall_s"] > 0
+    assert d["stage_times"]["n_steps"] == model.n_steps_
+    assert d["counters"]["exec"]["dispatches"] > 0
+    assert "resilience" in d["counters"] and "serve" in d["counters"]
+    parsed = json.loads(rep.to_json())
+    assert parsed["kind"] == "fit_stream"
+
+
+def test_estimator_fit_attaches_report(session, tmp_path):
+    from orange3_spark_tpu.core.domain import (
+        ContinuousVariable, DiscreteVariable, Domain,
+    )
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.models.logistic_regression import (
+        LogisticRegression,
+    )
+
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((300, 5)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    domain = Domain([ContinuousVariable(f"f{i}") for i in range(5)],
+                    DiscreteVariable("c", ("0", "1")))
+    t = TpuTable.from_numpy(domain, X, y, session=session)
+    model = LogisticRegression(max_iter=4).fit(t)
+    rep = model.run_report_
+    assert rep.kind == "fit"
+    assert rep.meta["estimator"] == "LogisticRegression"
+    assert rep.wall_s > 0
+    out = str(tmp_path / "report.json")
+    rep.to_json(out)
+    with open(out) as f:
+        assert json.load(f)["meta"]["n_rows"] == 300
+
+
+def test_hashed_fit_report_carries_stage_times(session):
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+
+    rng = np.random.default_rng(0)
+    n, n_dense, n_cat = 2048, 3, 2
+    X = np.concatenate([
+        (rng.random((n, 1)) > 0.5).astype(np.float32),
+        rng.standard_normal((n, n_dense)).astype(np.float32),
+        rng.integers(0, 50, (n, n_cat)).astype(np.float32),
+    ], axis=1)
+    est = StreamingHashedLinearEstimator(
+        n_dims=1 << 12, n_dense=n_dense, n_cat=n_cat, epochs=2,
+        chunk_rows=512, label_in_chunk=True)
+    model = est.fit_stream(
+        lambda: iter([X[:1024], X[1024:]]), session=session,
+        cache_device=True)
+    st = model.run_report_.stage_times
+    # the report carries the same stage keys the stage_times= plumbing
+    # exposes — without the caller having had to pass a dict
+    for key in ("parse_s", "h2d_s", "epoch_s", "cache_dtype",
+                "optim_update", "replay_source"):
+        assert key in st, key
+    # caller-dict compat: same fit WITH stage_times= sees the same keys
+    st2: dict = {}
+    est.fit_stream(lambda: iter([X[:1024], X[1024:]]), session=session,
+                   cache_device=True, stage_times=st2)
+    assert set(st) <= set(st2) | {"n_steps"}
+
+
+def test_serving_context_report(session):
+    from orange3_spark_tpu.serve import BucketLadder, ServingContext
+    from orange3_spark_tpu.utils.profiling import count_dispatch
+
+    ctx = ServingContext(BucketLadder(min_bucket=64, max_bucket=512))
+    with ctx:
+        rep = ctx.report()
+    assert rep["kind"] == "serving"
+    assert rep["meta"]["ladder"] == list(ctx.ladder.buckets())
+    assert rep["cache_entries"] == 0
+    assert "serve" in rep["counters"]
+    json.dumps(rep)     # JSON-able end to end
+    # the window FREEZES at the last __exit__: later process activity
+    # must not be misattributed to the serving window
+    after_exit = ctx.report()
+    count_dispatch(50)
+    later = ctx.report()
+    assert later["wall_s"] == after_exit["wall_s"]
+    assert later["counters"] == after_exit["counters"]
+    # a never-entered context has no window: absolute counters, honestly
+    ctx2 = ServingContext(BucketLadder(min_bucket=64, max_bucket=512))
+    rep2 = ctx2.report()
+    assert rep2["meta"]["window"] == "process-absolute"
+    assert rep2["wall_s"] is None
+    assert rep2["counters"]["exec"]["dispatches"] >= 50
+
+
+# ---------------------------------------------------- telemetry endpoint
+@pytest.fixture()
+def obs_server_ctx(session, monkeypatch):
+    from orange3_spark_tpu.serve import BucketLadder, ServingContext
+
+    monkeypatch.setenv("OTPU_OBS_PORT", "0")      # ephemeral port
+    ctx = ServingContext(BucketLadder(min_bucket=64, max_bucket=512))
+    with ctx:
+        assert ctx._telemetry is not None, "telemetry server did not bind"
+        yield ctx
+    assert ctx._telemetry is None                 # stopped on last exit
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+def test_metrics_endpoint_serves_prometheus_text(obs_server_ctx, session):
+    from orange3_spark_tpu.core.domain import (
+        ContinuousVariable, DiscreteVariable, Domain,
+    )
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.models.kmeans import KMeans
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((200, 4)).astype(np.float32)
+    domain = Domain([ContinuousVariable(f"f{i}") for i in range(4)], None)
+    t = TpuTable.from_numpy(domain, X, session=session)
+    model = KMeans(k=3, max_iter=3).fit(t)
+    model.predict(t)       # routed through the active context
+    status, body = _get(obs_server_ctx._telemetry.url + "/metrics")
+    assert status == 200
+    # the acceptance criterion: aot/bucket/mb counters are scrapeable
+    for name in ("otpu_serve_aot_hits_total", "otpu_serve_aot_misses_total",
+                 "otpu_serve_bucket_hits_total",
+                 "otpu_serve_bucket_misses_total",
+                 "otpu_serve_mb_requests_total", "otpu_dispatches_total"):
+        assert name in body, name
+    assert "# TYPE otpu_dispatches_total counter" in body
+
+
+def test_healthz_degrades_on_stale_heartbeat(obs_server_ctx, monkeypatch):
+    from orange3_spark_tpu.serve import context as serve_context
+    from orange3_spark_tpu.utils import dispatch
+
+    url = obs_server_ctx._telemetry.url + "/healthz"
+    dispatch.beat()
+    status, body = _get(url)
+    assert status == 200
+    d = json.loads(body)
+    assert d["status"] == "ok" and d["last_beat_age_s"] < 60
+    assert {"wedges", "retries", "dispatches", "mb_queue_depth",
+            "in_flight"} <= set(d)
+    # age the heartbeat past the threshold with a serve call in flight
+    # (the wedged-dispatch signature): /healthz must go 503
+    monkeypatch.setattr(dispatch, "_last_beat",
+                        time.monotonic() - 10_000)
+    serve_context._M_INFLIGHT.inc()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(url)
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["status"] == "stale"
+    finally:
+        serve_context._M_INFLIGHT.dec()
+    # same stale beat with NOTHING in flight = merely idle, still healthy
+    # (a load balancer must not eject a backend for a quiet minute)
+    status, body = _get(url)
+    assert status == 200
+    assert json.loads(body)["status"] == "idle"
+    dispatch.beat()
+
+
+def test_endpoint_never_binds_under_kill_switch(session, monkeypatch):
+    from orange3_spark_tpu.serve import BucketLadder, ServingContext
+
+    monkeypatch.setenv("OTPU_OBS_PORT", "0")
+    monkeypatch.setenv("OTPU_OBS", "0")
+    trace.refresh()
+    try:
+        with ServingContext(BucketLadder(min_bucket=64,
+                                         max_bucket=512)) as ctx:
+            assert ctx._telemetry is None
+    finally:
+        monkeypatch.setenv("OTPU_OBS", "1")
+        trace.refresh()
+    # and with no port at all, nothing binds either
+    monkeypatch.delenv("OTPU_OBS_PORT")
+    with ServingContext(BucketLadder(min_bucket=64,
+                                     max_bucket=512)) as ctx:
+        assert ctx._telemetry is None
+    # a malformed port must stay unbound (no surprise ephemeral listener
+    # the operator's scrape can't find), not crash activation
+    monkeypatch.setenv("OTPU_OBS_PORT", "9090x")
+    with ServingContext(BucketLadder(min_bucket=64,
+                                     max_bucket=512)) as ctx:
+        assert ctx._telemetry is None
+
+
+# ------------------------------------------------------------- tooling
+def test_obs_dump_tool_smoke(session, tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from obs_dump import run_dump
+    finally:
+        sys.path.pop(0)
+    out = run_dump(rows=2048, session=session,
+                   trace_out=str(tmp_path / "t.json"))
+    assert out["trace_valid"] is True
+    assert {"fit", "epoch", "chunk", "serve"} <= set(out["span_names"])
+    assert out["snapshot"]["otpu_dispatches_total"]["type"] == "counter"
+    with open(tmp_path / "t.json") as f:
+        trace.validate_chrome_trace(json.load(f))
+    assert out["fit_report"]["kind"] == "fit_stream"
+    json.dumps(out["snapshot"])
+
+
+# ---------------------------------------------------------------- timed
+def test_timed_log_line_byte_compatible_and_instrumented(caplog):
+    from orange3_spark_tpu.obs.registry import REGISTRY
+    from orange3_spark_tpu.utils.profiling import timed
+
+    @timed(name="obs_test_fn")
+    def work():
+        return 42
+
+    hist = REGISTRY.get("otpu_timed_seconds")
+    before = hist.count(label="obs_test_fn")
+    trace.clear()
+    with caplog.at_level(logging.INFO, logger="orange3_spark_tpu"):
+        assert work() == 42
+    # byte-compatible log line: "<label>: <secs>.3fs" (no suffix w/o rows)
+    msgs = [r.getMessage() for r in caplog.records
+            if "obs_test_fn" in r.getMessage()]
+    assert msgs and re.fullmatch(r"obs_test_fn: \d+\.\d{3}s", msgs[-1])
+    # ...and the call now reaches the obs surfaces too
+    assert hist.count(label="obs_test_fn") == before + 1
+    assert any(e[1] == "timed:obs_test_fn" for e in trace.events())

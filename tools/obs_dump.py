@@ -1,0 +1,117 @@
+"""One-shot observability smoke — metrics snapshot + trace export.
+
+Runs a small streaming fit plus a short served predict trace, then:
+
+* prints the full metrics-registry snapshot (the same structure bench.py
+  embeds under its ``obs`` key),
+* exports the recorded spans as Chrome trace-event JSON and validates it
+  against the format's object-form rules (the file loads in Perfetto /
+  ``chrome://tracing``),
+* prints a one-line summary JSON (the capture-watcher banking convention).
+
+The quick "is the whole obs surface wired?" probe: fit/epoch/chunk/
+dispatch spans from the fit, serve spans + aot/bucket counters from the
+trace, and a parseable export — all in a few seconds on CPU.
+
+Importable: ``run_dump(rows=..., session=...)`` returns the summary dict
+(the not-slow smoke test in tests/test_obs.py calls it directly).
+
+Usage:
+    python tools/obs_dump.py [--rows 8192] [--trace-out /tmp/otpu_trace.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def run_dump(rows: int = 8192, session=None,
+             trace_out: str | None = None) -> dict:
+    import numpy as np
+
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.io.streaming import (
+        StreamingLinearEstimator, array_chunk_source,
+    )
+    from orange3_spark_tpu.obs import REGISTRY, trace
+    from orange3_spark_tpu.serve import BucketLadder, ServingContext
+
+    session = session or TpuSession.builder_get_or_create()
+    chunk_rows = 512
+    n_features = 4
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((rows, n_features)).astype(np.float32)
+    y = (X @ rng.standard_normal(n_features).astype(np.float32) > 0
+         ).astype(np.float32)
+    src = array_chunk_source(X, y, chunk_rows=chunk_rows)
+
+    trace.clear()
+    model = StreamingLinearEstimator(
+        loss="logistic", epochs=2, step_size=0.1, chunk_rows=chunk_rows,
+    ).fit_stream(src, n_features=n_features, session=session,
+                 cache_device=True)
+
+    # short served trace: three mixed-size predicts through the bucketed
+    # AOT path (ticks the serve counters and records "serve" spans)
+    from orange3_spark_tpu.core.domain import (
+        ContinuousVariable, DiscreteVariable, Domain,
+    )
+    from orange3_spark_tpu.core.table import TpuTable
+
+    domain = Domain([ContinuousVariable(f"f{i}") for i in range(n_features)],
+                    DiscreteVariable("y", ("0", "1")))
+    ctx = ServingContext(BucketLadder(min_bucket=64,
+                                      max_bucket=max(chunk_rows, 64)))
+    with ctx:
+        for n in (32, 100, min(rows, chunk_rows)):
+            t = TpuTable.from_numpy(domain, X[:n], y[:n], session=session)
+            model.predict(t)
+        serve_report = ctx.report()
+
+    exported = trace.export_chrome_trace(trace_out)
+    events = trace.validate_chrome_trace(exported)   # raises if malformed
+    span_names = sorted({e["name"] for e in events if e["ph"] == "X"})
+    snapshot = REGISTRY.snapshot()
+    # under OTPU_OBS=0 there are no spans and no run report — the tool
+    # still dumps the registry (live by design) instead of crashing
+    fit_report = getattr(model, "run_report_", None)
+    return {
+        "metric": "obs_dump",
+        "rows": rows,
+        "obs_enabled": trace.enabled(),
+        "fit_report": fit_report.to_dict() if fit_report else None,
+        "serve_report": serve_report,
+        "trace_events": len(events),
+        "span_names": span_names,
+        "trace_valid": True,
+        "trace_path": trace_out,
+        "snapshot_metrics": len(snapshot),
+        "snapshot": snapshot,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=8192)
+    ap.add_argument("--trace-out", default="/tmp/otpu_trace.json")
+    args = ap.parse_args()
+    out = run_dump(rows=args.rows, trace_out=args.trace_out)
+    print("== metrics snapshot ==")
+    print(json.dumps(out["snapshot"], indent=2))
+    print(f"== trace: {out['trace_events']} events "
+          f"({', '.join(out['span_names'])}) -> {out['trace_path']} ==")
+    summary = {k: v for k, v in out.items()
+               if k not in ("snapshot", "fit_report", "serve_report")}
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
