@@ -1289,10 +1289,214 @@ def bench_fault(*, rows: int = 262_144, epochs: int = 4) -> dict:
     }
 
 
+def bench_overload(*, requests: int = 64, service_ms: float = 25.0) -> dict:
+    """Overload-protection A/B (docs/resilience.md, resilience/overload.py):
+    an OPEN-LOOP burst of mixed-size predict requests arrives faster than
+    the (injected-slow) serving path can drain, raw vs
+    admission-controlled.
+
+      raw       OTPU_RESILIENCE=0 — the legacy unbounded queue: every
+                request eventually completes, but p99 is the whole
+                backlog's service time (queueing-theory blowup);
+      admitted  admission control with a 120 ms request deadline — a
+                request whose projected queue wait exceeds its deadline
+                sheds IMMEDIATELY with a typed OverloadShedError, the
+                adaptive coalescer grows its merge window to drain the
+                rest, and completed-request p99 stays bounded.
+
+    The injected ``overload:delay_ms`` fault makes per-dispatch service
+    time deterministic, so the A/B measures the CONTROL LOGIC, not the
+    host's XLA latency du jour. The line also drills the circuit breaker
+    (a flaky-AOT backend re-admitted through half-open where the old
+    blacklist stayed dead) and the memory-pressure brownout ladder (an
+    injected mem_pressure fraction degrades the HBM chunk cache instead
+    of dying). ``p99_bound_factor`` (raw p99 / admitted p99), goodput and
+    shed fraction are the headline fields; zero hung or lost futures is
+    part of the claim."""
+    import concurrent.futures
+
+    import jax
+    import numpy as np
+
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.io.streaming import (
+        StreamingLinearEstimator, array_chunk_source,
+    )
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+    from orange3_spark_tpu.resilience import (
+        OverloadShedError, inject_faults,
+    )
+    from orange3_spark_tpu.resilience.overload import (
+        current_brownout_level, shed_total,
+    )
+    from orange3_spark_tpu.serve import BucketLadder, ServingContext
+
+    session = TpuSession.builder_get_or_create()
+    n_chips = session.n_devices
+    n_dense, n_cat = 4, 4
+    rng = np.random.default_rng(7)
+    rows_fit = 1 << 14
+    X = np.concatenate([
+        rng.standard_normal((rows_fit, n_dense)).astype(np.float32),
+        rng.integers(0, 1000, (rows_fit, n_cat)).astype(np.float32),
+    ], axis=1)
+    y = (rng.random(rows_fit) < 0.3).astype(np.float32)
+    _log("[overload] fitting the tiny CTR model ...")
+    model = StreamingHashedLinearEstimator(
+        n_dims=1 << 14, n_dense=n_dense, n_cat=n_cat, epochs=1,
+        step_size=0.05, chunk_rows=4096,
+    ).fit_stream(array_chunk_source(X, y, chunk_rows=4096), session=session)
+
+    # deterministic open-loop burst: mixed sizes, 2 ms arrival spacing —
+    # far faster than the injected ~25 ms/dispatch service rate
+    sizes = np.exp(rng.uniform(np.log(64), np.log(256), requests)
+                   ).astype(np.int64)
+    offs = rng.integers(0, rows_fit - int(sizes.max()), requests)
+    stagger_s = 0.002
+    ladder = BucketLadder(min_bucket=64, max_bucket=1 << 12)
+
+    def run_arm(env: dict, label: str) -> dict:
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        lat_ok, lat_shed, lost = [], [], 0
+        try:
+            with ServingContext(ladder, micro_batch=True, max_batch=256,
+                                max_wait_ms=1.0) as ctx:
+                ctx.warmup(model, n_cols=n_dense + n_cat,
+                           kinds=("array",), session=session)
+
+                def one(i: int):
+                    time.sleep(i * stagger_s)    # the arrival schedule
+                    o, s = int(offs[i]), int(sizes[i])
+                    t0 = time.perf_counter()
+                    try:
+                        out = model.predict(X[o:o + s])
+                        assert out.shape[0] == s
+                        return "ok", (time.perf_counter() - t0) * 1e3
+                    except OverloadShedError:
+                        return "shed", (time.perf_counter() - t0) * 1e3
+
+                _log(f"[overload] {label} arm: {requests} requests ...")
+                t0 = time.perf_counter()
+                with inject_faults(f"overload:delay_ms={service_ms}"):
+                    # no `with` block: shutdown(wait=False) — a genuinely
+                    # hung future must be REPORTED as hung_futures, not
+                    # deadlock the bench joining its blocked thread
+                    ex = concurrent.futures.ThreadPoolExecutor(requests)
+                    try:
+                        futs = [ex.submit(one, i) for i in range(requests)]
+                        done, pending = concurrent.futures.wait(
+                            futs, timeout=120.0)
+                        lost = len(pending)
+                        for f in done:
+                            kind, ms = f.result()
+                            (lat_ok if kind == "ok" else lat_shed).append(ms)
+                    finally:
+                        ex.shutdown(wait=False)
+                wall = time.perf_counter() - t0
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        return {"lat_ok": lat_ok, "sheds": len(lat_shed), "lost": lost,
+                "wall_s": wall, "completed": len(lat_ok),
+                "rows_total": int(sizes.sum())}
+
+    def pctl(lat, q):
+        return round(float(np.percentile(np.asarray(lat), q)), 3)
+
+    # ---- arm 1: legacy unbounded (the kill-switch contract) ----
+    raw = run_arm({"OTPU_RESILIENCE": "0"}, "raw (OTPU_RESILIENCE=0)")
+    # ---- arm 2: admission-controlled ----
+    shed0 = shed_total()
+    adm = run_arm({
+        "OTPU_RESILIENCE": "1",
+        "OTPU_ADMISSION_DEADLINE_S": "0.1",
+        "OTPU_ADMISSION_SERVICE_MS": str(service_ms),
+    }, "admission-controlled")
+    typed_sheds = shed_total() - shed0
+
+    # ---- circuit-breaker drill: flaky AOT backend re-admitted ----
+    _log("[overload] circuit-breaker half-open drill ...")
+    clk = [0.0]
+    os.environ.setdefault("OTPU_RETRY_BASE_S", "0.02")
+    breaker_readmitted = False
+    with ServingContext(ladder, breaker_clock=lambda: clk[0]) as ctx2:
+        with inject_faults("aot_build:fails=4,key=array"):
+            model.predict(X[:64])        # build exhausts retries -> open
+        st = ctx2.breaker_states()
+        was_open = st.get("HashedLinearModel:array") == "open"
+        clk[0] += 30.0                   # past the seeded cooldown
+        model.predict(X[:64])            # half-open probe build succeeds
+        breaker_readmitted = (
+            was_open and ctx2.breaker_states()
+            .get("HashedLinearModel:array") == "closed")
+
+    # ---- brownout drill: injected memory pressure degrades, not dies ----
+    _log("[overload] memory-pressure brownout drill ...")
+    Xs = rng.standard_normal((8192, 8)).astype(np.float32)
+    ys = (Xs @ rng.standard_normal(8).astype(np.float32) > 0
+          ).astype(np.float32)
+    with inject_faults("mem_pressure:frac=0.97,after=2"):
+        m2 = StreamingLinearEstimator(
+            loss="logistic", epochs=2, step_size=0.05, chunk_rows=1024,
+        ).fit_stream(array_chunk_source(Xs, ys, chunk_rows=1024),
+                     n_features=8, session=session, cache_device=True)
+        jax.block_until_ready(m2.coef)
+    brownout_reached = current_brownout_level()
+
+    p99_raw = pctl(raw["lat_ok"], 99) if raw["lat_ok"] else None
+    p99_adm = pctl(adm["lat_ok"], 99) if adm["lat_ok"] else None
+    factor = (round(p99_raw / p99_adm, 2)
+              if p99_raw and p99_adm else None)
+    goodput_rows = adm["rows_total"]
+    return {
+        "metric": "overload_admission_p99_bound_factor",
+        "value": factor if factor is not None else 0,
+        "unit": "x",
+        # an overload A/B has no external baseline: the raw arm IS the
+        # denominator, reported as p99_bound_factor
+        "vs_baseline": None,
+        "backend": jax.default_backend(),
+        "requests": requests,
+        "service_ms_injected": service_ms,
+        # ---- the acceptance-criterion fields ----
+        "p99_ms_admitted": p99_adm,
+        "p50_ms_admitted": pctl(adm["lat_ok"], 50) if adm["lat_ok"] else None,
+        "p99_ms_raw": p99_raw,
+        "p50_ms_raw": pctl(raw["lat_ok"], 50) if raw["lat_ok"] else None,
+        "p99_bound_factor": factor,
+        "sheds": adm["sheds"],
+        "typed_sheds": typed_sheds,
+        "shed_fraction": round(adm["sheds"] / requests, 3),
+        "completed": adm["completed"],
+        "hung_futures": adm["lost"],
+        "lost_futures": requests - adm["completed"] - adm["sheds"]
+        - adm["lost"],
+        # completed-request rows (avg size x completes) over the arm wall
+        "goodput_rows_per_s_per_chip": round(
+            (goodput_rows / requests) * adm["completed"]
+            / adm["wall_s"] / n_chips, 1),
+        # ---- the legacy (kill-switch) contract ----
+        "legacy_unbounded": (raw["sheds"] == 0 and raw["lost"] == 0
+                             and raw["completed"] == requests),
+        "raw_wall_s": round(raw["wall_s"], 3),
+        "admitted_wall_s": round(adm["wall_s"], 3),
+        # ---- breaker + brownout drills ----
+        "breaker_readmitted": breaker_readmitted,
+        "brownout_level_reached": brownout_reached,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="criteo",
-                    choices=["criteo", "dense_logreg", "serving", "fault"])
+                    choices=["criteo", "dense_logreg", "serving", "fault",
+                             "overload"])
     ap.add_argument("--rows", type=int, default=N_ROWS)
     ap.add_argument("--epochs", type=int, default=EPOCHS)
     # None = per-config default (criteo N_DIMS, serving's lighter 1<<18 —
@@ -1584,6 +1788,8 @@ def _main_locked(args, rows, cpu_rows, lk, t_budget0, force_cpu=False):
             return bench_fault(
                 rows=(args.rows if args.rows != N_ROWS else 262_144),
                 epochs=(args.epochs if args.epochs != EPOCHS else 4))
+        if args.config == "overload":
+            return bench_overload()
         return bench_dense_logreg()
 
     if args.profile:

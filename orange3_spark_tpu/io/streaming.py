@@ -45,6 +45,7 @@ from orange3_spark_tpu.io.multihost import put_sharded
 from orange3_spark_tpu.obs.report import RunReport
 from orange3_spark_tpu.obs.trace import refreshed_enabled as obs_enabled
 from orange3_spark_tpu.obs.trace import span, span_iter, traced
+from orange3_spark_tpu.resilience.numerics import check_finite_training
 from orange3_spark_tpu.utils.dispatch import bound_dispatch
 from orange3_spark_tpu.utils.profiling import count_dispatch
 from orange3_spark_tpu.models.base import Estimator, Params
@@ -641,8 +642,25 @@ class _DeviceCache:
         if not self.enabled:
             return
         self.offered += 1
+        # memory-pressure brownout ladder (resilience/overload.py; inert —
+        # level 0 — unless a pressure source is configured): 1 = admit
+        # only to HALF the budget, 2 = stop admitting (the existing miss/
+        # latch machinery routes replay to the spill or the re-streamed
+        # source), 3 = drop the cache NOW, freeing the HBM it holds
+        from orange3_spark_tpu.resilience.overload import brownout_level
+
+        lvl = brownout_level()
+        if lvl >= 3:
+            self.enabled = False
+            self.degraded = True
+            self.batches = []
+            self.nbytes = 0
+            self.first_miss = None
+            return
+        budget = self.budget // 2 if lvl == 1 else self.budget
         sz = self._size(batch)
-        if self.first_miss is None and self.nbytes + sz <= self.budget:
+        if (lvl < 2 and self.first_miss is None
+                and self.nbytes + sz <= budget):
             self.batches.append(batch)
             self.nbytes += sz
         else:
@@ -1434,6 +1452,9 @@ class StreamingKMeans(Estimator):
                         )
                         n_steps += 1
                         bound_dispatch(n_steps, cost)
+                check_finite_training(None, centers, epoch=epoch,
+                                      chunk=n_steps,
+                                      estimator="StreamingKMeans")
                 continue
             for X_np, _, w_np in _rechunk(source(), pad_rows):
                 n = X_np.shape[0]
@@ -1520,6 +1541,11 @@ class StreamingKMeans(Estimator):
             spill.delete()
         if centers is None:
             raise ValueError("stream produced no live rows")
+        # streaming epoch-1 and fused-replay paths end here: one final
+        # non-finite guard (typed divergence instead of NaN centers)
+        check_finite_training(None, centers, epoch=p.epochs - 1,
+                              chunk=n_steps, final=True,
+                              estimator="StreamingKMeans")
         model = KMeansModel(KMeansParams(k=p.k), centers)
         model.n_iter_ = n_steps
         if report is not None:
@@ -1677,6 +1703,12 @@ class StreamingLinearEstimator(Estimator):
                 )
 
         def epoch_snapshot(epoch):
+            # non-finite guard (resilience/numerics.py) BEFORE the save:
+            # a divergent epoch must raise typed, never checkpoint NaN
+            # state a resume would silently continue from
+            check_finite_training(last_loss, theta, epoch=epoch,
+                                  chunk=n_steps,
+                                  estimator="StreamingLinearEstimator")
             # one shared save decision (epoch_boundary_snapshot) — called
             # at the end of every trained epoch, whatever path ran it
             epoch_boundary_snapshot(
@@ -1826,6 +1858,12 @@ class StreamingLinearEstimator(Estimator):
                 break
         if spill is not None:
             spill.delete()
+        # the fused-replay paths break out before another epoch_snapshot:
+        # one final guard (loss AND theta — a last-step divergence only
+        # shows in theta) so a replay that diverged still raises typed
+        check_finite_training(last_loss, theta, epoch=p.epochs - 1,
+                              chunk=n_steps, final=True,
+                              estimator="StreamingLinearEstimator")
         model = self._wrap_model(theta, k, class_values)
         model.n_steps_ = n_steps
         model.final_loss_ = float(last_loss) if last_loss is not None else None

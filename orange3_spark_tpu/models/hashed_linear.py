@@ -75,6 +75,7 @@ from orange3_spark_tpu.optim.sparse import (
 from orange3_spark_tpu.obs.report import RunReport
 from orange3_spark_tpu.obs.trace import span, span_iter, traced
 from orange3_spark_tpu.obs.trace import refreshed_enabled as obs_enabled
+from orange3_spark_tpu.resilience.numerics import check_finite_training
 from orange3_spark_tpu.utils.dispatch import bound_dispatch
 from orange3_spark_tpu.utils.profiling import count_dispatch
 
@@ -1834,6 +1835,11 @@ class StreamingHashedLinearEstimator(Estimator):
                             n_steps += 1
                             continue
                         run_step(dev_chunk)
+            # non-finite guard (resilience/numerics.py) BEFORE the save:
+            # a divergent epoch raises typed, never checkpoints NaN state
+            check_finite_training(
+                last_loss, theta, epoch=epoch, chunk=n_steps,
+                estimator="StreamingHashedLinearEstimator")
             # epoch-boundary snapshot (checkpoint_every_epochs cadence):
             # the shared save decision covers every epoch path above
             epoch_boundary_snapshot(
@@ -1921,6 +1927,11 @@ class StreamingHashedLinearEstimator(Estimator):
 
         if spill is not None:
             spill.delete()
+        # fused replay breaks out past the per-epoch guard: final check
+        # (loss AND theta — a last-step divergence only shows in theta)
+        check_finite_training(
+            last_loss, theta, epoch=p.epochs - 1, chunk=n_steps,
+            final=True, estimator="StreamingHashedLinearEstimator")
         if is_sparse_update(optim_resolved):
             # settle the lazy decay the table still owes (rows untouched
             # since their last step) so the returned model equals the

@@ -8,7 +8,9 @@ here is a stdlib ``ThreadingHTTPServer`` on a daemon thread exposing:
 * ``GET /healthz``  — JSON liveness: seconds since the last progress beat
   (``utils.dispatch.beat`` — every step loop, prefetch worker, routed
   serve call and micro-batch flush ticks it), in-flight/wedge/retry
-  counts and the micro-batcher queue depth. Returns **503** once the
+  counts, the micro-batcher queue depth, admission-control shed totals
+  and the memory-pressure ``brownout_level``
+  (resilience/overload.py). Returns **503** once the
   beat is older than ``OTPU_OBS_STALE_S`` (default 60 s) WHILE work is
   in flight — the round-4 wedged-dispatch signature. An idle process
   (nothing in flight, nothing to beat about) reports ``idle`` and stays
@@ -118,6 +120,9 @@ class TelemetryServer:
         acting on this endpoint would permanently eject every backend
         that sees a quiet minute."""
         from orange3_spark_tpu.obs.registry import REGISTRY
+        from orange3_spark_tpu.resilience.overload import (
+            brownout_level, shed_total,
+        )
         from orange3_spark_tpu.utils.dispatch import last_beat
         from orange3_spark_tpu.utils.profiling import (
             exec_counters, resilience_counters,
@@ -147,6 +152,14 @@ class TelemetryServer:
             "crc_failures": res["crc_failures"],
             "dispatches": ex["dispatches"],
             "mb_queue_depth": depth,
+            # overload-protection state (resilience/overload.py): how
+            # hard admission control is shedding, and which brownout
+            # rung the memory-pressure ladder lands on — RECOMPUTED per
+            # scrape (a level-3 spike during a finished fit must not be
+            # echoed forever), so a load balancer can steer AWAY from a
+            # browned-out backend and return once pressure subsides
+            "sheds": shed_total(),
+            "brownout_level": brownout_level(consume=False),
         }, healthy
 
 

@@ -23,6 +23,15 @@ measured, bounded overhead:
   ``OTPU_DISPATCH_BUDGET_S`` raises a typed ``DispatchWedgedError``
   carrying stage/step/beat diagnostics instead of hanging the process
   forever (the round-4 tunnel-wedge signature).
+* ``overload`` — overload protection & graceful degradation: admission
+  control with projected-wait shedding (``OverloadShedError``), the
+  closed/open/half-open ``CircuitBreaker`` (replacing the serving
+  first-failure blacklist and fast-failing repeated wedges), adaptive
+  micro-batch coalescing, and memory-pressure brownout watermarks
+  feeding the ``_DeviceCache`` degrade ladder.
+* ``numerics`` — the per-epoch non-finite training guard
+  (``NumericalDivergenceError`` naming epoch and chunk ordinal instead
+  of silently training to NaN).
 
 Crash-resumable fits: ``checkpoint_every_epochs`` on
 ``StreamingLinearParams``/``HashedLinearParams`` snapshots training state
@@ -54,6 +63,18 @@ from orange3_spark_tpu.resilience.retry import (
     resilient_source,
     retry_call,
 )
+from orange3_spark_tpu.resilience.numerics import (
+    NumericalDivergenceError,
+    check_finite_training,
+)
+from orange3_spark_tpu.resilience.overload import (
+    AdaptiveCoalescer,
+    AdmissionController,
+    CircuitBreaker,
+    OverloadShedError,
+    brownout_level,
+    request_deadline,
+)
 from orange3_spark_tpu.resilience.watchdog import (
     DispatchWedgedError,
     dispatch_budget_s,
@@ -62,17 +83,25 @@ from orange3_spark_tpu.resilience.watchdog import (
 from orange3_spark_tpu.utils.fault import StreamCheckpointer
 
 __all__ = [
+    "AdaptiveCoalescer",
+    "AdmissionController",
+    "CircuitBreaker",
     "DispatchWedgedError",
     "FaultSpec",
+    "NumericalDivergenceError",
+    "OverloadShedError",
     "RetryPolicy",
     "StreamCheckpointer",
     "TransientBuildError",
     "TransientSourceError",
     "active_fault_spec",
+    "brownout_level",
+    "check_finite_training",
     "dispatch_budget_s",
     "guarded_block_until_ready",
     "inject_faults",
     "is_transient",
+    "request_deadline",
     "resilience_enabled",
     "resilient_source",
     "retry_call",

@@ -28,6 +28,15 @@ either explicit ordinals or a seeded hash, never wall-clock or id()):
 * ``aot_build``     the first ``fails=N`` AOT builds in the serving
   ``ExecutableCache`` raise ``TransientBuildError`` (optionally only for
   keys whose repr contains ``key=SUBSTR``).
+* ``overload``      sleep ``delay_ms`` inside each of the first
+  ``requests=N`` serving dispatches (``-1`` = every dispatch, the
+  default) — the deterministic slow-service load the admission
+  controller's shed/deadline logic is tested and benched against
+  (resilience/overload.py).
+* ``mem_pressure``  report a synthetic memory-pressure fraction
+  ``frac=F`` to the brownout watermarks (after the first ``after=K``
+  queries, default 0) — drives the shrink-admission/force-spill/degrade
+  ladder without actually exhausting host RAM.
 
 State (per-ordinal fail budgets, sync counters) lives on the ``FaultSpec``
 instance, so a retried read observes the budget already consumed — that is
@@ -73,7 +82,8 @@ class TransientBuildError(RuntimeError):
     """Injected transient AOT-build failure (retryable by contract)."""
 
 
-_KINDS = ("source_io", "slow_source", "spill_corrupt", "wedge", "aot_build")
+_KINDS = ("source_io", "slow_source", "spill_corrupt", "wedge", "aot_build",
+          "overload", "mem_pressure")
 
 
 def _record_fault(kind: str) -> None:
@@ -89,8 +99,10 @@ class _Clause:
         self.kind = kind
         self.args = args
         self.fail_left: dict[int, int] = {}   # ordinal -> remaining fails
-        self.sync_seen = 0                    # wedge: guarded syncs seen
+        self.sync_seen = 0                    # wedge/overload/mem_pressure:
+        #                                       consuming queries seen
         self.build_fails_done = 0             # aot_build: raises so far
+        self.fired = False                    # mem_pressure: counter ticked
 
     def _arg(self, key, default=None, cast=float):
         v = self.args.get(key)
@@ -209,6 +221,43 @@ class FaultSpec:
                 if c.sync_seen == int(c._arg("at", 1, cast=int)):
                     _record_fault("wedge")
                     return c._arg("hold_s", 3600.0)
+        return None
+
+    def take_overload_delay(self) -> float | None:
+        """Seconds of injected service delay for THIS serving dispatch
+        (the Nth since the spec was installed), else None. ``requests=N``
+        bounds the slow spell (default -1 = every dispatch)."""
+        for c in self._of("overload"):
+            with self._lock:
+                c.sync_seen += 1
+                budget = int(c._arg("requests", -1, cast=int))
+                if 0 <= budget < c.sync_seen:
+                    continue
+            _record_fault("overload")
+            return c._arg("delay_ms", 10.0) / 1e3
+        return None
+
+    def mem_pressure_frac(self, consume: bool = True) -> float | None:
+        """Synthetic memory-pressure fraction for the brownout
+        watermarks, else None. ``after=K`` keeps the first K CONSUMING
+        queries (chunk offers) pressure-free so a ladder test can cache
+        a prefix before the squeeze; side observers (/healthz scrapes)
+        pass ``consume=False`` and never advance the budget — a load
+        balancer polling health must not shift deterministic targeting.
+        The fault counter ticks once per clause, at first activation."""
+        for c in self._of("mem_pressure"):
+            fire = False
+            with self._lock:
+                if consume:
+                    c.sync_seen += 1
+                if c.sync_seen <= int(c._arg("after", 0, cast=int)):
+                    continue
+                if consume and not c.fired:
+                    c.fired = True
+                    fire = True
+            if fire:
+                _record_fault("mem_pressure")
+            return c._arg("frac", 1.0)
         return None
 
     # ----------------------------------------------------- serving hooks

@@ -1,0 +1,88 @@
+"""Non-finite training guard — typed divergence instead of silent NaN.
+
+A too-hot step size (or a single Inf cell in a billion-row stream) turns
+a streaming fit into a NaN factory that trains to completion and ships a
+useless model — the failure is silent until evaluation. The guard is one
+cheap check per EPOCH (never per step — a per-step host sync would
+serialize the async dispatch pipeline): the epoch's last loss scalar,
+falling back to a single fused all-finite reduction over theta when no
+loss exists (pure-ingest defer passes, k-means centers). A non-finite
+value raises :class:`NumericalDivergenceError` naming the epoch and
+chunk ordinal, ticks ``otpu_divergence_total`` and lands an instant on
+the obs timeline. Inert under ``OTPU_RESILIENCE=0`` (the legacy
+train-to-NaN behavior, read per call)."""
+
+from __future__ import annotations
+
+import math
+
+from orange3_spark_tpu.obs.registry import REGISTRY
+from orange3_spark_tpu.resilience.faults import resilience_enabled
+
+__all__ = ["NumericalDivergenceError", "check_finite_training"]
+
+_M_DIVERGENCE = REGISTRY.counter(
+    "otpu_divergence_total",
+    "streaming fits aborted by the non-finite training guard")
+
+
+class NumericalDivergenceError(FloatingPointError):
+    """Training state went non-finite. ``what`` names the tripping value
+    ('loss' or 'theta'), ``epoch``/``chunk`` locate it in the stream."""
+
+    def __init__(self, *, what: str, epoch: int, chunk: int,
+                 estimator: str = ""):
+        self.what = what
+        self.epoch = epoch
+        self.chunk = chunk
+        self.estimator = estimator
+        who = f"{estimator} " if estimator else ""
+        super().__init__(
+            f"{who}training diverged: non-finite {what} at epoch {epoch}, "
+            f"chunk ordinal {chunk}. Lower step_size / raise reg_param, "
+            "or check the stream for Inf/NaN features. "
+            "OTPU_RESILIENCE=0 restores the legacy silent-NaN behavior."
+        )
+
+
+def _tree_finite(tree) -> bool:
+    # sum-of-sums: any Inf/NaN leaf poisons the total (+Inf + -Inf = NaN,
+    # so cancellation cannot hide it); one tiny reduction dispatch per
+    # leaf per epoch, synced once at the float()
+    import jax.numpy as jnp
+    from jax import tree as jtree
+
+    total = 0.0
+    for leaf in jtree.leaves(tree):
+        total += float(jnp.sum(jnp.asarray(leaf)))
+        if not math.isfinite(total):
+            return False
+    return True
+
+
+def check_finite_training(loss=None, theta=None, *, epoch: int, chunk: int,
+                          estimator: str = "", final: bool = False) -> None:
+    """The per-epoch guard every streaming fit loop calls at its epoch
+    boundary. Prefers the (already-materializing) loss scalar; checks
+    ``theta`` only when no loss exists for the epoch — EXCEPT on the
+    fit's ``final`` check, which always sweeps theta too: the step's
+    loss is computed from theta BEFORE its update, so a last-step
+    divergence leaves a finite loss and only theta carries the NaN (one
+    extra reduction per fit, not per epoch). No-op under the
+    kill-switch."""
+    if not resilience_enabled():
+        return
+    what = None
+    if loss is not None and not math.isfinite(float(loss)):
+        what = "loss"
+    elif (theta is not None and (loss is None or final)
+            and not _tree_finite(theta)):
+        what = "theta"
+    if what is None:
+        return
+    _M_DIVERGENCE.inc()
+    from orange3_spark_tpu.obs import trace as _trace
+
+    _trace.instant("divergence", what=what, epoch=epoch, chunk=chunk)
+    raise NumericalDivergenceError(
+        what=what, epoch=epoch, chunk=chunk, estimator=estimator)

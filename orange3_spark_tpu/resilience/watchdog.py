@@ -118,6 +118,21 @@ def guarded_block_until_ready(token, *, step: int | None = None,
         if hold is not None:
             time.sleep(hold)
         return jax.block_until_ready(token)
+    # circuit breaker on repeated wedges (resilience/overload.py): once a
+    # budgeted sync has wedged, later guarded syncs fast-fail typed in
+    # ~0 s instead of each burning the full budget — until the breaker's
+    # seeded cooldown admits a half-open probe sync, whose success
+    # re-admits the backend automatically
+    from orange3_spark_tpu.resilience.overload import wedge_breaker
+
+    breaker = wedge_breaker()
+    if not breaker.allow():
+        diag = _diagnostics()
+        diag["breaker_state"] = breaker.state()
+        raise DispatchWedgedError(
+            stage=stage, step=step, budget_s=budget, waited_s=0.0,
+            diagnostics=diag,
+        )
     done = threading.Event()
     err: list = []
 
@@ -138,12 +153,14 @@ def guarded_block_until_ready(token, *, step: int | None = None,
         from orange3_spark_tpu.utils.profiling import record_wedge
 
         record_wedge()
+        breaker.record_failure()
         raise DispatchWedgedError(
             stage=stage, step=step, budget_s=budget,
             waited_s=time.perf_counter() - t0, diagnostics=_diagnostics(),
         )
     if err:
         raise err[0]
+    breaker.record_success()
     return token
 
 

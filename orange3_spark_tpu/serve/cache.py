@@ -29,6 +29,11 @@ from typing import Any, Callable
 
 from orange3_spark_tpu.utils.profiling import record_serve
 
+_MISSING = object()
+#: countless LRU placeholder for keys that own no executable (pad-path
+#: buckets, failed builds); never returned as a build product
+_PAD_MARKER = "pad-marker"
+
 
 def _build_resilient(key, build):
     """One AOT build with the resilience wrap: fault injection inside the
@@ -93,10 +98,16 @@ class ExecutableCache:
 
     def get_or_build(self, key, build: Callable[[], Any]):
         with self._lock:
-            if key in self._entries:
+            entry = self._entries.get(key, _MISSING)
+            if entry is not _MISSING and entry is not _PAD_MARKER:
                 self._entries.move_to_end(key)
                 record_serve(aot_hits=1)
-                return self._entries[key]
+                return entry
+            # a _PAD_MARKER here is a failed build's LRU placeholder
+            # (see _blacklist/mark): it keeps the eviction bookkeeping
+            # honest but must NOT satisfy a build — a breaker's
+            # half-open probe re-attempts the build through this path,
+            # and the real entry then replaces the marker in place
             fut = self._building.get(key)
             if fut is None:
                 fut = self._building[key] = Future()
@@ -144,7 +155,7 @@ class ExecutableCache:
             if key in self._entries:
                 self._entries.move_to_end(key)
                 return
-            self._entries[key] = "pad-marker"
+            self._entries[key] = _PAD_MARKER
             while len(self._entries) > self.max_entries:
                 evicted.append(self._entries.popitem(last=False)[0])
             if evicted:

@@ -34,8 +34,13 @@ Activation is a context manager::
 methods run untouched (zero overhead beyond one None check), and batches
 larger than the ladder's ``max_bucket`` bypass serving (the raw path
 amortizes its own compile there, and the serving path's host round trip
-would dominate). Models whose transform cannot trace device-pure are
-blacklisted on first failure and served raw from then on.
+would dominate). Models whose transform cannot trace device-pure trip a
+per-(model, kind) circuit breaker (resilience/overload.py) and serve raw
+while it is open; a half-open probe re-admits a recovered model
+(``OTPU_RESILIENCE=0`` restores the first-failure process-lifetime
+blacklist). Dispatches run under admission control — bounded in-flight
+work with projected-wait shedding (typed ``OverloadShedError``) when a
+request deadline applies.
 
 The active context is PROCESS-wide (serving worker threads must see the
 context their pool installed, which a thread-local could not give them);
@@ -47,6 +52,7 @@ from __future__ import annotations
 import copy
 import logging
 import threading
+import time
 from typing import Any, Callable
 
 import jax
@@ -58,6 +64,10 @@ from orange3_spark_tpu.serve.bucketing import (
 )
 from orange3_spark_tpu.obs.registry import REGISTRY
 from orange3_spark_tpu.obs.trace import span
+from orange3_spark_tpu.resilience.overload import (
+    AdmissionController, CircuitBreaker, maybe_injected_service_delay,
+    shed_total,
+)
 from orange3_spark_tpu.serve.cache import ExecutableCache
 from orange3_spark_tpu.utils.dispatch import beat
 from orange3_spark_tpu.utils.profiling import record_serve
@@ -172,12 +182,31 @@ class ServingContext:
 
     def __init__(self, ladder: BucketLadder | None = None, *,
                  max_entries: int = 64, micro_batch: bool = False,
-                 max_batch: int = 4096, max_wait_ms: float = 2.0):
+                 max_batch: int = 4096, max_wait_ms: float = 2.0,
+                 admission: AdmissionController | None = None,
+                 breaker_clock=None):
         self.ladder = ladder or BucketLadder()
         self.cache = ExecutableCache(max_entries, on_evict=self._on_evict)
         self._records: dict[int, _ModelRecord] = {}
         self._rec_lock = threading.Lock()
-        self._unservable: set = set()       # (fingerprint, kind) build fails
+        # (fingerprint, kind) -> CircuitBreaker. The old set-membership
+        # blacklist became a breaker per entry: a build failure opens it
+        # (raw path while open), the seeded cooldown admits a half-open
+        # probe build, and a probe success re-admits the model — under
+        # OTPU_RESILIENCE=0 the breaker never half-opens, which IS the
+        # legacy first-failure process-lifetime latch
+        self._unservable: dict = {}
+        self._breaker_clock = breaker_clock or time.monotonic
+        # admission control (resilience/overload.py): bounded in-flight
+        # dispatches + projected-wait shedding. At the default knobs it
+        # only bounds in-flight work (waits, never sheds); shedding
+        # starts once a request deadline is configured. A caller-shared
+        # controller keeps ITS diagnostics hook (first owner wins — an
+        # unconditional overwrite would misattribute shed diagnostics
+        # and pin an exited context alive via the bound method)
+        self.admission = admission or AdmissionController()
+        if self.admission.diagnostics_hook is None:
+            self.admission.diagnostics_hook = self.breaker_states
         self._staged_refs: dict = {}        # id -> staged program (keeps the
         #                                     id-keyed cache entries honest)
         self._micro_batch = micro_batch
@@ -213,6 +242,8 @@ class ServingContext:
                 self.micro_batcher = MicroBatcher(
                     self, max_batch=self._max_batch,
                     max_wait_ms=self._max_wait_ms,
+                    admission=self.admission,
+                    batch_cap=self.ladder.max_bucket,
                 )
             self._activations += 1
             if self._activations == 1:
@@ -315,10 +346,12 @@ class ServingContext:
                 if r.fingerprint == fp:
                     del self._records[mid]
             # the record's strong ref kept id(model) stable; without it the
-            # id can be reused, so fingerprint-keyed state must not outlive
-            # it. Rebuilt under _rec_lock — _blacklist's concurrent .add()
-            # would crash this comprehension's iteration otherwise
-            self._unservable = {u for u in self._unservable if u[0] != fp}
+            # id can be reused, so fingerprint-keyed state (incl. its
+            # breakers) must not outlive it. Rebuilt under _rec_lock —
+            # _blacklist's concurrent insert would crash this
+            # comprehension's iteration otherwise
+            self._unservable = {u: br for u, br in self._unservable.items()
+                                if u[0] != fp}
 
     # ----------------------------------------------------- served entries
     def served_transform(self, model, table: TpuTable, raw_fn=None):
@@ -328,7 +361,7 @@ class ServingContext:
         # model, and a model that is never actually served would otherwise
         # never gain the cache entry whose eviction releases the pin
         if (bucket is None
-                or (_fingerprint(model), "transform") in self._unservable):
+                or self._breaker_blocks(_fingerprint(model), "transform")):
             with _raw_calls():
                 return raw_fn(model, table)
         rec = self._record_for(model)
@@ -348,8 +381,11 @@ class ServingContext:
             self._blacklist(rec, "transform", e, key=key)
             with _raw_calls():
                 return raw_fn(model, table)
-        Xd, Yd, Wd = self._serve_args(table, n_pad, session)
-        outX, outY, outW = compiled(Xd, Yd, Wd)
+        self._breaker_ok(rec.fingerprint, "transform")
+        with self.admission.slot():
+            maybe_injected_service_delay()
+            Xd, Yd, Wd = self._serve_args(table, n_pad, session)
+            outX, outY, outW = compiled(Xd, Yd, Wd)
         return TpuTable(meta["domain"], outX, outY, outW, table.metas,
                         table.n_rows, session)
 
@@ -363,7 +399,7 @@ class ServingContext:
         session = table.session
         n_pad = session.pad_rows(bucket)
         hook = getattr(type(model), "_device_predict", None)
-        if hook is None or (rec.fingerprint, "predict") in self._unservable:
+        if hook is None or self._breaker_blocks(rec.fingerprint, "predict"):
             # no device hook: bucket-pad the table and run the raw predict
             # on it — the model's internal jits then cache per BUCKET
             # shape (the compile-count win) and strip via n_rows as ever
@@ -394,9 +430,12 @@ class ServingContext:
                 self._blacklist(rec, "predict", e, key=key)
                 with _raw_calls():
                     return raw_fn(model, table)
-            Xd, Yd, Wd = self._serve_args(table, n_pad, session)
-            out = compiled(Xd, Yd, Wd)
-            return np.asarray(jax.device_get(out))[:n]
+            self._breaker_ok(rec.fingerprint, "predict")
+            with self.admission.slot():
+                maybe_injected_service_delay()
+                Xd, Yd, Wd = self._serve_args(table, n_pad, session)
+                out = compiled(Xd, Yd, Wd)
+                return np.asarray(jax.device_get(out))[:n]
         record_serve(request_rows=n)    # dispatch-level ticks live in
         #                                 _dispatch (merged under the mb)
         X, Y, W = table_to_host(table)
@@ -429,7 +468,8 @@ class ServingContext:
         Xall = np.asarray(Xall)
         n = Xall.shape[0]
         bucket = self.ladder.bucket_for(n)
-        if bucket is None or (_fingerprint(model), "array") in self._unservable:
+        if bucket is None or self._breaker_blocks(_fingerprint(model),
+                                                  "array"):
             return None
         rec = self._record_for(model)
         from orange3_spark_tpu.core.session import TpuSession
@@ -474,32 +514,38 @@ class ServingContext:
             except Exception as e:  # noqa: BLE001
                 self._blacklist(rec, "array", e, key=key)
                 raise _BuildFailed from e
-            Xd = jax.device_put(pad_rows_np(X, n_pad), session.row_sharding)
-            out = compiled(state, Xd)
-        else:
-            model = rec.model
-            key = ("predict", rec.fingerprint, n_pad, X.shape[1],
-                   str(X.dtype), (Y.shape[1] if Y is not None else 0),
-                   domain_sig(domain), _mesh_key(session))
-            self._tick_dispatch(key, n_pad)
-            try:
-                compiled, _ = self._ensure_table_exec(
-                    key, rec, "predict", session, domain,
-                    n_attrs=X.shape[1], x_dtype=x_dtype,
-                    y_cols=(Y.shape[1] if Y is not None else 0),
-                    y_dtype=(Y.dtype if Y is not None else None),
-                    n_pad=n_pad,
-                )
-            except Exception as e:  # noqa: BLE001
-                self._blacklist(rec, "predict", e, key=key)
-                raise _BuildFailed from e
+            self._breaker_ok(rec.fingerprint, "array")
+            with self.admission.slot():
+                maybe_injected_service_delay()
+                Xd = jax.device_put(pad_rows_np(X, n_pad),
+                                    session.row_sharding)
+                out = compiled(state, Xd)
+                return np.asarray(jax.device_get(out))[:n]
+        key = ("predict", rec.fingerprint, n_pad, X.shape[1],
+               str(X.dtype), (Y.shape[1] if Y is not None else 0),
+               domain_sig(domain), _mesh_key(session))
+        self._tick_dispatch(key, n_pad)
+        try:
+            compiled, _ = self._ensure_table_exec(
+                key, rec, "predict", session, domain,
+                n_attrs=X.shape[1], x_dtype=x_dtype,
+                y_cols=(Y.shape[1] if Y is not None else 0),
+                y_dtype=(Y.dtype if Y is not None else None),
+                n_pad=n_pad,
+            )
+        except Exception as e:  # noqa: BLE001
+            self._blacklist(rec, "predict", e, key=key)
+            raise _BuildFailed from e
+        self._breaker_ok(rec.fingerprint, "predict")
+        with self.admission.slot():
+            maybe_injected_service_delay()
             Xd = jax.device_put(pad_rows_np(X, n_pad), session.row_sharding)
             Yd = (jax.device_put(pad_rows_np(Y, n_pad), session.row_sharding)
                   if Y is not None else None)
             Wd = jax.device_put(pad_rows_np(W, n_pad),
                                 session.vector_sharding)
             out = compiled(Xd, Yd, Wd)
-        return np.asarray(jax.device_get(out))[:n]
+            return np.asarray(jax.device_get(out))[:n]
 
     # ------------------------------------------------------------ builders
     def _table_key(self, kind, rec, table: TpuTable, n_pad: int) -> tuple:
@@ -565,19 +611,60 @@ class ServingContext:
         compiled = jax.jit(fn).lower(st_avals, Xa).compile()
         return compiled, state
 
-    def _blacklist(self, rec, kind, e, key=None) -> None:
+    def _breaker_blocks(self, fp, kind) -> bool:
+        """Is this (fingerprint, kind) barred from serving right now?
+        No breaker = never failed = serve. An open breaker serves raw
+        until its cooldown admits a half-open probe (``allow()`` then
+        returns True ONCE and the next build attempt is the probe)."""
+        br = self._unservable.get((fp, kind))
+        return br is not None and not br.allow()
+
+    def _breaker_ok(self, fp, kind) -> None:
+        """A build/cache-hit succeeded for a key that has a breaker:
+        close a half-open probe (the recovered backend is re-admitted)."""
+        br = self._unservable.get((fp, kind))
+        if br is not None:
+            br.record_success()
+
+    def breaker_states(self) -> dict:
+        """{'<Model>:<kind>': 'closed'|'half-open'|'open'} for every
+        breaker this context holds — report()/shed-error diagnostics.
+        Two same-class models' breakers get id-suffixed keys instead of
+        silently overwriting each other (the common one-model-per-class
+        case keeps the readable key)."""
         with self._rec_lock:
-            known = (rec.fingerprint, kind) in self._unservable
-            if not known:
-                self._unservable.add((rec.fingerprint, kind))
+            items = list(self._unservable.items())
+        out: dict = {}
+        for (fp, kind), br in items:
+            key = f"{fp[0]}:{kind}"
+            if key in out:
+                key = f"{fp[0]}[{fp[1]}]:{kind}"
+            out[key] = br.state()
+        return out
+
+    def _blacklist(self, rec, kind, e, key=None) -> None:
+        """A serving build failed (post-retry): trip the (fingerprint,
+        kind) circuit breaker. While open the model serves raw; after
+        the seeded cooldown one half-open probe re-attempts the build,
+        and a success re-admits the model automatically (the legacy
+        process-lifetime latch under OTPU_RESILIENCE=0)."""
+        with self._rec_lock:
+            br = self._unservable.get((rec.fingerprint, kind))
+            known = br is not None
+            if br is None:
+                br = self._unservable[(rec.fingerprint, kind)] = \
+                    CircuitBreaker(f"serve:{kind}",
+                                   clock=self._breaker_clock)
+        br.record_failure()
         if not known:
-            log.warning("serve: %s %s not AOT-servable, using raw path (%s)",
-                        rec.fingerprint[0], kind,
-                        f"{type(e).__name__}: {e}"[:200])
+            log.warning(
+                "serve: %s %s not AOT-servable, using raw path until the "
+                "breaker re-probes (%s)", rec.fingerprint[0], kind,
+                f"{type(e).__name__}: {e}"[:200])
         if key is not None:
             # the failed build left no cache entry; a marker gives the
             # fingerprint LRU presence so _on_evict eventually releases
-            # the record pin and the blacklist entry
+            # the record pin and the breaker entry
             self.cache.mark(key)
 
     # ----------------------------------------------------------- utilities
@@ -702,7 +789,11 @@ class ServingContext:
         else:
             out = rep.to_dict()
         out["cache_entries"] = len(self.cache)
-        out["unservable"] = len(self._unservable)
+        out["breakers"] = self.breaker_states()
+        with self._rec_lock:
+            brs = list(self._unservable.values())
+        out["unservable"] = sum(1 for br in brs if br.state() != "closed")
+        out["sheds"] = shed_total()
         out["micro_batcher_active"] = self.micro_batcher is not None
         out["telemetry_url"] = (self._telemetry.url
                                 if self._telemetry is not None else None)

@@ -25,10 +25,22 @@ and no future is ever abandoned behind it.
 Deadline semantics (resilience/): every returned future carries a hard
 deadline (``deadline_s``, env ``OTPU_MB_DEADLINE_S``, default 30 s) — if
 the worker thread dies or its dispatch wedges, ``result()`` raises a
-typed ``MicroBatchTimeoutError`` naming the request's group key instead
-of blocking the caller forever. A worker found dead at ``submit`` time
-sheds the request to direct dispatch (``submit`` returns None). Disabled
-(legacy block-forever futures) under ``OTPU_RESILIENCE=0``.
+typed ``MicroBatchTimeoutError`` naming the request's group key (and
+carrying live queue/worker/breaker diagnostics) instead of blocking the
+caller forever. A worker found dead at ``submit`` time sheds the request
+to direct dispatch (``submit`` returns None). Disabled (legacy
+block-forever futures) under ``OTPU_RESILIENCE=0``.
+
+Overload semantics (resilience/overload.py): ``submit`` runs the owning
+context's admission check against the queue depth — a request whose
+projected queue wait exceeds its deadline budget raises a typed
+``OverloadShedError`` instead of parking behind a queue it cannot clear
+(no deadline configured = the legacy behavior: a full queue sheds to
+direct dispatch via the None return). The worker's coalescing window is
+ADAPTIVE: sustained queue depth grows ``max_wait_ms``/the merge target
+(bounded by ``OTPU_MB_MAX_WAIT_MS`` and the bucket ladder's top rung),
+an idle queue shrinks both back — bigger merges exactly when the queue
+needs draining, minimum latency when it does not.
 """
 
 from __future__ import annotations
@@ -53,18 +65,23 @@ _SENTINEL = object()
 class MicroBatchTimeoutError(TimeoutError):
     """A micro-batched request's future missed its hard deadline — the
     coalescer thread died or its merged dispatch wedged. Carries the
-    request's ``group_key`` (model fingerprint / schema / session) so the
-    stuck endpoint is identifiable from the error alone."""
+    request's ``group_key`` (model fingerprint / schema / session) plus
+    live ``diagnostics`` (queue depth, worker liveness, breaker states)
+    so the stuck endpoint is self-explaining from the error alone."""
 
-    def __init__(self, group_key, waited_s: float):
+    def __init__(self, group_key, waited_s: float,
+                 diagnostics: dict | None = None):
         self.group_key = group_key
         self.waited_s = waited_s
+        self.diagnostics = diagnostics or {}
+        extra = f" Diagnostics: {self.diagnostics}." if self.diagnostics \
+            else ""
         super().__init__(
             f"micro-batched request (group_key={group_key!r}) got no "
             f"result within its {waited_s:.3g}s deadline: the dispatch "
-            "thread died or its device dispatch wedged. Direct dispatch "
-            "(micro_batch=False) or OTPU_MB_DEADLINE_S tune the deadline; "
-            "OTPU_RESILIENCE=0 restores unbounded waits."
+            f"thread died or its device dispatch wedged.{extra} Direct "
+            "dispatch (micro_batch=False) or OTPU_MB_DEADLINE_S tune the "
+            "deadline; OTPU_RESILIENCE=0 restores unbounded waits."
         )
 
 
@@ -74,6 +91,16 @@ class _DeadlineFuture(Future):
 
     _deadline_s: float | None = None
     _group_key = None
+    _diag_fn = None
+
+    def _timeout_error(self, eff) -> MicroBatchTimeoutError:
+        diag = None
+        if self._diag_fn is not None:
+            try:
+                diag = self._diag_fn()
+            except Exception:  # noqa: BLE001 - diagnostics must not mask
+                diag = None
+        return MicroBatchTimeoutError(self._group_key, eff, diag)
 
     def result(self, timeout=None):
         eff = timeout if timeout is not None else self._deadline_s
@@ -82,7 +109,7 @@ class _DeadlineFuture(Future):
         try:
             return super().result(eff)
         except _FutTimeout:
-            raise MicroBatchTimeoutError(self._group_key, eff) from None
+            raise self._timeout_error(eff) from None
 
     def exception(self, timeout=None):
         eff = timeout if timeout is not None else self._deadline_s
@@ -91,7 +118,7 @@ class _DeadlineFuture(Future):
         try:
             return super().exception(eff)
         except _FutTimeout:
-            raise MicroBatchTimeoutError(self._group_key, eff) from None
+            raise self._timeout_error(eff) from None
 
 
 @dataclass
@@ -121,10 +148,22 @@ class MicroBatcher:
 
     def __init__(self, ctx, *, max_batch: int = 4096,
                  max_wait_ms: float = 2.0, queue_depth: int = 1024,
-                 deadline_s: float | None = None):
+                 deadline_s: float | None = None, admission=None,
+                 batch_cap: int | None = None):
+        from orange3_spark_tpu.resilience.overload import AdaptiveCoalescer
+
         self.ctx = ctx
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1e3
+        # the owning context's AdmissionController (None = no admission:
+        # the stub-ctx test path and pre-overload callers)
+        self.admission = admission
+        # load-adaptive wait/merge dial; fixed base values under the
+        # kill-switch. batch_cap = the bucket ladder's top rung — growth
+        # can never merge past a shape the ladder compiles
+        self._adapt = AdaptiveCoalescer(
+            self.max_wait_s, max_batch,
+            batch_cap if batch_cap is not None else max_batch)
         # hard future deadline; None = legacy block-forever (kill-switch)
         from orange3_spark_tpu.resilience.faults import resilience_enabled
 
@@ -158,8 +197,17 @@ class MicroBatcher:
                 # direct dispatch instead of parking a doomed future
                 or not self._thread.is_alive()):
             return None
+        if self.admission is not None:
+            # typed load shedding (resilience/overload.py): a request
+            # whose projected queue wait exceeds its deadline budget
+            # raises OverloadShedError HERE — it must not enqueue (the
+            # queue is the overload) nor fall to direct dispatch (that
+            # ADDS load). No deadline configured = no-op, and the
+            # queue.Full path below keeps its legacy shed-to-direct.
+            self.admission.check_queue(self._q.qsize())
         fut = _DeadlineFuture()
         fut._deadline_s = self.deadline_s
+        fut._diag_fn = self.diagnostics
         req = _Request(kind, rec, tuple(
             np.asarray(a) if a is not None else None for a in arrays
         ), n, meta, future=fut)
@@ -183,8 +231,37 @@ class MicroBatcher:
                 self._q.put(_SENTINEL)   # worker drains ahead of us
         self._thread.join(timeout=timeout_s)
 
+    def diagnostics(self) -> dict:
+        """Live state a timeout/shed error carries: queue depth, worker
+        liveness, the adaptive factor, and (when an admission controller
+        is attached) in-flight count + breaker states."""
+        d = {
+            "queue_depth": self._q.qsize(),
+            "worker_alive": self._thread.is_alive(),
+            "closed": self._closed,
+            "adapt_factor": round(self._adapt.factor, 3),
+        }
+        adm = self.admission
+        if adm is not None:
+            d["inflight"] = adm.inflight
+            hook = adm.diagnostics_hook
+            if hook is not None:
+                try:
+                    d["breakers"] = dict(hook())
+                except Exception:  # noqa: BLE001 - diagnostics only
+                    pass
+        return d
+
     # ------------------------------------------------------------- worker
     def _worker(self) -> None:
+        # admitted work: the worker waits for admission slots but is
+        # never itself shed (its requests were admitted at submit)
+        from orange3_spark_tpu.resilience.overload import request_deadline
+
+        with request_deadline(float("inf")):
+            self._worker_loop()
+
+    def _worker_loop(self) -> None:
         pending = None
         while True:
             item = pending if pending is not None else self._q.get()
@@ -193,8 +270,11 @@ class MicroBatcher:
                 return
             batch = [item]
             rows = item.n
-            deadline = time.perf_counter() + self.max_wait_s
-            while rows < self.max_batch:
+            # adaptive coalescing window (resilience/overload.py): depth
+            # pressure grows the wait/merge target, idle shrinks it back
+            max_batch = self._adapt.current_batch()
+            deadline = time.perf_counter() + self._adapt.current_wait_s()
+            while rows < max_batch:
                 remaining = deadline - time.perf_counter()
                 if remaining <= 0:
                     break
@@ -206,12 +286,17 @@ class MicroBatcher:
                     pending = nxt
                     break
                 if (nxt.group_key != item.group_key
-                        or rows + nxt.n > self.max_batch):
+                        or rows + nxt.n > max_batch):
                     pending = nxt     # flush current group, start the next
                     break
                 batch.append(nxt)
                 rows += nxt.n
+            # service-time EWMA: fed by the admission slot inside
+            # ctx._dispatch (dispatch wall only — a flush-level sample
+            # here would double-count and fold slot-acquisition WAIT
+            # into the "service" estimate, over-shedding under load)
             self._flush(batch, rows)
+            self._adapt.update(self._q.qsize())
             beat()                    # serving progress feeds the watchdog
 
     def _flush(self, batch: list, rows: int) -> None:

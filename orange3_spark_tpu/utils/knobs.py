@@ -93,6 +93,41 @@ _ALL = [
     Knob("OTPU_MB_DEADLINE_S", "float", 30.0, "resilience",
          "Hard deadline on micro-batched futures; a dead/wedged coalescer "
          "raises MicroBatchTimeoutError instead of hanging the caller."),
+    Knob("OTPU_ADMISSION_MAX_INFLIGHT", "int", 64, "resilience",
+         "Serving admission bound: dispatches concurrently in flight; "
+         "0 = unbounded (legacy)."),
+    Knob("OTPU_ADMISSION_MAX_QUEUE", "int", 256, "resilience",
+         "Callers allowed to wait on admission before excess requests "
+         "shed with OverloadShedError."),
+    Knob("OTPU_ADMISSION_DEADLINE_S", "float", 0.0, "resilience",
+         "Default per-request deadline budget: shed when projected queue "
+         "wait exceeds it (0 = no deadline; request_deadline() overrides "
+         "per thread)."),
+    Knob("OTPU_ADMISSION_SERVICE_MS", "float", 0.0, "resilience",
+         "Seed/floor for the admission controller's EWMA service-time "
+         "estimate (a cold start must not admit a burst on a zero "
+         "estimate)."),
+    Knob("OTPU_BREAKER_THRESHOLD", "int", 1, "resilience",
+         "Consecutive failures that open a circuit breaker (serving "
+         "build failures arrive post-retry, so 1 preserves the old "
+         "blacklist economics)."),
+    Knob("OTPU_BREAKER_COOLDOWN_S", "float", 5.0, "resilience",
+         "Open-breaker cooldown before a half-open probe is admitted "
+         "(seeded-jittered per open)."),
+    Knob("OTPU_BREAKER_PROBES", "int", 1, "resilience",
+         "Half-open probe successes required to close a breaker."),
+    Knob("OTPU_MB_ADAPT", "flag", "1", "resilience",
+         "Adaptive micro-batch coalescing kill-switch; 0 pins the "
+         "configured max_wait_ms/max_batch."),
+    Knob("OTPU_MB_MAX_WAIT_MS", "float", 20.0, "resilience",
+         "Ceiling the adaptive coalescer may grow max_wait_ms to under "
+         "sustained queue depth."),
+    Knob("OTPU_MEM_BUDGET_MB", "float", 0.0, "resilience",
+         "Host-RSS budget the brownout watermarks read against "
+         "(0 = brownout inert unless a mem_pressure fault is injected)."),
+    Knob("OTPU_MEM_WATERMARKS", "str", "0.75,0.88,0.96", "resilience",
+         "Brownout ladder fractions: shrink chunk admission / force "
+         "spill / degrade the HBM replay cache."),
     # ----------------------------------------------------------- serve/
     Knob("OTPU_SERVE_REQUESTS", "int", 120, "serve",
          "bench.py serving-trace request count."),

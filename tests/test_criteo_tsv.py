@@ -100,10 +100,14 @@ def test_criteo_tsv_fits_end_to_end(session, tmp_path):
     assert ev["auc"] > 0.8, f"failed to learn from hex categoricals: {ev}"
 
 
-def test_missing_keep_poisons_visibly(session, tmp_path):
+def test_missing_keep_poisons_visibly(session, tmp_path, monkeypatch):
     """missing='keep' hands NaN through untouched — the documented
-    contract for pipelines with their own imputer: a NaN that reaches the
-    step shows up in the loss instead of being silently zeroed."""
+    contract for pipelines with their own imputer: a NaN that reaches
+    the step shows up TYPED (the resilience/numerics.py non-finite
+    guard names the epoch and chunk) instead of being silently zeroed;
+    under OTPU_RESILIENCE=0 it shows up in the loss, legacy-style."""
+    from orange3_spark_tpu.resilience import NumericalDivergenceError
+
     path = _write_criteo_tsv(tmp_path / "train.tsv", n_rows=512)
     src = csv_raw_chunk_source(str(path), delimiter="\t", header=False,
                                chunk_rows=512, categorical_cols=CAT_COLS)
@@ -111,6 +115,9 @@ def test_missing_keep_poisons_visibly(session, tmp_path):
         n_dims=1 << 14, n_dense=N_DENSE, n_cat=N_CAT, epochs=1,
         step_size=0.08, chunk_rows=512, label_in_chunk=True, missing="keep",
     )
+    with pytest.raises(NumericalDivergenceError, match="epoch 0"):
+        est.fit_stream(src, session=session)
+    monkeypatch.setenv("OTPU_RESILIENCE", "0")
     model = est.fit_stream(src, session=session)
     assert not np.isfinite(model.final_loss_)
 

@@ -1,0 +1,630 @@
+"""Overload protection & graceful degradation (resilience/overload.py):
+admission control with projected-wait shedding, the closed/open/half-open
+circuit breaker (replacing the serving first-failure blacklist and
+fast-failing repeated wedges), adaptive micro-batch coalescing, the
+memory-pressure brownout ladder, and the non-finite training guard.
+The mitigation tests here FAIL under ``OTPU_RESILIENCE=0`` by
+construction — the kill-switch tests pin the legacy ladder explicitly.
+Fake clocks everywhere a schedule matters; no tier-1 sleeps beyond
+millisecond-scale thread handshakes."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.resilience import (
+    NumericalDivergenceError,
+    OverloadShedError,
+    inject_faults,
+)
+from orange3_spark_tpu.resilience.overload import (
+    AdaptiveCoalescer,
+    AdmissionController,
+    CircuitBreaker,
+    request_deadline,
+    reset_wedge_breaker,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_overload_state(monkeypatch):
+    """Admission knobs at defaults, no wedge-breaker carry-over between
+    tests, fast retry backoff."""
+    for k in ("OTPU_ADMISSION_DEADLINE_S", "OTPU_ADMISSION_SERVICE_MS",
+              "OTPU_RESILIENCE", "OTPU_MEM_BUDGET_MB"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("OTPU_RETRY_BASE_S", "0.001")
+    reset_wedge_breaker()
+    yield
+    reset_wedge_breaker()
+
+
+# ---------------------------------------------------- admission control
+def test_admission_immediate_shed_on_hopeless_wait(monkeypatch):
+    """A request whose projected queue wait exceeds its deadline sheds
+    IMMEDIATELY (no waiting at all), with queue depth and wait estimate
+    on the typed error."""
+    monkeypatch.setenv("OTPU_ADMISSION_SERVICE_MS", "1000")  # 1 s/dispatch
+    ac = AdmissionController(max_inflight=1, max_queue=8)
+    entered = threading.Event()
+    release = threading.Event()
+
+    def hold():
+        with ac.slot():
+            entered.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=hold, daemon=True)
+    t.start()
+    assert entered.wait(2.0)
+    t0 = time.perf_counter()
+    with pytest.raises(OverloadShedError) as ei:
+        with ac.slot(deadline_s=0.05):
+            pass
+    assert time.perf_counter() - t0 < 0.5      # shed, not waited out
+    e = ei.value
+    assert e.reason == "projected_wait"
+    assert e.est_wait_s > e.deadline_s == 0.05
+    assert e.inflight == 1
+    release.set()
+    t.join(2.0)
+
+
+def test_admission_deadline_expiry_sheds_while_waiting():
+    ac = AdmissionController(max_inflight=1, max_queue=8)
+    entered = threading.Event()
+    release = threading.Event()
+
+    def hold():
+        with ac.slot():
+            entered.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=hold, daemon=True)
+    t.start()
+    assert entered.wait(2.0)
+    # no service estimate yet (EWMA 0): admitted to the wait, then the
+    # deadline expires while the slot never frees
+    with pytest.raises(OverloadShedError) as ei:
+        with ac.slot(deadline_s=0.02):
+            pass
+    assert ei.value.reason == "deadline"
+    release.set()
+    t.join(2.0)
+    # and the released slot admits the next caller cleanly
+    with ac.slot(deadline_s=0.02):
+        assert ac.inflight == 1
+    assert ac.inflight == 0
+
+
+def test_admission_queue_full_sheds_with_deadline_only(monkeypatch):
+    """The hard queue bound sheds only for deadline-carrying requests;
+    a deadline-free caller keeps the legacy contract (the mb queue's
+    own Full bound sheds to direct dispatch, no new exception type)."""
+    monkeypatch.setenv("OTPU_ADMISSION_SERVICE_MS", "0.001")
+    ac = AdmissionController(max_inflight=4, max_queue=2)
+    ac.check_queue(queue_depth=500)            # no deadline: legacy no-op
+    with pytest.raises(OverloadShedError) as ei:
+        ac.check_queue(queue_depth=2, deadline_s=60.0)  # at the bound
+    assert ei.value.reason == "queue_full"
+    ac.check_queue(queue_depth=1, deadline_s=60.0)      # below it: ok
+
+
+def test_admission_kill_switch_unbounded(monkeypatch):
+    """OTPU_RESILIENCE=0 restores legacy behavior: no bounds, no sheds,
+    even with a hopeless deadline configured."""
+    monkeypatch.setenv("OTPU_RESILIENCE", "0")
+    monkeypatch.setenv("OTPU_ADMISSION_SERVICE_MS", "1000")
+    ac = AdmissionController(max_inflight=1, max_queue=1)
+    ac.check_queue(queue_depth=500, deadline_s=0.001)   # no-op
+    with ac.slot(deadline_s=0.001):
+        with ac.slot(deadline_s=0.001):        # no in-flight bound either
+            pass
+
+
+def test_request_deadline_thread_local_scoping(monkeypatch):
+    monkeypatch.setenv("OTPU_ADMISSION_SERVICE_MS", "1000")
+    ac = AdmissionController(max_inflight=4, max_queue=64)
+    # ambient knob deadline
+    monkeypatch.setenv("OTPU_ADMISSION_DEADLINE_S", "0.01")
+    with pytest.raises(OverloadShedError):
+        ac.check_queue(queue_depth=5)
+    # per-request scope outranks the knob
+    with request_deadline(60.0):
+        ac.check_queue(queue_depth=5)          # generous: admitted
+    with pytest.raises(OverloadShedError):
+        ac.check_queue(queue_depth=5)          # scope ended: knob again
+
+
+def test_shed_error_carries_breaker_diagnostics(monkeypatch):
+    monkeypatch.setenv("OTPU_ADMISSION_SERVICE_MS", "1000")
+    ac = AdmissionController(max_inflight=4, max_queue=64)
+    ac.diagnostics_hook = lambda: {"Model:predict": "open"}
+    with pytest.raises(OverloadShedError) as ei:
+        ac.check_queue(queue_depth=5, deadline_s=0.01)
+    assert ei.value.diagnostics == {"Model:predict": "open"}
+    assert "Model:predict" in str(ei.value)
+    assert ei.value.queue_depth == 5
+
+
+# ----------------------------------------------------- circuit breaker
+def test_breaker_lifecycle_fake_clock():
+    clk = [0.0]
+    br = CircuitBreaker("t", failure_threshold=2, cooldown_s=10.0,
+                        probe_successes=1, jitter=0.0,
+                        clock=lambda: clk[0])
+    assert br.state() == "closed" and br.allow()
+    br.record_failure()
+    assert br.state() == "closed" and br.allow()   # below threshold
+    br.record_failure()
+    assert br.state() == "open" and not br.allow()
+    clk[0] = 9.9
+    assert not br.allow()                          # cooldown not elapsed
+    clk[0] = 10.0
+    assert br.allow()                              # the half-open probe
+    assert not br.allow()                          # ONE probe at a time
+    br.record_failure()                            # probe failed: reopen
+    assert br.state() == "open"
+    clk[0] = 20.0
+    assert br.allow()
+    br.record_success()                            # probe succeeded
+    assert br.state() == "closed" and br.allow()
+
+
+def test_breaker_seeded_probe_cadence_pinned():
+    """The cooldown jitter is deterministic per (seed, open count) — the
+    retry-policy convention — so probe schedules are exactly pinnable."""
+    import zlib
+
+    clk = [0.0]
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=10.0,
+                        jitter=0.25, seed=0, clock=lambda: clk[0])
+    br.record_failure()
+    u = zlib.crc32(b"0:1") / 0xFFFFFFFF
+    expect = 10.0 * (1.0 + 0.25 * u)
+    clk[0] = expect - 1e-6
+    assert not br.allow()
+    clk[0] = expect
+    assert br.allow()
+
+
+def test_breaker_kill_switch_is_the_legacy_latch(monkeypatch):
+    monkeypatch.setenv("OTPU_RESILIENCE", "0")
+    clk = [0.0]
+    br = CircuitBreaker(failure_threshold=3, cooldown_s=0.1,
+                        clock=lambda: clk[0])
+    br.record_failure()                # legacy: FIRST failure latches
+    assert br.state() == "open"
+    clk[0] = 1e9
+    assert not br.allow()              # and never half-opens
+    monkeypatch.delenv("OTPU_RESILIENCE")
+    assert br.allow()                  # switch back on: probe admitted
+
+
+def test_breaker_concurrent_transitions_are_safe():
+    """Hammer allow/record_failure/record_success from threads: no
+    crash, and the breaker lands in a valid state."""
+    clk = [0.0]
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=0.0, jitter=0.0,
+                        clock=lambda: clk[0])
+    stop = threading.Event()
+    errors = []
+
+    def hammer(op):
+        try:
+            while not stop.is_set():
+                op()
+        except Exception as e:  # noqa: BLE001 - the assertion target
+            errors.append(e)
+
+    ops = [br.allow, br.record_failure, br.record_success, br.state]
+    threads = [threading.Thread(target=hammer, args=(op,), daemon=True)
+               for op in ops for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(2.0)
+        assert not t.is_alive()
+    assert not errors
+    assert br.state() in ("closed", "open", "half-open")
+
+
+def test_wedge_breaker_fast_fails_then_reprobes(session, monkeypatch):
+    """After one wedge, later guarded syncs fast-fail (typed, ~0 s)
+    instead of burning the full watchdog budget; the cooldown admits a
+    probe sync whose success re-admits the backend."""
+    import jax.numpy as jnp
+
+    from orange3_spark_tpu.resilience import (
+        DispatchWedgedError, guarded_block_until_ready,
+    )
+    from orange3_spark_tpu.resilience import overload as ov
+
+    clk = [0.0]
+    monkeypatch.setattr(ov, "_wedge_breaker",
+                        CircuitBreaker("dispatch", jitter=0.0,
+                                       cooldown_s=10.0,
+                                       clock=lambda: clk[0]))
+    token = jnp.zeros((4,))
+    with inject_faults("wedge:at=1,hold_s=20"):
+        with pytest.raises(DispatchWedgedError):
+            guarded_block_until_ready(token, budget_s=0.1)
+    # breaker open: the next sync fast-fails without waiting the budget
+    t0 = time.perf_counter()
+    with pytest.raises(DispatchWedgedError) as ei:
+        guarded_block_until_ready(token, budget_s=5.0)
+    assert time.perf_counter() - t0 < 1.0
+    assert ei.value.waited_s == 0.0
+    assert ei.value.diagnostics.get("breaker_state") in ("open",
+                                                         "half-open")
+    # cooldown elapses: the probe sync runs for real and re-admits
+    clk[0] = 10.0
+    assert guarded_block_until_ready(token, budget_s=5.0) is token
+    guarded_block_until_ready(token, budget_s=5.0)   # closed again
+
+
+# ------------------------------------------- serving breaker half-open
+def _tiny_hashed_model(session):
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+
+    rng = np.random.default_rng(5)
+    X = np.concatenate([
+        rng.standard_normal((2048, 2)).astype(np.float32),
+        rng.integers(0, 100, (2048, 2)).astype(np.float32),
+    ], axis=1)
+    y = (rng.random(2048) < 0.4).astype(np.float32)
+    model = StreamingHashedLinearEstimator(
+        n_dims=1 << 10, n_dense=2, n_cat=2, epochs=1, step_size=0.05,
+        chunk_rows=1024,
+    ).fit_stream(array_chunk_source(X, y, chunk_rows=1024),
+                 session=session)
+    return model, X
+
+
+def test_serving_breaker_half_open_readmits_recovered_backend(session):
+    """The acceptance drill: a flaky-AOT backend (injected transient
+    build failures that outlast the retry budget) trips the breaker and
+    serves raw; after the cooldown, ONE half-open probe build succeeds
+    and the model is re-admitted to AOT serving — where the old
+    blacklist stayed dead for the process lifetime."""
+    from orange3_spark_tpu.serve import BucketLadder, ServingContext
+    from orange3_spark_tpu.utils.profiling import serve_counters
+
+    model, X = _tiny_hashed_model(session)
+    clk = [0.0]
+    ladder = BucketLadder(min_bucket=64, max_bucket=1 << 11)
+    with ServingContext(ladder, breaker_clock=lambda: clk[0]) as ctx:
+        with inject_faults("aot_build:fails=4,key=array"):
+            want = model.predict(X[:64])       # raw fallback, same answer
+        states = ctx.breaker_states()
+        assert states.get("HashedLinearModel:array") == "open"
+        # while open: served raw, NO build attempted (the fast path)
+        misses0 = serve_counters()["aot_misses"]
+        np.testing.assert_array_equal(model.predict(X[:64]), want)
+        assert serve_counters()["aot_misses"] == misses0
+        # cooldown elapses: half-open probe build runs and succeeds
+        clk[0] += 30.0
+        np.testing.assert_array_equal(model.predict(X[:64]), want)
+        assert ctx.breaker_states()["HashedLinearModel:array"] == "closed"
+        assert serve_counters()["aot_misses"] == misses0 + 1  # the probe
+        # and it keeps serving AOT (cache hit, still closed)
+        np.testing.assert_array_equal(model.predict(X[:64]), want)
+        assert ctx.breaker_states()["HashedLinearModel:array"] == "closed"
+
+
+def test_serving_breaker_kill_switch_stays_dead(session, monkeypatch):
+    """Under OTPU_RESILIENCE=0 the breaker IS the legacy blacklist: the
+    first failure latches for the context's lifetime, cooldown or not.
+    (Injection stays live under the kill-switch, but fails=4 is consumed
+    by the ONE fail-fast attempt + the would-be probes never running.)"""
+    from orange3_spark_tpu.serve import BucketLadder, ServingContext
+    from orange3_spark_tpu.utils.profiling import serve_counters
+
+    monkeypatch.setenv("OTPU_RESILIENCE", "0")
+    model, X = _tiny_hashed_model(session)
+    clk = [0.0]
+    ladder = BucketLadder(min_bucket=64, max_bucket=1 << 11)
+    with ServingContext(ladder, breaker_clock=lambda: clk[0]) as ctx:
+        with inject_faults("aot_build:fails=1,key=array"):
+            model.predict(X[:64])              # fail-fast: one attempt
+        assert ctx.breaker_states()["HashedLinearModel:array"] == "open"
+        clk[0] += 1e6                          # any amount of cooldown
+        misses0 = serve_counters()["aot_misses"]
+        model.predict(X[:64])                  # still raw, no probe
+        assert serve_counters()["aot_misses"] == misses0
+        assert ctx.breaker_states()["HashedLinearModel:array"] == "open"
+
+
+# ------------------------------------------------ adaptive coalescing
+def test_adaptive_coalescer_grows_and_shrinks_within_bounds():
+    a = AdaptiveCoalescer(0.002, 256, 4096, high_depth=4, growth=2.0,
+                          max_wait_s=0.016)
+    assert a.current_wait_s() == 0.002 and a.current_batch() == 256
+    for _ in range(10):                        # sustained depth: grow,
+        a.update(queue_depth=8)                # capped at the bounds
+    assert a.current_wait_s() == pytest.approx(0.016)
+    assert a.current_batch() == min(int(256 * a.factor), 4096)
+    assert a.factor == 8.0                     # 16ms / 2ms
+    for _ in range(10):                        # idle: shrink back to base
+        a.update(queue_depth=0)
+    assert a.factor == 1.0
+    assert a.current_wait_s() == 0.002 and a.current_batch() == 256
+
+
+def test_adaptive_coalescer_kill_switch(monkeypatch):
+    monkeypatch.setenv("OTPU_RESILIENCE", "0")
+    a = AdaptiveCoalescer(0.002, 256, 4096)
+    for _ in range(10):
+        a.update(queue_depth=100)
+    assert a.current_wait_s() == 0.002 and a.current_batch() == 256
+
+
+# --------------------------------------------------- micro-batch sheds
+class _StubRec:
+    fingerprint = "fov"
+
+
+def _stub_mb(dispatch_hold_s=0.0, admission=None, **kw):
+    from orange3_spark_tpu.serve.microbatch import MicroBatcher
+
+    class StubCtx:
+        def _dispatch(self, kind, rec, arrays, rows, meta):
+            if dispatch_hold_s:
+                time.sleep(dispatch_hold_s)
+            return np.zeros((rows,), np.float32)
+
+    return MicroBatcher(StubCtx(), admission=admission, **kw)
+
+
+def _submit(mb, n=2):
+    return mb.submit("array", _StubRec(),
+                     (np.zeros((n, 2), np.float32), None, None), n,
+                     meta=(None, None, np.float32))
+
+
+def test_microbatch_submit_sheds_typed_on_projected_wait(monkeypatch):
+    monkeypatch.setenv("OTPU_ADMISSION_SERVICE_MS", "1000")
+    monkeypatch.setenv("OTPU_ADMISSION_DEADLINE_S", "0.05")
+    ac = AdmissionController(max_inflight=8, max_queue=64)
+    mb = _stub_mb(dispatch_hold_s=0.2, admission=ac, max_wait_ms=1.0,
+                  deadline_s=5.0)
+    try:
+        f1 = _submit(mb)                       # queue empty: admitted
+        assert f1 is not None
+        time.sleep(0.02)                       # worker is inside dispatch
+        f2 = _submit(mb)                       # qsize 0 still: admitted
+        with pytest.raises(OverloadShedError):
+            # a queued request ahead + 1 s/dispatch estimate >> 50 ms
+            for _ in range(8):
+                _submit(mb)
+        assert np.asarray(f1.result()).shape == (2,)
+        if f2 is not None:
+            f2.result()
+    finally:
+        mb.close(timeout_s=5.0)
+
+
+def test_microbatch_timeout_error_carries_diagnostics():
+    from orange3_spark_tpu.serve.microbatch import MicroBatchTimeoutError
+
+    ac = AdmissionController(max_inflight=8, max_queue=64)
+    ac.diagnostics_hook = lambda: {"M:array": "open"}
+    mb = _stub_mb(dispatch_hold_s=5.0, admission=ac, max_wait_ms=1.0,
+                  deadline_s=0.1)
+    try:
+        fut = _submit(mb)
+        assert fut is not None
+        with pytest.raises(MicroBatchTimeoutError) as ei:
+            fut.result()
+        d = ei.value.diagnostics
+        assert d["worker_alive"] is True and "queue_depth" in d
+        assert d["breakers"] == {"M:array": "open"}
+        assert "queue_depth" in str(ei.value)
+    finally:
+        mb.close(timeout_s=6.0)
+
+
+# ---------------------------------------------------- shutdown races
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_shutdown_race_every_caller_gets_result_or_typed_error():
+    """Concurrent submits racing close(): no future may hang — every
+    caller sees a result, a typed timeout, or the None shed-to-direct —
+    while a breaker flips open/closed underneath."""
+    from orange3_spark_tpu.serve.microbatch import MicroBatchTimeoutError
+
+    ac = AdmissionController(max_inflight=8, max_queue=64)
+    br = CircuitBreaker("race", failure_threshold=1, cooldown_s=0.0,
+                        jitter=0.0)
+    mb = _stub_mb(dispatch_hold_s=0.001, admission=ac, max_wait_ms=0.5,
+                  deadline_s=2.0)
+    stop = threading.Event()
+    outcomes: list = []
+    errors: list = []
+
+    def submitter():
+        while not stop.is_set():
+            try:
+                fut = _submit(mb)
+                if fut is None:
+                    outcomes.append("direct")
+                    continue
+                try:
+                    fut.result()
+                    outcomes.append("ok")
+                except MicroBatchTimeoutError:
+                    outcomes.append("timeout")
+            except Exception as e:  # noqa: BLE001 - the assertion target
+                errors.append(e)
+                return
+
+    def breaker_flipper():
+        while not stop.is_set():
+            br.record_failure()
+            br.allow()
+            br.record_success()
+
+    threads = [threading.Thread(target=submitter, daemon=True)
+               for _ in range(4)]
+    threads.append(threading.Thread(target=breaker_flipper, daemon=True))
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    mb.close(timeout_s=5.0)        # races the in-flight submits
+    time.sleep(0.02)
+    stop.set()
+    for t in threads:
+        t.join(5.0)
+        assert not t.is_alive(), "a submitter hung past shutdown"
+    assert not errors, errors
+    assert outcomes and set(outcomes) <= {"ok", "timeout", "direct"}
+    assert not mb._thread.is_alive()
+
+
+def test_context_exit_races_served_predicts(session):
+    """model.predict racing ServingContext.__exit__: every call returns
+    a correct-length result (served or raw fallback) or a typed error —
+    nothing hangs, nothing crashes."""
+    from orange3_spark_tpu.serve import BucketLadder, ServingContext
+
+    model, X = _tiny_hashed_model(session)
+    ladder = BucketLadder(min_bucket=64, max_bucket=1 << 11)
+    ctx = ServingContext(ladder, micro_batch=True, max_batch=512,
+                         max_wait_ms=1.0)
+    errors: list = []
+    done = threading.Event()
+
+    def caller():
+        while not done.is_set():
+            try:
+                out = model.predict(X[:64])
+                if out.shape[0] != 64:
+                    errors.append(AssertionError(out.shape))
+            except Exception as e:  # noqa: BLE001 - the assertion target
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=caller, daemon=True)
+               for _ in range(4)]
+    with ctx:
+        ctx.warmup(model, n_cols=4, kinds=("array",), session=session)
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+    # context exited while callers are mid-flight: they fall back to the
+    # raw path (no active context) and keep answering
+    time.sleep(0.05)
+    done.set()
+    for t in threads:
+        t.join(10.0)
+        assert not t.is_alive(), "a predict hung across __exit__"
+    assert not errors, errors[:3]
+
+
+# --------------------------------------------------- brownout ladder
+def test_device_cache_brownout_ladder(monkeypatch):
+    from orange3_spark_tpu.io.streaming import _DeviceCache
+
+    def batch(kb=64):
+        return (np.zeros(kb * 256, np.float32),)   # kb KiB
+
+    # level 1 (frac >= w1): admission shrinks to HALF the budget — a
+    # stream that fits the half still caches whole; one that does not
+    # takes the normal no-partial-replay latch (drop + degraded)
+    with inject_faults("mem_pressure:frac=0.80"):
+        c = _DeviceCache(True, budget=4 * 64 * 1024)
+        c.offer(batch())
+        c.offer(batch())
+        assert len(c.batches) == 2 and not c.degraded
+        c.offer(batch())            # past HALF (would fit the full budget)
+        assert not c.batches and c.degraded
+    # level 2 (frac >= w2): nothing admitted — force the spill path
+    with inject_faults("mem_pressure:frac=0.90"):
+        c = _DeviceCache(True, budget=4 * 64 * 1024)
+        c.offer(batch())
+        assert not c.batches and c.degraded and not c.enabled
+    # level 3 (frac >= w3): an already-cached prefix is DROPPED (the HBM
+    # is handed back), after= lets the prefix cache first
+    with inject_faults("mem_pressure:frac=0.97,after=2"):
+        c = _DeviceCache(True, budget=4 * 64 * 1024)
+        c.offer(batch())
+        c.offer(batch())
+        assert len(c.batches) == 2
+        c.offer(batch())
+        assert not c.batches and c.nbytes == 0 and not c.enabled
+        assert c.degraded
+    # kill-switch: pressure ignored, legacy cache keeps everything
+    monkeypatch.setenv("OTPU_RESILIENCE", "0")
+    with inject_faults("mem_pressure:frac=0.97"):
+        c = _DeviceCache(True, budget=4 * 64 * 1024)
+        for _ in range(4):
+            c.offer(batch())
+        assert len(c.batches) == 4 and not c.degraded
+
+
+def test_healthz_reports_brownout_and_sheds():
+    from orange3_spark_tpu.obs.server import TelemetryServer
+
+    body, healthy = TelemetryServer().health()
+    assert "brownout_level" in body and "sheds" in body
+    assert isinstance(body["brownout_level"], int)
+
+
+# ---------------------------------------------- non-finite guard
+def test_divergence_guard_raises_typed(session, monkeypatch):
+    from orange3_spark_tpu.io.streaming import (
+        StreamingLinearEstimator, array_chunk_source,
+    )
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((2048, 4)).astype(np.float32)
+    X[100, 2] = np.inf                      # one poisoned cell
+    y = (X[:, 0] > 0).astype(np.float32)
+    src = array_chunk_source(X, y, chunk_rows=512)
+    est = dict(loss="logistic", epochs=3, step_size=0.1, chunk_rows=512)
+    with pytest.raises(NumericalDivergenceError) as ei:
+        StreamingLinearEstimator(**est).fit_stream(
+            src, n_features=4, session=session)
+    assert ei.value.epoch == 0              # named: first epoch
+    assert ei.value.chunk >= 1
+    assert "epoch 0" in str(ei.value)
+    # kill-switch: the legacy silent-NaN fit completes
+    monkeypatch.setenv("OTPU_RESILIENCE", "0")
+    m = StreamingLinearEstimator(**est).fit_stream(
+        src, n_features=4, session=session)
+    assert not np.isfinite(np.asarray(m.coef)).all()
+
+
+def test_divergence_final_check_sweeps_theta():
+    """The step's loss is computed from theta BEFORE its update, so a
+    LAST-step divergence leaves a finite loss — the fit-final check must
+    sweep theta anyway (per-epoch checks skip it when a loss exists)."""
+    import jax.numpy as jnp
+
+    from orange3_spark_tpu.resilience.numerics import check_finite_training
+
+    bad_theta = {"coef": jnp.asarray([np.inf, 1.0])}
+    check_finite_training(1.0, bad_theta, epoch=0, chunk=1)   # per-epoch:
+    #                       finite loss short-circuits, theta not swept
+    with pytest.raises(NumericalDivergenceError) as ei:
+        check_finite_training(1.0, bad_theta, epoch=3, chunk=7,
+                              final=True)
+    assert ei.value.what == "theta" and ei.value.epoch == 3
+
+
+# ----------------------------------------------------- drill smoke
+def test_overload_drill_smoke(session):
+    from tools.overload_drill import run_drill
+
+    rows = run_drill(session=session, requests=12, service_ms=15.0)
+    assert [r["rung"] for r in rows] == ["admission", "breaker",
+                                         "brownout"]
+    bad = [r for r in rows if not r["ok"]]
+    assert not bad, bad

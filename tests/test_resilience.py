@@ -43,9 +43,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 @pytest.fixture(autouse=True)
 def _fast_retries(monkeypatch):
     """Keep real backoff sleeps out of tier-1 (tests that pin the
-    schedule use an injected fake clock instead)."""
+    schedule use an injected fake clock instead), and start each test
+    with a fresh dispatch breaker — a wedge in a NEIGHBORING test's
+    budgeted sync would otherwise fast-fail this test's first guarded
+    sync for the breaker's cooldown window (resilience/overload.py)."""
+    from orange3_spark_tpu.resilience.overload import reset_wedge_breaker
+
     monkeypatch.setenv("OTPU_RETRY_BASE_S", "0.001")
     reset_resilience_counters()
+    reset_wedge_breaker()
+    yield
+    reset_wedge_breaker()
 
 
 def _data(n=2048, d=4, seed=0):
