@@ -943,6 +943,16 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
     }
 
 
+def _traced_requests_total() -> int:
+    """Current otpu_traced_requests_total (obs/context.py coverage
+    counter) — the serving/overload configs delta this around their
+    measured windows."""
+    from orange3_spark_tpu.obs.registry import REGISTRY
+
+    m = REGISTRY.get("otpu_traced_requests_total")
+    return int(m.total()) if m is not None else 0
+
+
 def bench_serving(n_rows: int, *, dims: int = 1 << 18,
                   backend: str = "") -> dict:
     """Serving bench (serve/ subsystem): the predict hot path on the Criteo
@@ -1053,8 +1063,12 @@ def bench_serving(n_rows: int, *, dims: int = 1 << 18,
     recompiles_raw = xla_compile_count() - c0
 
     # ---- phase 2: bucketed + warmed AOT cache ----
+    from orange3_spark_tpu.obs import flight
+
     ladder = BucketLadder(min_bucket=256, max_bucket=1 << 14)
     reset_serve_counters()
+    traced0 = _traced_requests_total()
+    flight0 = flight.bundles_written()
     ctx = ServingContext(ladder)
     with ctx:
         _log("[serving] warmup (AOT-compiling the bucket ladder) ...")
@@ -1068,6 +1082,9 @@ def bench_serving(n_rows: int, *, dims: int = 1 << 18,
         lat_b, wall_b = run_trace()
         recompiles_b = xla_compile_count() - c0   # warmup compiles INCLUDED
         sc = serve_counters()
+    # per-request trace coverage (obs/context.py): every bucketed-phase
+    # request should have minted a trace id at its serving entry
+    traced_requests = _traced_requests_total() - traced0
 
     # ---- phase 3: bucketed + micro-batch, concurrent small requests ----
     small = [(o, s) for o, s in trace if s <= 1024] * 2
@@ -1132,6 +1149,10 @@ def bench_serving(n_rows: int, *, dims: int = 1 << 18,
         "mb_merge_factor": (round(mb["mb_merge_factor"], 2)
                             if mb["mb_merge_factor"] else None),
         "mb_rows_per_sec_per_chip": round(mb_rows / wall_mb / n_chips, 1),
+        # ---- trace-context + flight-recorder coverage (ISSUE 9) ----
+        "traced_requests": traced_requests,
+        "trace_coverage": round(traced_requests / len(trace), 3),
+        "flight_bundles_written": flight.bundles_written() - flight0,
     }
 
 
@@ -1409,16 +1430,21 @@ def bench_overload(*, requests: int = 64, service_ms: float = 25.0) -> dict:
     def pctl(lat, q):
         return round(float(np.percentile(np.asarray(lat), q)), 3)
 
+    from orange3_spark_tpu.obs import flight
+
+    flight0 = flight.bundles_written()
     # ---- arm 1: legacy unbounded (the kill-switch contract) ----
     raw = run_arm({"OTPU_RESILIENCE": "0"}, "raw (OTPU_RESILIENCE=0)")
     # ---- arm 2: admission-controlled ----
     shed0 = shed_total()
+    traced0 = _traced_requests_total()
     adm = run_arm({
         "OTPU_RESILIENCE": "1",
         "OTPU_ADMISSION_DEADLINE_S": "0.1",
         "OTPU_ADMISSION_SERVICE_MS": str(service_ms),
     }, "admission-controlled")
     typed_sheds = shed_total() - shed0
+    traced_requests = _traced_requests_total() - traced0
 
     # ---- circuit-breaker drill: flaky AOT backend re-admitted ----
     _log("[overload] circuit-breaker half-open drill ...")
@@ -1489,6 +1515,10 @@ def bench_overload(*, requests: int = 64, service_ms: float = 25.0) -> dict:
         # ---- breaker + brownout drills ----
         "breaker_readmitted": breaker_readmitted,
         "brownout_level_reached": brownout_reached,
+        # ---- trace-context + flight-recorder coverage (ISSUE 9) ----
+        "traced_requests": traced_requests,
+        "trace_coverage": round(traced_requests / requests, 3),
+        "flight_bundles_written": flight.bundles_written() - flight0,
     }
 
 

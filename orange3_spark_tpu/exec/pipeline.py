@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Callable, Iterator
 
+from orange3_spark_tpu.obs import context as obs_context
 from orange3_spark_tpu.obs.trace import span
 from orange3_spark_tpu.utils.dispatch import beat
 
@@ -104,41 +105,14 @@ class PipelinedExecutor:
         q: queue.Queue = queue.Queue(maxsize=self.depth)
         stop = threading.Event()
         prep = self.prep
+        # the consumer's trace context (the fit's run id) — the worker
+        # thread adopts it so its "prefetch" spans carry the same trace
+        # id as the fit/epoch/chunk spans they feed (obs/context.py)
+        trace_ctx = obs_context.current_trace()
 
         def worker():
-            it = iter(items)
-            try:
-                while True:
-                    # time the PULL too: the upstream iterator is where the
-                    # parse/rechunk work lives (prep is only pad+device_put),
-                    # and both run on this thread — prep_s must carry the
-                    # whole host-side cost or overlap_pct overstates waits
-                    t0 = time.perf_counter()
-                    with span("prefetch", stats.items):
-                        try:
-                            item = next(it)
-                        except StopIteration:
-                            break
-                        out = prep(item)
-                    stats.prep_s += time.perf_counter() - t0
-                    beat()  # parse/DMA progress feeds the stall watchdog
-                    while not stop.is_set():
-                        try:
-                            q.put(out, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-                    if stop.is_set():
-                        return
-                payload = (_EOF, None)
-            except BaseException as e:  # noqa: BLE001 - re-raised on consumer
-                payload = (_EOF, e)
-            while not stop.is_set():
-                try:
-                    q.put(payload, timeout=0.1)
-                    return
-                except queue.Full:
-                    continue
+            with obs_context.adopt(trace_ctx):
+                self._produce(iter(items), q, stop, prep, stats)
 
         t = threading.Thread(target=worker, daemon=True, name=self.name)
         t.start()
@@ -163,6 +137,42 @@ class PipelinedExecutor:
                 from orange3_spark_tpu.utils.profiling import record_pipeline
 
                 record_pipeline(stats)
+
+    @staticmethod
+    def _produce(it, q, stop, prep, stats) -> None:
+        """The worker-thread body (runs under the adopted trace context)."""
+        try:
+            while True:
+                # time the PULL too: the upstream iterator is where the
+                # parse/rechunk work lives (prep is only pad+device_put),
+                # and both run on this thread — prep_s must carry the
+                # whole host-side cost or overlap_pct overstates waits
+                t0 = time.perf_counter()
+                with span("prefetch", stats.items):
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        break
+                    out = prep(item)
+                stats.prep_s += time.perf_counter() - t0
+                beat()  # parse/DMA progress feeds the stall watchdog
+                while not stop.is_set():
+                    try:
+                        q.put(out, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+            payload = (_EOF, None)
+        except BaseException as e:  # noqa: BLE001 - re-raised on consumer
+            payload = (_EOF, e)
+        while not stop.is_set():
+            try:
+                q.put(payload, timeout=0.1)
+                return
+            except queue.Full:
+                continue
 
 
 def prefetch_iter(prep: Callable, items: Iterator, *, depth: int = 2,

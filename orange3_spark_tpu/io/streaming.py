@@ -1027,7 +1027,7 @@ class DiskChunkCache:
                     )
 
                     record_crc_failure()
-                    raise SpillCorruptionError(
+                    err = SpillCorruptionError(
                         f"spill record {i} of {self.n_records} in "
                         f"{self.path!r} failed CRC verification (stored "
                         f"0x{stored:08x} != computed 0x{computed:08x}): "
@@ -1035,6 +1035,13 @@ class DiskChunkCache:
                         "spill and re-run the fit (OTPU_RESILIENCE=0 "
                         "skips verification)."
                     )
+                    # black box (obs/flight.py): freeze the replay's
+                    # state — spans, registry, knobs, stacks — at the
+                    # corruption, before the raise unwinds the fit
+                    from orange3_spark_tpu.obs.flight import auto_dump
+
+                    auto_dump("spill_corruption", err)
+                    raise err
                 # the file is immutable after finalize(): verify each
                 # record ONCE, not once per replay epoch — a 100-epoch
                 # disk replay must not pay a 99x recurring CRC tax on a

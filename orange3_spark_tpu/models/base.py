@@ -202,15 +202,21 @@ class Estimator:
 
             report = RunReport("fit", estimator=type(self).__name__,
                                n_rows=table.n_rows)
+        from orange3_spark_tpu.obs.context import trace_scope
+
         t0 = time.perf_counter()
-        with span("fit", unique=True, estimator=type(self).__name__):
-            model = self._fit(table)
-            if isinstance(model, Model):
-                try:
-                    # don't time async dispatch
-                    jax.block_until_ready(model.state_pytree)
-                except NotImplementedError:
-                    pass
+        # mint the fit's run id here (reused — not shadowed — by a
+        # streaming _fit's own @traced("fit") entry), so every span and
+        # typed anomaly under this fit carries one identity
+        with trace_scope("fit", reuse=True):
+            with span("fit", unique=True, estimator=type(self).__name__):
+                model = self._fit(table)
+                if isinstance(model, Model):
+                    try:
+                        # don't time async dispatch
+                        jax.block_until_ready(model.state_pytree)
+                    except NotImplementedError:
+                        pass
         # else: stateless result (e.g. QuantileDiscretizer -> Bucketizer)
         dt = time.perf_counter() - t0
         # rows/sec/chip is THE baseline metric (BASELINE.json "metric").

@@ -1,18 +1,27 @@
 """Unified observability subsystem (docs/observability.md).
 
-Four pieces, one kill-switch (``OTPU_OBS=0``):
+Six pieces, one kill-switch (``OTPU_OBS=0``):
 
 * ``registry``  — typed thread-safe metrics (counters/gauges/histograms,
   labels, JSON snapshot, Prometheus text exposition). Always live: the
   legacy ``utils.profiling`` counter shims are views over it.
 * ``trace``     — low-overhead structured spans (lock-free ring buffer,
-  Chrome trace-event export, ``jax.profiler`` alignment). No-ops under
-  the kill-switch.
+  trace/span/parent ids, Chrome trace-event + flow-event export,
+  ``jax.profiler`` alignment). No-ops under the kill-switch.
+* ``context``   — Dapper-style trace-context propagation: per-request
+  trace ids minted at the serving entry, per-fit run ids at fit entry,
+  carried via contextvars with tail-biased retention
+  (``OTPU_TRACE_SAMPLE``).
+* ``flight``    — anomaly flight recorder: a rate-limited ``dump()``
+  writing a versioned JSON black-box bundle (spans, breaker states,
+  queue depths, knobs, all-thread stacks), fired automatically at the
+  typed-anomaly raise sites (``OTPU_FLIGHT=0`` disables).
 * ``report``    — per-run structured reports (``model.run_report_``,
-  ``ServingContext.report()``).
-* ``server``    — opt-in stdlib ``/metrics`` + ``/healthz`` endpoint on
-  serving processes (``OTPU_OBS_PORT``). Never binds under the
-  kill-switch.
+  ``ServingContext.report()``), linking into the trace ring via the
+  top-k slowest trace trees.
+* ``server``    — opt-in stdlib ``/metrics`` + ``/healthz`` +
+  ``/debug/flight`` + ``/debug/stacks`` endpoint on serving processes
+  (``OTPU_OBS_PORT``). Never binds under the kill-switch.
 """
 
 from orange3_spark_tpu.obs.registry import (  # noqa: F401
@@ -25,7 +34,10 @@ from orange3_spark_tpu.obs.server import (  # noqa: F401
 from orange3_spark_tpu.obs.trace import (  # noqa: F401
     export_chrome_trace, instant, span, span_iter, validate_chrome_trace,
 )
-from orange3_spark_tpu.obs import trace  # noqa: F401
+from orange3_spark_tpu.obs import context, flight, trace  # noqa: F401
+from orange3_spark_tpu.obs.context import (  # noqa: F401
+    current_trace_id, trace_scope,
+)
 
 
 def obs_enabled() -> bool:

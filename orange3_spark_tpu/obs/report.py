@@ -76,9 +76,20 @@ class RunReport:
         self.stage_times: dict = {}
         self.started_at = time.time()
         self._t0 = time.perf_counter()
+        self._t0_ns = time.perf_counter_ns()
         self._c0 = counter_families()
         self.wall_s: float | None = None
         self.counters: dict | None = None
+        self.slow_traces: list | None = None
+
+    def _slow_traces(self) -> list:
+        """Top-k slowest trace trees among spans recorded since this run
+        started — the report's link into the trace ring (a report names
+        the trace ids an operator can pull from the exported Chrome
+        trace or a flight bundle)."""
+        from orange3_spark_tpu.obs.trace import slowest_traces
+
+        return slowest_traces(5, since_ns=self._t0_ns)
 
     def add(self, **fields) -> "RunReport":
         """Merge run-level facts (resolved decisions, warmup info)."""
@@ -86,11 +97,13 @@ class RunReport:
         return self
 
     def finish(self) -> "RunReport":
-        """Freeze the wall clock and counter deltas (idempotent: the first
-        call wins, so a fit's report isn't re-bracketed by its caller)."""
+        """Freeze the wall clock, counter deltas and the slow-trace view
+        (idempotent: the first call wins, so a fit's report isn't
+        re-bracketed by its caller)."""
         if self.wall_s is None:
             self.wall_s = round(time.perf_counter() - self._t0, 6)
             self.counters = _delta(self._c0, counter_families())
+            self.slow_traces = self._slow_traces()
         return self
 
     def to_dict(self) -> dict:
@@ -98,9 +111,11 @@ class RunReport:
         deltas-so-far (``ctx.report()`` polls a long-lived context)."""
         if self.wall_s is not None:
             wall, counters = self.wall_s, self.counters
+            slow = self.slow_traces if self.slow_traces is not None else []
         else:
             wall = round(time.perf_counter() - self._t0, 6)
             counters = _delta(self._c0, counter_families())
+            slow = self._slow_traces()
         return {
             "kind": self.kind,
             "meta": dict(self.meta),
@@ -108,6 +123,7 @@ class RunReport:
             "wall_s": wall,
             "stage_times": dict(self.stage_times),
             "counters": counters,
+            "slow_traces": slow,
         }
 
     def to_json(self, path: str | None = None, **dump_kw) -> str:

@@ -53,17 +53,38 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
         owner: "TelemetryServer" = self.server._otpu_owner
         try:
-            if self.path.split("?")[0] == "/metrics":
+            route = self.path.split("?")[0]
+            if route == "/metrics":
                 from orange3_spark_tpu.obs.registry import REGISTRY
 
                 self._send(200, REGISTRY.to_prometheus().encode(),
                            PROM_CONTENT_TYPE)
-            elif self.path.split("?")[0] == "/healthz":
+            elif route == "/healthz":
                 body, healthy = owner.health()
                 self._send(200 if healthy else 503,
                            json.dumps(body).encode(), "application/json")
+            elif route == "/debug/flight":
+                # the manual black-box pull on a LIVE process: write a
+                # bundle (no rate limit — the operator asked) and return
+                # it; loopback-only like everything on this listener
+                from orange3_spark_tpu.obs import flight
+
+                bundle = flight.collect_bundle(
+                    "debug_endpoint", context=owner._context)
+                path = flight.dump("debug_endpoint", bundle=bundle)
+                bundle["path"] = path
+                self._send(200, json.dumps(bundle, default=str).encode(),
+                           "application/json")
+            elif route == "/debug/stacks":
+                from orange3_spark_tpu.obs import flight, trace
+
+                body = {"stacks": flight.thread_stacks(),
+                        "open_spans": trace.open_spans()}
+                self._send(200, json.dumps(body, default=str).encode(),
+                           "application/json")
             else:
-                self._send(404, b"not found: try /metrics or /healthz\n",
+                self._send(404, b"not found: try /metrics, /healthz, "
+                                b"/debug/flight or /debug/stacks\n",
                            "text/plain")
         except Exception as e:  # noqa: BLE001 - never kill the listener
             try:

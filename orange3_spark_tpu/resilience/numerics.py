@@ -28,19 +28,22 @@ _M_DIVERGENCE = REGISTRY.counter(
 
 class NumericalDivergenceError(FloatingPointError):
     """Training state went non-finite. ``what`` names the tripping value
-    ('loss' or 'theta'), ``epoch``/``chunk`` locate it in the stream."""
+    ('loss' or 'theta'), ``epoch``/``chunk`` locate it in the stream,
+    ``trace_id`` names the fit's run id (obs/context.py)."""
 
     def __init__(self, *, what: str, epoch: int, chunk: int,
-                 estimator: str = ""):
+                 estimator: str = "", trace_id: str | None = None):
         self.what = what
         self.epoch = epoch
         self.chunk = chunk
         self.estimator = estimator
+        self.trace_id = trace_id
         who = f"{estimator} " if estimator else ""
+        tr = f" [trace {trace_id}]" if trace_id else ""
         super().__init__(
             f"{who}training diverged: non-finite {what} at epoch {epoch}, "
-            f"chunk ordinal {chunk}. Lower step_size / raise reg_param, "
-            "or check the stream for Inf/NaN features. "
+            f"chunk ordinal {chunk}{tr}. Lower step_size / raise "
+            "reg_param, or check the stream for Inf/NaN features. "
             "OTPU_RESILIENCE=0 restores the legacy silent-NaN behavior."
         )
 
@@ -82,7 +85,19 @@ def check_finite_training(loss=None, theta=None, *, epoch: int, chunk: int,
         return
     _M_DIVERGENCE.inc()
     from orange3_spark_tpu.obs import trace as _trace
+    from orange3_spark_tpu.obs.context import (
+        current_trace_id, flag_current_trace,
+    )
 
     _trace.instant("divergence", what=what, epoch=epoch, chunk=chunk)
-    raise NumericalDivergenceError(
-        what=what, epoch=epoch, chunk=chunk, estimator=estimator)
+    flag_current_trace()
+    err = NumericalDivergenceError(
+        what=what, epoch=epoch, chunk=chunk, estimator=estimator,
+        trace_id=current_trace_id())
+    # black box (obs/flight.py): the fit's spans, registry state and knob
+    # table at the moment of divergence — BEFORE any checkpoint/caller
+    # cleanup can disturb them
+    from orange3_spark_tpu.obs.flight import auto_dump
+
+    auto_dump("divergence", err)
+    raise err

@@ -100,22 +100,26 @@ class OverloadShedError(RuntimeError):
     """A serving request was shed by admission control instead of being
     queued past its deadline (or past the hard queue bound). Carries the
     live evidence — ``queue_depth``, ``inflight``, ``est_wait_s``,
-    ``deadline_s`` and a ``diagnostics`` dict (breaker states when the
-    owning context provides them) — so a shed in production logs is
-    self-explaining."""
+    ``deadline_s``, the request's ``trace_id`` (obs/context.py) and a
+    ``diagnostics`` dict (breaker states when the owning context provides
+    them) — so a shed in production logs is self-explaining."""
 
     def __init__(self, *, reason: str, queue_depth: int, inflight: int,
                  est_wait_s: float, deadline_s: float | None,
-                 diagnostics: dict | None = None):
+                 diagnostics: dict | None = None,
+                 trace_id: str | None = None):
         self.reason = reason
         self.queue_depth = queue_depth
         self.inflight = inflight
         self.est_wait_s = est_wait_s
         self.deadline_s = deadline_s
         self.diagnostics = diagnostics or {}
+        self.trace_id = trace_id
         dl = (f"{deadline_s:.3g}s deadline" if deadline_s is not None
               else "no deadline")
         extra = (f"; {self.diagnostics}" if self.diagnostics else "")
+        if trace_id:
+            extra = f" [trace {trace_id}]" + extra
         super().__init__(
             f"request shed ({reason}): projected queue wait "
             f"{est_wait_s:.3g}s vs {dl} at queue depth {queue_depth} "
@@ -249,10 +253,31 @@ class AdmissionController:
 
     def _shed(self, reason: str, queue_depth: int, est: float,
               deadline_s: float | None):
+        from orange3_spark_tpu.obs.context import (
+            current_trace_id, flag_current_trace,
+        )
+
         _record_shed(reason)
+        # tail retention keeps the shed trace whole in the ring. The
+        # flight-recorder dump happens at the PUBLIC entry points
+        # (_dump_shed), outside the admission condition variable —
+        # slot() sheds from inside `with self._cv:`, and a bundle write
+        # (stacks + registry + disk IO) under that lock would stall
+        # every other caller at exactly the moment of peak overload.
+        flag_current_trace()
         raise OverloadShedError(
             reason=reason, queue_depth=queue_depth, inflight=self._inflight,
-            est_wait_s=est, deadline_s=deadline_s, diagnostics=self._diag())
+            est_wait_s=est, deadline_s=deadline_s, diagnostics=self._diag(),
+            trace_id=current_trace_id())
+
+    @staticmethod
+    def _dump_shed(err: "OverloadShedError") -> None:
+        """Black box (obs/flight.py): the first shed of an overload spell
+        freezes queue depths/breakers/stacks; the rate limit keeps a shed
+        storm from becoming an IO storm. Called with NO locks held."""
+        from orange3_spark_tpu.obs.flight import auto_dump
+
+        auto_dump("overload_shed", err)
 
     # ------------------------------------------------------- entrypoints
     def check_queue(self, queue_depth: int,
@@ -270,12 +295,16 @@ class AdmissionController:
         d = deadline_s if deadline_s is not None else _ambient_deadline_s()
         if d is None or math.isinf(d):
             return
-        if queue_depth >= self.max_queue:
-            self._shed("queue_full", queue_depth,
-                       self.estimate_wait_s(queue_depth, parallelism), d)
-        est = self.estimate_wait_s(queue_depth, parallelism)
-        if est > d:
-            self._shed("projected_wait", queue_depth, est, d)
+        try:
+            if queue_depth >= self.max_queue:
+                self._shed("queue_full", queue_depth,
+                           self.estimate_wait_s(queue_depth, parallelism), d)
+            est = self.estimate_wait_s(queue_depth, parallelism)
+            if est > d:
+                self._shed("projected_wait", queue_depth, est, d)
+        except OverloadShedError as e:
+            self._dump_shed(e)
+            raise
 
     @contextmanager
     def slot(self, deadline_s: float | None = None):
@@ -290,6 +319,24 @@ class AdmissionController:
         if d is not None and math.isinf(d):
             d = None    # request_deadline(inf): admitted work (the mb
             #             worker) waits for a slot but is never shed
+        try:
+            self._acquire(d)
+        except OverloadShedError as e:
+            # the raise already released self._cv — the flight dump's
+            # stack/registry/disk work must never run under it
+            self._dump_shed(e)
+            raise
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe_service(time.perf_counter() - t0)
+            with self._cv:
+                self._inflight -= 1
+                _M_INFLIGHT.set(self._inflight)
+                self._cv.notify()
+
+    def _acquire(self, d: float | None) -> None:
         with self._cv:
             depth = self._waiters
             backlog = depth + max(self._inflight - self.max_inflight + 1, 0)
@@ -325,15 +372,6 @@ class AdmissionController:
                 _M_QUEUE_DEPTH.set(self._waiters)
             self._inflight += 1
             _M_INFLIGHT.set(self._inflight)
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.observe_service(time.perf_counter() - t0)
-            with self._cv:
-                self._inflight -= 1
-                _M_INFLIGHT.set(self._inflight)
-                self._cv.notify()
 
 
 # ----------------------------------------------------- circuit breaker

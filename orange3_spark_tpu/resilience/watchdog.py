@@ -53,13 +53,17 @@ class DispatchWedgedError(RuntimeError):
     last liveness beat)."""
 
     def __init__(self, *, stage: str, step: int | None, budget_s: float,
-                 waited_s: float, diagnostics: dict):
+                 waited_s: float, diagnostics: dict,
+                 trace_id: str | None = None):
         self.stage = stage
         self.step = step
         self.budget_s = budget_s
         self.waited_s = waited_s
         self.diagnostics = diagnostics
+        self.trace_id = trace_id
         at = f" at step {step}" if step is not None else ""
+        if trace_id:
+            at += f" [trace {trace_id}]"
         super().__init__(
             f"device dispatch wedged: {stage}{at} exceeded its "
             f"{budget_s:.3g}s budget (waited {waited_s:.3g}s; last "
@@ -125,13 +129,18 @@ def guarded_block_until_ready(token, *, step: int | None = None,
     # re-admits the backend automatically
     from orange3_spark_tpu.resilience.overload import wedge_breaker
 
+    from orange3_spark_tpu.obs.context import (
+        current_trace_id, flag_current_trace,
+    )
+
     breaker = wedge_breaker()
     if not breaker.allow():
         diag = _diagnostics()
         diag["breaker_state"] = breaker.state()
+        flag_current_trace()     # tail retention keeps the killed trace
         raise DispatchWedgedError(
             stage=stage, step=step, budget_s=budget, waited_s=0.0,
-            diagnostics=diag,
+            diagnostics=diag, trace_id=current_trace_id(),
         )
     done = threading.Event()
     err: list = []
@@ -154,10 +163,22 @@ def guarded_block_until_ready(token, *, step: int | None = None,
 
         record_wedge()
         breaker.record_failure()
-        raise DispatchWedgedError(
+        flag_current_trace()
+        # a DISTINCT name: `err` is the waiter closure's result list, and
+        # rebinding it here would turn the abandoned waiter's eventual
+        # err.append(e) into an AttributeError on this exception object
+        wedge_err = DispatchWedgedError(
             stage=stage, step=step, budget_s=budget,
             waited_s=time.perf_counter() - t0, diagnostics=_diagnostics(),
+            trace_id=current_trace_id(),
         )
+        # black box (obs/flight.py): the waiter thread is still parked in
+        # the runtime RIGHT NOW, so the bundle's stacks catch it, and the
+        # wedged dispatch span is still open on this thread
+        from orange3_spark_tpu.obs.flight import auto_dump
+
+        auto_dump("dispatch_wedged", wedge_err)
+        raise wedge_err
     if err:
         raise err[0]
     breaker.record_success()

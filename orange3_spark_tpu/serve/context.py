@@ -62,8 +62,10 @@ from orange3_spark_tpu.core.table import TpuTable
 from orange3_spark_tpu.serve.bucketing import (
     BucketLadder, domain_sig, pad_rows_np, table_to_host,
 )
+from orange3_spark_tpu.obs import context as obs_context
 from orange3_spark_tpu.obs.registry import REGISTRY
-from orange3_spark_tpu.obs.trace import span
+from orange3_spark_tpu.obs.trace import enabled as trace_enabled
+from orange3_spark_tpu.obs.trace import flow, span
 from orange3_spark_tpu.resilience.overload import (
     AdmissionController, CircuitBreaker, maybe_injected_service_delay,
     shed_total,
@@ -78,6 +80,9 @@ from orange3_spark_tpu.utils.profiling import record_serve
 # IDLE process (zero in flight, nothing to beat about) stays healthy
 _M_INFLIGHT = REGISTRY.gauge(
     "otpu_serve_inflight", "routed serve calls currently in flight")
+_M_TRACED = REGISTRY.counter(
+    "otpu_traced_requests_total",
+    "serve requests that minted a trace id at entry")
 
 log = logging.getLogger("orange3_spark_tpu")
 
@@ -112,6 +117,34 @@ class _raw_calls:
         _TLS.depth -= 1
 
 
+def _request_scope():
+    """Per-request trace context (obs/context.py): mint a trace id at the
+    serving entry — ``route()`` for table calls, ``served_array`` for the
+    raw-chunk models whose predict routes itself — unless an outer scope
+    already minted one (reuse). Ticks the trace-coverage counter only on
+    a genuine mint, so ``traced_requests / requests`` is an honest ratio."""
+    if trace_enabled() and obs_context.current_trace() is None:
+        _M_TRACED.inc()
+    return obs_context.trace_scope("serve", reuse=True, sample=True)
+
+
+# micro-batch flush -> _dispatch side channel for the merged requests'
+# trace ids (same worker thread; the _dispatch SIGNATURE stays stable for
+# the stub-context tests). take() clears, so ids never leak across
+# flushes.
+_DISPATCH_TLS = threading.local()
+
+
+def set_dispatch_traces(ids) -> None:
+    _DISPATCH_TLS.ids = ids
+
+
+def take_dispatch_traces():
+    ids = getattr(_DISPATCH_TLS, "ids", None)
+    _DISPATCH_TLS.ids = None
+    return ids
+
+
 def route(kind: str, raw_fn: Callable, model, *args, **kwargs):
     """The models.base dispatch point: serve when a context is active and
     the call is a plain single-table ``transform``/``predict``; otherwise
@@ -129,10 +162,14 @@ def route(kind: str, raw_fn: Callable, model, *args, **kwargs):
     beat()
     _M_INFLIGHT.inc()
     try:
-        with span("serve", kind=kind, rows=table.n_rows):
-            if kind == "transform":
-                return ctx.served_transform(model, table, raw_fn)
-            return ctx.served_predict(model, table, raw_fn)
+        # every routed request gets a trace id here — the Dapper entry
+        # point; the serve span (and everything under it, including a
+        # micro-batched flush on another thread via flow events) carries it
+        with _request_scope():
+            with span("serve", kind=kind, rows=table.n_rows):
+                if kind == "transform":
+                    return ctx.served_transform(model, table, raw_fn)
+                return ctx.served_predict(model, table, raw_fn)
     finally:
         _M_INFLIGHT.dec()
         beat()
@@ -467,10 +504,19 @@ class ServingContext:
         (caller falls through to its raw path)."""
         Xall = np.asarray(Xall)
         n = Xall.shape[0]
-        bucket = self.ladder.bucket_for(n)
-        if bucket is None or self._breaker_blocks(_fingerprint(model),
-                                                  "array"):
+        # serving-doesn't-apply checks BEFORE the trace mint: a request
+        # falling straight through to its raw path must neither record a
+        # near-zero "serve" span nor inflate the coverage counter
+        if (self.ladder.bucket_for(n) is None
+                or self._breaker_blocks(_fingerprint(model), "array")):
             return None
+        # array-serving models route THEMSELVES here (route() only sees
+        # table calls), so this is their per-request trace-id entry point
+        with _request_scope():
+            with span("serve", kind="array", rows=n):
+                return self._served_array_inner(model, Xall, n)
+
+    def _served_array_inner(self, model, Xall: np.ndarray, n: int):
         rec = self._record_for(model)
         from orange3_spark_tpu.core.session import TpuSession
 
@@ -496,7 +542,11 @@ class ServingContext:
                   meta) -> np.ndarray:
         """Pad ``arrays`` (host, row-stripped) to the bucket, run the AOT
         executable, return per-row outputs stripped back to ``n`` rows.
-        The micro-batcher calls this with MERGED request rows."""
+        The micro-batcher calls this with MERGED request rows (their
+        trace ids ride the thread-local side channel; flow-end events
+        inside the dispatch span close each request's submit→flush→
+        dispatch arrow)."""
+        member_traces = take_dispatch_traces()
         session, domain, x_dtype = meta
         bucket = self.ladder.bucket_for(n)
         if bucket is None:       # merged batch outgrew the ladder: clamp
@@ -517,10 +567,14 @@ class ServingContext:
             self._breaker_ok(rec.fingerprint, "array")
             with self.admission.slot():
                 maybe_injected_service_delay()
-                Xd = jax.device_put(pad_rows_np(X, n_pad),
-                                    session.row_sharding)
-                out = compiled(state, Xd)
-                return np.asarray(jax.device_get(out))[:n]
+                with span("serve_dispatch", kind="array", rows=n,
+                          n_pad=n_pad):
+                    for t in member_traces or ():
+                        flow("f", t)
+                    Xd = jax.device_put(pad_rows_np(X, n_pad),
+                                        session.row_sharding)
+                    out = compiled(state, Xd)
+                    return np.asarray(jax.device_get(out))[:n]
         key = ("predict", rec.fingerprint, n_pad, X.shape[1],
                str(X.dtype), (Y.shape[1] if Y is not None else 0),
                domain_sig(domain), _mesh_key(session))
@@ -539,13 +593,19 @@ class ServingContext:
         self._breaker_ok(rec.fingerprint, "predict")
         with self.admission.slot():
             maybe_injected_service_delay()
-            Xd = jax.device_put(pad_rows_np(X, n_pad), session.row_sharding)
-            Yd = (jax.device_put(pad_rows_np(Y, n_pad), session.row_sharding)
-                  if Y is not None else None)
-            Wd = jax.device_put(pad_rows_np(W, n_pad),
-                                session.vector_sharding)
-            out = compiled(Xd, Yd, Wd)
-            return np.asarray(jax.device_get(out))[:n]
+            with span("serve_dispatch", kind="predict", rows=n,
+                      n_pad=n_pad):
+                for t in member_traces or ():
+                    flow("f", t)
+                Xd = jax.device_put(pad_rows_np(X, n_pad),
+                                    session.row_sharding)
+                Yd = (jax.device_put(pad_rows_np(Y, n_pad),
+                                     session.row_sharding)
+                      if Y is not None else None)
+                Wd = jax.device_put(pad_rows_np(W, n_pad),
+                                    session.vector_sharding)
+                out = compiled(Xd, Yd, Wd)
+                return np.asarray(jax.device_get(out))[:n]
 
     # ------------------------------------------------------------ builders
     def _table_key(self, kind, rec, table: TpuTable, n_pad: int) -> tuple:
@@ -797,7 +857,21 @@ class ServingContext:
         out["micro_batcher_active"] = self.micro_batcher is not None
         out["telemetry_url"] = (self._telemetry.url
                                 if self._telemetry is not None else None)
+        if "slow_traces" not in out:
+            # never-entered contexts have no RunReport to have frozen the
+            # slow-trace view; compute it live (same shape either way)
+            from orange3_spark_tpu.obs.trace import slowest_traces
+
+            out["slow_traces"] = slowest_traces(5)
         return out
+
+    def dump_flight(self, reason: str = "manual") -> str | None:
+        """Write an anomaly flight bundle NOW (obs/flight.py) — the manual
+        black-box pull for a live serving process. Returns the bundle path
+        (None under the OTPU_OBS/OTPU_FLIGHT kill-switches)."""
+        from orange3_spark_tpu.obs import flight
+
+        return flight.dump(reason, context=self)
 
     # ------------------------------------------------- staged-graph reuse
     def staged_executable(self, staged, example_args):

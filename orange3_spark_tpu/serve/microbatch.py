@@ -54,7 +54,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from orange3_spark_tpu.obs.trace import span
+from orange3_spark_tpu.obs.context import current_trace_id
+from orange3_spark_tpu.obs.trace import flow, span
 from orange3_spark_tpu.serve.bucketing import domain_sig
 from orange3_spark_tpu.utils.dispatch import beat
 from orange3_spark_tpu.utils.profiling import record_serve
@@ -65,19 +66,23 @@ _SENTINEL = object()
 class MicroBatchTimeoutError(TimeoutError):
     """A micro-batched request's future missed its hard deadline — the
     coalescer thread died or its merged dispatch wedged. Carries the
-    request's ``group_key`` (model fingerprint / schema / session) plus
+    request's ``group_key`` (model fingerprint / schema / session) and
+    ``trace_id`` (minted at the serving entry, obs/context.py) plus
     live ``diagnostics`` (queue depth, worker liveness, breaker states)
     so the stuck endpoint is self-explaining from the error alone."""
 
     def __init__(self, group_key, waited_s: float,
-                 diagnostics: dict | None = None):
+                 diagnostics: dict | None = None,
+                 trace_id: str | None = None):
         self.group_key = group_key
         self.waited_s = waited_s
         self.diagnostics = diagnostics or {}
+        self.trace_id = trace_id
         extra = f" Diagnostics: {self.diagnostics}." if self.diagnostics \
             else ""
+        tr = f" [trace {trace_id}]" if trace_id else ""
         super().__init__(
-            f"micro-batched request (group_key={group_key!r}) got no "
+            f"micro-batched request (group_key={group_key!r}){tr} got no "
             f"result within its {waited_s:.3g}s deadline: the dispatch "
             f"thread died or its device dispatch wedged.{extra} Direct "
             "dispatch (micro_batch=False) or OTPU_MB_DEADLINE_S tune the "
@@ -92,6 +97,7 @@ class _DeadlineFuture(Future):
     _deadline_s: float | None = None
     _group_key = None
     _diag_fn = None
+    _trace_id = None
 
     def _timeout_error(self, eff) -> MicroBatchTimeoutError:
         diag = None
@@ -100,7 +106,8 @@ class _DeadlineFuture(Future):
                 diag = self._diag_fn()
             except Exception:  # noqa: BLE001 - diagnostics must not mask
                 diag = None
-        return MicroBatchTimeoutError(self._group_key, eff, diag)
+        return MicroBatchTimeoutError(self._group_key, eff, diag,
+                                      trace_id=self._trace_id)
 
     def result(self, timeout=None):
         eff = timeout if timeout is not None else self._deadline_s
@@ -129,6 +136,7 @@ class _Request:
     n: int                       # logical rows in this request
     meta: tuple                  # (session, domain, x_dtype) for dispatch
     future: Future = field(default_factory=Future)
+    trace_id: str | None = None  # the caller's trace id (obs/context.py)
 
     @property
     def group_key(self):
@@ -208,10 +216,21 @@ class MicroBatcher:
         fut = _DeadlineFuture()
         fut._deadline_s = self.deadline_s
         fut._diag_fn = self.diagnostics
+        trace_id = current_trace_id()
         req = _Request(kind, rec, tuple(
             np.asarray(a) if a is not None else None for a in arrays
-        ), n, meta, future=fut)
+        ), n, meta, future=fut, trace_id=trace_id)
         fut._group_key = req.group_key
+        fut._trace_id = trace_id
+        if trace_id is not None:
+            # flow start (inside the caller's serve span): the arrow's
+            # tail; the flush's step and the dispatch's end complete the
+            # submit → flush → dispatch link across threads. Emitted
+            # BEFORE the enqueue — the worker can flush (and stamp the
+            # 't'/'f' hops) in the gap, and an out-of-order chain draws
+            # no arrow; a rare dangling 's' on the shed-to-direct path
+            # below is harmless by the flow-event rules.
+            flow("s", trace_id)
         # atomic with close(): no request can land BEHIND the shutdown
         # sentinel, where the worker would exit without resolving its
         # future and the caller would block in fut.result() forever
@@ -301,11 +320,26 @@ class MicroBatcher:
 
     def _flush(self, batch: list, rows: int) -> None:
         record_serve(mb_requests=len(batch), mb_batches=1)
-        with span("mb_flush", requests=len(batch), rows=rows):
-            self._flush_inner(batch, rows)
+        traces = [r.trace_id for r in batch if r.trace_id is not None]
+        with span("mb_flush", requests=len(batch), rows=rows,
+                  **({"traces": traces} if traces else {})):
+            # flow steps: each member request's arrow passes through this
+            # merged flush on the worker thread
+            for t in traces:
+                flow("t", t)
+            self._flush_inner(batch, rows, traces)
 
-    def _flush_inner(self, batch: list, rows: int) -> None:
+    def _flush_inner(self, batch: list, rows: int,
+                     traces: list | None = None) -> None:
         try:
+            from orange3_spark_tpu.serve.context import set_dispatch_traces
+
+            # side channel (same thread): _dispatch closes each member's
+            # flow arrow inside its serve_dispatch span. Set
+            # UNCONDITIONALLY — an empty list clears the slot, so a
+            # traceless flush (or one that fails before _dispatch) can
+            # never hand the PREVIOUS flush's ids to the next dispatch
+            set_dispatch_traces(traces or [])
             first = batch[0]
             if len(batch) == 1:
                 merged = first.arrays

@@ -32,6 +32,7 @@ __all__ = [
     "get_raw",
     "get_str",
     "knob_table_md",
+    "resolved",
 ]
 
 
@@ -145,6 +146,23 @@ _ALL = [
          "than this many seconds."),
     Knob("OTPU_OBS_TRACE_CAP", "int", 65536, "obs",
          "Span ring-buffer capacity (oldest events overwrite past it)."),
+    Knob("OTPU_TRACE_SAMPLE", "float", 1.0, "obs",
+         "Fraction of fast-OK serve traces retained in the ring "
+         "(deterministic per-trace-id coin); slow, shed and erroring "
+         "traces are always kept whole (tail-biased retention)."),
+    Knob("OTPU_TRACE_SLOW_MS", "float", 250.0, "obs",
+         "Latency above which an unsampled serve trace is retained "
+         "anyway (the tail the ring exists to explain)."),
+    Knob("OTPU_FLIGHT", "flag", "1", "obs",
+         "Anomaly flight-recorder kill-switch; 0 = typed anomalies write "
+         "no bundles (OTPU_OBS=0 disables it too)."),
+    Knob("OTPU_FLIGHT_DIR", "str", "/tmp/otpu_flight", "obs",
+         "Directory automatic and manual flight bundles are written to."),
+    Knob("OTPU_FLIGHT_MAX", "int", 16, "obs",
+         "Max flight bundles kept in OTPU_FLIGHT_DIR (oldest deleted)."),
+    Knob("OTPU_FLIGHT_RATE_S", "float", 60.0, "obs",
+         "Min seconds between AUTOMATIC flight bundles (an anomaly storm "
+         "must not become an IO storm); manual dumps are unlimited."),
     # --------------------------------------------------------- harness
     Knob("OTPU_BENCH_DIR", "str", "/tmp/otpu_bench", "harness",
          "Bench scratch dir (generated CSVs, spills)."),
@@ -218,6 +236,17 @@ def get_int(name: str) -> int | None:
 
 def get_float(name: str) -> float | None:
     return _num(name, float)
+
+
+def resolved() -> dict:
+    """Every knob's CURRENT resolved value (typed getters, so malformed
+    env values show as their declared defaults — exactly what the code
+    will act on). The flight recorder embeds this table in every bundle:
+    'which knobs was this process actually running under' is the first
+    post-mortem question."""
+    getters = {"flag": get_bool, "int": get_int, "float": get_float,
+               "str": get_str, "marker": get_raw}
+    return {k.name: getters[k.type](k.name) for k in KNOBS.values()}
 
 
 def knob_table_md() -> str:

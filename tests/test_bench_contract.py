@@ -79,7 +79,10 @@ def _run(argv, timeout=420):
      {"p50_ms", "p99_ms", "recompiles", "bucket_hits",
       "recompiles_unbucketed", "compile_reduction", "p50_ms_unbucketed",
       "p99_ms_unbucketed", "pad_overhead", "mb_merge_factor",
-      "warmup_buckets", "baseline_value", "baseline_note"}),
+      "warmup_buckets", "baseline_value", "baseline_note",
+      # trace-context coverage (ISSUE 9): every bucketed-phase request
+      # minted a trace id at its serving entry
+      "traced_requests", "trace_coverage", "flight_bundles_written"}),
     # resilience fault arm (ISSUE 6): the recovery-overhead A/B line must
     # carry the fields the acceptance criterion is judged on — bounded
     # retries absorbing injected faults bitwise, and the watchdog
@@ -99,7 +102,10 @@ def _run(argv, timeout=420):
      {"p99_ms_admitted", "p99_ms_raw", "p99_bound_factor", "sheds",
       "typed_sheds", "shed_fraction", "completed", "hung_futures",
       "lost_futures", "goodput_rows_per_s_per_chip", "legacy_unbounded",
-      "breaker_readmitted", "brownout_level_reached"}),
+      "breaker_readmitted", "brownout_level_reached",
+      # ISSUE 9: shed anomalies auto-write flight bundles, and every
+      # burst request carried a trace id
+      "traced_requests", "trace_coverage", "flight_bundles_written"}),
 ])
 def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
     r = _run(argv)
@@ -163,6 +169,13 @@ def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
         assert d["parity_bitwise"] is True
         assert d["watchdog_raised"] is True
         assert d["faults_injected"] >= 1 and d["retries"] >= 1
+    if "trace_coverage" in extra_keys:
+        # the ISSUE-9 coverage claim: every request through the measured
+        # serving window minted a trace id at entry (traced/requests == 1)
+        assert d["traced_requests"] >= 1
+        assert d["trace_coverage"] == 1.0, (
+            d["traced_requests"], d["requests"])
+        assert isinstance(d["flight_bundles_written"], int)
     if "p99_bound_factor" in extra_keys:
         # the overload claims (ISSUE 8 acceptance): under the injected
         # overload trace the admission-controlled arm keeps p99 >= 3x
@@ -179,3 +192,6 @@ def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
         assert d["legacy_unbounded"] is True
         assert d["breaker_readmitted"] is True
         assert d["brownout_level_reached"] >= 2
+        # ISSUE 9: the first shed of the admitted arm auto-wrote a black
+        # box (sheds >= 1 is asserted above, so a bundle must exist)
+        assert d["flight_bundles_written"] >= 1

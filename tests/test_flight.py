@@ -1,0 +1,526 @@
+"""ISSUE-9 surface: trace-context propagation + anomaly flight recorder.
+
+* per-request trace ids at route()/served_array(), per-fit run ids at
+  fit entry, propagated to the prefetch worker and the micro-batcher
+  (flow events linking submit -> flush -> dispatch across threads);
+* typed anomalies carry the trace id of the request/run they killed —
+  including a shed delivered to a caller mid-flush;
+* tail-biased retention: fast-OK traces sample out under
+  OTPU_TRACE_SAMPLE, slow/shed/erroring traces stay whole;
+* the flight recorder: bundle schema, concurrency with live span
+  recording and registry ticks, rate limit + retention, kill-switches,
+  the wedged-dispatch end-to-end drill (auto bundle with the open
+  dispatch span and the waiter thread's stack), /debug endpoints,
+  flight_view rendering;
+* the metrics-catalog doc-drift guard (docs table <-> source-registered
+  otpu_* metrics, both directions).
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.obs import flight, trace
+from orange3_spark_tpu.obs.context import (
+    current_trace_id, trace_scope,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def flight_dir(tmp_path, monkeypatch):
+    d = str(tmp_path / "flight")
+    monkeypatch.setenv("OTPU_FLIGHT_DIR", d)
+    flight.reset_rate_limit()
+    yield d
+    flight.reset_rate_limit()
+
+
+def _bundles(d):
+    return sorted(glob.glob(os.path.join(d, "flight-*.json")))
+
+
+def _fit(session, *, chunks=20, epochs=1, chunk_rows=256, fault_spec=None):
+    from orange3_spark_tpu.io.streaming import (
+        StreamingLinearEstimator, array_chunk_source,
+    )
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((chunks * chunk_rows, 8)).astype(np.float32)
+    y = (X @ rng.standard_normal(8).astype(np.float32) > 0
+         ).astype(np.float32)
+    src = array_chunk_source(X, y, chunk_rows=chunk_rows)
+    est = StreamingLinearEstimator(loss="logistic", epochs=epochs,
+                                   chunk_rows=chunk_rows)
+    if fault_spec is None:
+        return est.fit_stream(src, n_features=8, session=session,
+                              cache_device=True)
+    from orange3_spark_tpu.resilience import inject_faults
+
+    with inject_faults(fault_spec):
+        return est.fit_stream(src, n_features=8, session=session,
+                              cache_device=True)
+
+
+# ------------------------------------------------- trace-context basics
+def test_fit_spans_share_one_run_id(session):
+    trace.clear()
+    model = _fit(session, chunks=20, epochs=2)
+    spans = [e for e in trace.events() if e[0] == "X"]
+    by_name = {}
+    for e in spans:
+        by_name.setdefault(e[1], []).append(e)
+    run_id = by_name["fit"][0][6]
+    assert run_id and run_id.startswith("fit-")
+    for name in ("epoch", "chunk", "dispatch"):
+        assert by_name.get(name), name
+        assert all(e[6] == run_id for e in by_name[name]), name
+    # parent chain: chunks nest under epochs by SPAN ID, not just time
+    epoch_ids = {e[7] for e in by_name["epoch"]}
+    assert all(e[8] in epoch_ids for e in by_name["chunk"])
+    # the run report links into the ring via the same id
+    rep = model.run_report_.to_dict()
+    assert rep["slow_traces"], "report carries no slow traces"
+    assert rep["slow_traces"][0]["trace_id"] == run_id
+
+
+def test_prefetch_worker_adopts_the_callers_context():
+    from orange3_spark_tpu.exec.pipeline import PipelinedExecutor
+
+    trace.clear()
+    with trace_scope("fit") as ctx:
+        ex = PipelinedExecutor(lambda x: x * 2, depth=2, record=False)
+        assert list(ex.run(iter(range(6)))) == [0, 2, 4, 6, 8, 10]
+    prefetch = [e for e in trace.events()
+                if e[0] == "X" and e[1] == "prefetch"]
+    assert prefetch, "no prefetch spans"
+    assert all(e[6] == ctx.trace_id for e in prefetch), \
+        "worker spans lost the caller's run id"
+    # and they ran on a DIFFERENT thread than the scope's owner
+    assert {e[4] for e in prefetch} != {threading.get_ident()}
+
+
+def test_typed_errors_carry_trace_ids():
+    from orange3_spark_tpu.resilience.numerics import (
+        NumericalDivergenceError, check_finite_training,
+    )
+    from orange3_spark_tpu.resilience.overload import (
+        AdmissionController, OverloadShedError, request_deadline,
+    )
+
+    with trace_scope("fit") as ctx:
+        with pytest.raises(NumericalDivergenceError) as exc:
+            check_finite_training(float("inf"), None, epoch=3, chunk=7)
+        assert exc.value.trace_id == ctx.trace_id
+        assert ctx.trace_id in str(exc.value)
+    adm = AdmissionController(max_inflight=1, max_queue=0)
+    with trace_scope("serve") as ctx:
+        with request_deadline(0.001):
+            with pytest.raises(OverloadShedError) as exc:
+                adm.check_queue(50)
+        assert exc.value.trace_id == ctx.trace_id
+
+
+def test_shed_during_flush_delivers_the_sheds_trace_id(monkeypatch):
+    """While the worker is mid-flush (slow dispatch), an over-deadline
+    submit must shed with the SUBMITTING caller's trace id — not hang,
+    not carry the flush's identity."""
+    from orange3_spark_tpu.resilience.overload import (
+        AdmissionController, OverloadShedError, request_deadline,
+    )
+    from orange3_spark_tpu.serve.microbatch import MicroBatcher
+
+    monkeypatch.setenv("OTPU_ADMISSION_SERVICE_MS", "250")
+    release = threading.Event()
+
+    class StubRec:
+        fingerprint = ("Stub", 1, 0)
+
+    class StubCtx:
+        def _dispatch(self, kind, rec, arrays, rows, meta):
+            release.wait(5.0)          # the flush in progress
+            return np.zeros(rows)
+
+    adm = AdmissionController(max_inflight=2, max_queue=64)
+    mb = MicroBatcher(StubCtx(), max_batch=4, max_wait_ms=1.0,
+                      admission=adm)
+    try:
+        arrays = (np.zeros((1, 2), np.float32), None, None)
+        first = mb.submit("array", StubRec(), arrays, 1, meta=(None,) * 3)
+        assert first is not None
+        time.sleep(0.1)                # worker picked it up, now blocked
+        for _ in range(3):             # park a backlog behind the flush
+            mb.submit("array", StubRec(), arrays, 1, meta=(None,) * 3)
+        with trace_scope("serve") as ctx:
+            with request_deadline(0.001):
+                with pytest.raises(OverloadShedError) as exc:
+                    mb.submit("array", StubRec(), arrays, 1,
+                              meta=(None,) * 3)
+        assert exc.value.trace_id == ctx.trace_id
+    finally:
+        release.set()
+        mb.close()
+
+
+def test_mb_timeout_error_carries_trace_id():
+    from orange3_spark_tpu.serve.microbatch import (
+        MicroBatcher, MicroBatchTimeoutError,
+    )
+
+    class StubRec:
+        fingerprint = ("Stub", 1, 0)
+
+    class StubCtx:
+        def _dispatch(self, kind, rec, arrays, rows, meta):
+            time.sleep(30)
+
+    mb = MicroBatcher(StubCtx(), max_wait_ms=1.0, deadline_s=0.2)
+    try:
+        with trace_scope("serve") as ctx:
+            fut = mb.submit("array", StubRec(),
+                            (np.zeros((1, 2), np.float32), None, None), 1,
+                            meta=(None,) * 3)
+            assert fut is not None
+        with pytest.raises(MicroBatchTimeoutError) as exc:
+            fut.result()
+        assert exc.value.trace_id == ctx.trace_id
+    finally:
+        mb.close(timeout_s=0.1)
+
+
+def test_mb_flow_events_link_submit_flush_dispatch(session):
+    import concurrent.futures
+
+    from orange3_spark_tpu.core.domain import (
+        ContinuousVariable, DiscreteVariable, Domain,
+    )
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.serve import BucketLadder, ServingContext
+
+    model = _fit(session, chunks=4, epochs=1)
+    domain = Domain([ContinuousVariable(f"f{i}") for i in range(8)],
+                    DiscreteVariable("y", ("0", "1")))
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((256, 8)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    trace.clear()
+    with ServingContext(BucketLadder(min_bucket=64, max_bucket=512),
+                        micro_batch=True, max_batch=512,
+                        max_wait_ms=5.0):
+        with concurrent.futures.ThreadPoolExecutor(6) as ex:
+            futs = []
+            for i in range(6):
+                t = TpuTable.from_numpy(domain, X[i * 16:(i + 1) * 16],
+                                        y[i * 16:(i + 1) * 16],
+                                        session=session)
+                futs.append(ex.submit(model.predict, t))
+            for f in futs:
+                f.result()
+    evs = trace.events()
+    serves = [e for e in evs if e[0] == "X" and e[1] == "serve"]
+    assert len(serves) == 6
+    ids = {e[6] for e in serves}
+    assert len(ids) == 6 and all(t.startswith("serve-") for t in ids)
+    flows = {ph: [e for e in evs if e[0] == ph] for ph in "stf"}
+    assert flows["s"] and flows["t"] and flows["f"], \
+        {k: len(v) for k, v in flows.items()}
+    # every flow id is one of the serve trace ids, and the chain is
+    # complete per id: s (caller) -> t (flush) -> f (dispatch)
+    for ph in "stf":
+        assert {e[5]["id"] for e in flows[ph]} <= ids
+    s_threads = {e[4] for e in flows["s"]}
+    t_threads = {e[4] for e in flows["t"]}
+    assert not (s_threads & t_threads), "flows never crossed a thread"
+    # the acceptance criterion: the export WITH flow events validates
+    trace.validate_chrome_trace(trace.export_chrome_trace())
+    exported = trace.export_chrome_trace()["traceEvents"]
+    assert any(e["ph"] == "s" and e.get("id") for e in exported)
+
+
+def test_tail_biased_sampling(monkeypatch):
+    monkeypatch.setenv("OTPU_TRACE_SAMPLE", "0")
+    monkeypatch.setenv("OTPU_TRACE_SLOW_MS", "50")
+    trace.clear()
+    # fast-OK: dropped
+    with trace_scope("serve", sample=True):
+        with trace.span("serve", kind="fast"):
+            pass
+    assert not [e for e in trace.events() if e[0] == "X"]
+    # erroring: retained whole
+    with pytest.raises(RuntimeError):
+        with trace_scope("serve", sample=True) as ctx:
+            err_id = ctx.trace_id
+            with trace.span("serve", kind="err"):
+                raise RuntimeError("boom")
+    assert [e for e in trace.events() if e[0] == "X" and e[6] == err_id]
+    # slow: retained
+    with trace_scope("serve", sample=True) as ctx:
+        slow_id = ctx.trace_id
+        with trace.span("serve", kind="slow"):
+            time.sleep(0.06)
+    assert [e for e in trace.events() if e[0] == "X" and e[6] == slow_id]
+    # rate 1.0 records everything again
+    monkeypatch.setenv("OTPU_TRACE_SAMPLE", "1.0")
+    trace.clear()
+    with trace_scope("serve", sample=True) as ctx:
+        with trace.span("serve", kind="fast"):
+            pass
+    assert [e for e in trace.events() if e[0] == "X"]
+
+
+# ------------------------------------------------------ flight recorder
+def test_manual_dump_bundle_schema(flight_dir):
+    trace.clear()
+    with trace.span("fit", estimator="X"):
+        trace.instant("retry", cause="source")
+        path = flight.dump("schema_test")
+    assert path and os.path.dirname(path) == flight_dir
+    with open(path) as f:
+        b = json.load(f)
+    assert b["flight_schema"] == flight.FLIGHT_SCHEMA_VERSION
+    assert b["reason"] == "schema_test"
+    for key in ("events", "open_spans", "slow_traces", "registry",
+                "knobs", "stacks", "breakers", "brownout_level"):
+        assert key in b, key
+    # dumped INSIDE the fit span: it is open, so it shows in open_spans
+    assert any(s["name"] == "fit" for s in b["open_spans"])
+    assert any(e["name"] == "retry" for e in b["events"])
+    # stacks include THIS thread by name
+    me = threading.current_thread().name
+    assert any(me in k for k in b["stacks"])
+    # the resolved knob table reflects what the process runs under
+    assert b["knobs"]["OTPU_FLIGHT_DIR"] == flight_dir
+
+
+def test_dump_races_span_recording_and_registry_ticks(flight_dir):
+    """The satellite concurrency claim: dumps racing active span
+    recording and registry ticks always produce valid JSON bundles."""
+    from orange3_spark_tpu.obs.registry import REGISTRY
+
+    c = REGISTRY.counter("otpu_flight_race_test_total", "test")
+    try:
+        stop = threading.Event()
+
+        def hammer():
+            i = 0
+            while not stop.is_set():
+                with trace.span("race", i=i):
+                    c.inc()
+                trace.instant("race_tick", i=i)
+                i += 1
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            paths = [flight.dump(f"race_{i}") for i in range(5)]
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        for p in paths:
+            with open(p) as f:
+                b = json.load(f)       # every bundle parses
+            assert b["flight_schema"] == flight.FLIGHT_SCHEMA_VERSION
+            assert b["events"] is not None and b["registry"]
+    finally:
+        c.reset()
+
+
+def test_auto_dump_rate_limit_and_retention(flight_dir, monkeypatch):
+    monkeypatch.setenv("OTPU_FLIGHT_RATE_S", "3600")
+    assert flight.auto_dump("first") is not None
+    assert flight.auto_dump("suppressed") is None     # inside the window
+    monkeypatch.setenv("OTPU_FLIGHT_RATE_S", "0")
+    assert flight.auto_dump("third") is not None      # window elapsed
+    # retention: MAX bundles kept, oldest deleted
+    monkeypatch.setenv("OTPU_FLIGHT_MAX", "2")
+    for i in range(3):
+        time.sleep(0.002)      # distinct ns timestamps -> stable sort
+        flight.dump(f"retain_{i}")
+    names = [os.path.basename(p) for p in _bundles(flight_dir)]
+    assert len(names) == 2, names
+    assert names[-1].endswith("retain_2.json")
+
+
+def test_flight_kill_switches(flight_dir, monkeypatch):
+    monkeypatch.setenv("OTPU_FLIGHT", "0")
+    assert flight.dump("nope") is None
+    assert flight.auto_dump("nope") is None
+    assert _bundles(flight_dir) == []
+    monkeypatch.setenv("OTPU_FLIGHT", "1")
+    monkeypatch.setenv("OTPU_OBS", "0")
+    trace.refresh()
+    try:
+        assert flight.dump("nope") is None   # obs master switch wins
+    finally:
+        monkeypatch.setenv("OTPU_OBS", "1")
+        trace.refresh()
+    assert _bundles(flight_dir) == []
+
+
+def test_wedged_dispatch_drill_auto_writes_bundle(
+        session, flight_dir, monkeypatch):
+    """The ISSUE-9 acceptance drill, end to end: an injected wedge under
+    a watchdog budget auto-writes a bundle whose spans include the
+    wedged dispatch WITH its trace id and whose stacks include the
+    abandoned waiter thread."""
+    from orange3_spark_tpu.resilience import DispatchWedgedError
+    from orange3_spark_tpu.resilience.overload import reset_wedge_breaker
+
+    monkeypatch.setenv("OTPU_DISPATCH_BUDGET_S", "0.2")
+    reset_wedge_breaker()
+    trace.clear()
+    with pytest.raises(DispatchWedgedError) as exc:
+        _fit(session, chunks=20, epochs=1,
+             fault_spec="wedge:at=1,hold_s=2")
+    err = exc.value
+    assert err.trace_id and err.trace_id.startswith("fit-")
+    bundles = [p for p in _bundles(flight_dir) if "dispatch_wedged" in p]
+    assert bundles, "wedge did not auto-write a flight bundle"
+    with open(bundles[-1]) as f:
+        b = json.load(f)
+    assert b["reason"] == "dispatch_wedged"
+    assert b["error"]["type"] == "DispatchWedgedError"
+    assert b["trace_id"] == err.trace_id
+    # the wedged dispatch span was still OPEN at dump time, with the id
+    assert any(s["name"] == "dispatch" and s["trace_id"] == err.trace_id
+               for s in b["open_spans"]), b["open_spans"]
+    # the abandoned waiter thread is parked in the runtime — its stack
+    # is the evidence the watchdog exists to preserve
+    assert any("otpu-dispatch-waiter" in k for k in b["stacks"]), \
+        list(b["stacks"])
+    reset_wedge_breaker()
+
+
+def test_spill_corruption_auto_writes_bundle(
+        session, flight_dir, tmp_path):
+    """The fourth anomaly: a CRC-failing spill record dumps the black
+    box (with the typed error) before the raise unwinds the replay."""
+    import warnings
+
+    from orange3_spark_tpu.io.codec import SpillCorruptionError
+    from orange3_spark_tpu.io.streaming import (
+        StreamingLinearEstimator, array_chunk_source,
+    )
+    from orange3_spark_tpu.resilience import inject_faults
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((2048, 8)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    src = array_chunk_source(X, y, chunk_rows=512)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with inject_faults("spill_corrupt:record=1,mode=flip"):
+            with pytest.raises(SpillCorruptionError):
+                StreamingLinearEstimator(
+                    loss="logistic", epochs=2, chunk_rows=512,
+                ).fit_stream(src, n_features=8, session=session,
+                             cache_device=True, cache_device_bytes=1,
+                             cache_spill_dir=str(tmp_path / "spill"))
+    bundles = [p for p in _bundles(flight_dir)
+               if "spill_corruption" in p]
+    assert bundles, "CRC failure did not auto-write a flight bundle"
+    with open(bundles[-1]) as f:
+        b = json.load(f)
+    assert b["error"]["type"] == "SpillCorruptionError"
+    assert "record 1" in b["error"]["message"]
+
+
+def test_debug_endpoints_serve_flight_and_stacks(
+        session, flight_dir, monkeypatch):
+    from orange3_spark_tpu.serve import BucketLadder, ServingContext
+
+    monkeypatch.setenv("OTPU_OBS_PORT", "0")
+    ctx = ServingContext(BucketLadder(min_bucket=64, max_bucket=512))
+    with ctx:
+        url = ctx._telemetry.url
+        with urllib.request.urlopen(url + "/debug/stacks", timeout=5) as r:
+            stacks = json.loads(r.read())
+        assert stacks["stacks"] and "open_spans" in stacks
+        with urllib.request.urlopen(url + "/debug/flight", timeout=5) as r:
+            b = json.loads(r.read())
+        assert b["flight_schema"] == flight.FLIGHT_SCHEMA_VERSION
+        assert b["reason"] == "debug_endpoint"
+        assert b["path"] and os.path.exists(b["path"])
+        # manual context dump too
+        p = ctx.dump_flight()
+        assert p and os.path.exists(p)
+    # context report links into the ring
+    rep = ctx.report()
+    assert "slow_traces" in rep
+
+
+def test_flight_view_renders_a_bundle(flight_dir):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from flight_view import render
+    finally:
+        sys.path.pop(0)
+    trace.clear()
+    with trace.span("fit"):
+        path = flight.dump("view_test")
+    with open(path) as f:
+        text = render(json.load(f))
+    assert "view_test" in text
+    assert "flight bundle" in text
+    assert "thread stacks" in text
+
+
+def test_obs_dump_tool_flight_flag(session, flight_dir, tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from obs_dump import run_dump
+    finally:
+        sys.path.pop(0)
+    out = run_dump(rows=2048, session=session,
+                   trace_out=str(tmp_path / "t.json"), flight=True)
+    assert out["flight_path"] and os.path.exists(out["flight_path"])
+    assert out["flight_valid"] is True
+
+
+# ----------------------------------------------------- doc-drift guard
+_METRIC_REG = re.compile(
+    r'REGISTRY\.\s*(?:counter|gauge|histogram)\(\s*"(otpu_[a-z0-9_]+)"')
+_DOC_ROW = re.compile(r"^\|\s*`(otpu_[a-z0-9_]+)`\s*\|")
+
+
+def test_metrics_catalog_doc_drift():
+    """Every registry-registered otpu_* metric appears in the docs
+    metrics catalog, and every catalog row names a metric the source
+    still registers — the knob source-grep test's spirit, for metrics."""
+    registered = set()
+    pkg = os.path.join(REPO, "orange3_spark_tpu")
+    for dirpath, _dirs, names in os.walk(pkg):
+        if "__pycache__" in dirpath:
+            continue
+        for n in names:
+            if not n.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, n), encoding="utf-8") as f:
+                registered.update(_METRIC_REG.findall(f.read()))
+    assert registered, "metric grep found nothing — pattern rotted?"
+    documented = set()
+    with open(os.path.join(REPO, "docs", "observability.md"),
+              encoding="utf-8") as f:
+        for line in f:
+            m = _DOC_ROW.match(line.strip())
+            if m:
+                documented.add(m.group(1))
+    missing_from_docs = registered - documented
+    assert not missing_from_docs, (
+        f"metrics registered in source but missing from the docs "
+        f"catalog (docs/observability.md): {sorted(missing_from_docs)}")
+    stale_in_docs = documented - registered
+    assert not stale_in_docs, (
+        f"docs catalog rows naming metrics no longer registered: "
+        f"{sorted(stale_in_docs)}")

@@ -1,0 +1,138 @@
+"""One-shot flight-bundle viewer — a post-mortem without a notebook.
+
+Renders an anomaly flight bundle (obs/flight.py) as a readable report:
+the anomaly line, the trace it killed, each thread's open spans and
+Python stack tail, the control-plane state (breakers, queue depths,
+brownout), non-default knobs, and the slowest traces in the ring at dump
+time.
+
+Usage:
+    python tools/flight_view.py /tmp/otpu_flight/flight-<ns>-<reason>.json
+    python tools/flight_view.py --latest [--dir /tmp/otpu_flight]
+
+Importable: ``render(bundle) -> str`` (the tier-1 smoke calls it on a
+freshly-dumped bundle).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _tree_lines(node: dict, depth: int = 0, out: list | None = None) -> list:
+    if out is None:
+        out = []
+    args = node.get("args") or {}
+    arg_s = (" " + ", ".join(f"{k}={v}" for k, v in args.items())
+             if args else "")
+    out.append(f"{'  ' * depth}{node['name']} "
+               f"{node['dur_ms']:.3f}ms{arg_s}")
+    for child in node.get("children", ()):
+        _tree_lines(child, depth + 1, out)
+    if node.get("truncated"):
+        out.append(f"{'  ' * (depth + 1)}... {node['truncated']} more")
+    return out
+
+
+def render(bundle: dict, *, stack_tail: int = 6) -> str:
+    """Human-readable report of one flight bundle."""
+    lines = []
+    err = bundle.get("error") or {}
+    lines.append(f"== flight bundle (schema {bundle.get('flight_schema')}) "
+                 f"pid {bundle.get('pid')} ==")
+    lines.append(f"reason:   {bundle.get('reason')}")
+    if err:
+        lines.append(f"error:    {err.get('type')}: "
+                     f"{str(err.get('message'))[:200]}")
+    lines.append(f"trace_id: {bundle.get('trace_id')}")
+    lines.append(f"control:  brownout={bundle.get('brownout_level')} "
+                 f"sheds={bundle.get('sheds')} "
+                 f"mb_queue={bundle.get('mb_queue_depth')} "
+                 f"admission={bundle.get('admission')}")
+    breakers = bundle.get("breakers") or {}
+    if breakers:
+        lines.append("breakers: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(breakers.items())))
+    open_spans = bundle.get("open_spans") or []
+    if open_spans:
+        lines.append("-- open spans (what each thread was inside) --")
+        for s in open_spans:
+            lines.append(f"  [{s['thread']}] {s['name']} "
+                         f"open {s['age_ms']:.1f}ms "
+                         f"trace={s.get('trace_id')}")
+    slow = bundle.get("slow_traces") or []
+    if slow:
+        lines.append("-- slowest traces --")
+        for t in slow:
+            lines.append(f"  {t['trace_id']}  {t['dur_ms']:.3f}ms  "
+                         f"({t['n_spans']} spans)")
+            lines.extend("    " + ln for ln in _tree_lines(t["tree"]))
+    stacks = bundle.get("stacks") or {}
+    if stacks:
+        lines.append("-- thread stacks (tails) --")
+        for name, frames in sorted(stacks.items()):
+            lines.append(f"  {name}:")
+            lines.extend(f"    {ln}" for ln in frames[-stack_tail:])
+    knobs = bundle.get("knobs") or {}
+    if knobs:
+        from orange3_spark_tpu.utils.knobs import KNOBS
+
+        non_default = {
+            k: v for k, v in sorted(knobs.items())
+            if k in KNOBS and _differs(KNOBS[k], v)
+        }
+        lines.append(f"-- knobs ({len(non_default)} non-default) --")
+        for k, v in non_default.items():
+            lines.append(f"  {k} = {v!r} (default {KNOBS[k].default!r})")
+    n_events = len(bundle.get("events") or [])
+    lines.append(f"-- {n_events} ring events in bundle "
+                 f"(export with tools/obs_dump.py for Perfetto) --")
+    return "\n".join(lines)
+
+
+def _differs(knob, value) -> bool:
+    d = knob.default
+    if knob.type == "flag":
+        return value is not (str(d) != "0")
+    if knob.type == "marker":
+        return value is not None
+    return value != d
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("bundle", nargs="?", help="path to a flight-*.json")
+    ap.add_argument("--latest", action="store_true",
+                    help="render the newest bundle in --dir")
+    ap.add_argument("--dir", default=None,
+                    help="bundle directory (default: OTPU_FLIGHT_DIR)")
+    args = ap.parse_args()
+    path = args.bundle
+    if path is None:
+        if not args.latest:
+            ap.error("give a bundle path or --latest")
+        from orange3_spark_tpu.utils import knobs as _knobs
+
+        directory = args.dir or _knobs.get_str("OTPU_FLIGHT_DIR")
+        names = sorted(n for n in os.listdir(directory)
+                       if n.startswith("flight-") and n.endswith(".json")
+                       ) if os.path.isdir(directory) else []
+        if not names:
+            print(f"no flight bundles in {directory}", file=sys.stderr)
+            return 1
+        path = os.path.join(directory, names[-1])
+    with open(path) as f:
+        bundle = json.load(f)
+    print(render(bundle))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

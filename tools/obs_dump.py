@@ -16,8 +16,13 @@ trace, and a parseable export — all in a few seconds on CPU.
 Importable: ``run_dump(rows=..., session=...)`` returns the summary dict
 (the not-slow smoke test in tests/test_obs.py calls it directly).
 
+``--flight`` additionally exercises the anomaly flight recorder: a
+manual ``obs.flight.dump()`` after the fit+serve window, the bundle
+re-read and schema-checked, its path in the summary line.
+
 Usage:
     python tools/obs_dump.py [--rows 8192] [--trace-out /tmp/otpu_trace.json]
+                             [--flight]
 """
 
 from __future__ import annotations
@@ -33,7 +38,8 @@ if REPO not in sys.path:
 
 
 def run_dump(rows: int = 8192, session=None,
-             trace_out: str | None = None) -> dict:
+             trace_out: str | None = None,
+             flight: bool = False) -> dict:
     import numpy as np
 
     from orange3_spark_tpu.core.session import TpuSession
@@ -82,6 +88,18 @@ def run_dump(rows: int = 8192, session=None,
     # under OTPU_OBS=0 there are no spans and no run report — the tool
     # still dumps the registry (live by design) instead of crashing
     fit_report = getattr(model, "run_report_", None)
+    flight_path = flight_valid = None
+    if flight:
+        from orange3_spark_tpu.obs import flight as _flight
+
+        flight_path = _flight.dump("obs_dump_smoke")
+        if flight_path is not None:      # None under the kill-switches
+            with open(flight_path) as f:
+                bundle = json.load(f)     # bundle must be valid JSON
+            flight_valid = (
+                bundle.get("flight_schema") == _flight.FLIGHT_SCHEMA_VERSION
+                and bool(bundle.get("stacks"))
+                and "registry" in bundle and "knobs" in bundle)
     return {
         "metric": "obs_dump",
         "rows": rows,
@@ -92,6 +110,8 @@ def run_dump(rows: int = 8192, session=None,
         "span_names": span_names,
         "trace_valid": True,
         "trace_path": trace_out,
+        "flight_path": flight_path,
+        "flight_valid": flight_valid,
         "snapshot_metrics": len(snapshot),
         "snapshot": snapshot,
     }
@@ -101,8 +121,11 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=8192)
     ap.add_argument("--trace-out", default="/tmp/otpu_trace.json")
+    ap.add_argument("--flight", action="store_true",
+                    help="also exercise a manual flight-recorder dump")
     args = ap.parse_args()
-    out = run_dump(rows=args.rows, trace_out=args.trace_out)
+    out = run_dump(rows=args.rows, trace_out=args.trace_out,
+                   flight=args.flight)
     print("== metrics snapshot ==")
     print(json.dumps(out["snapshot"], indent=2))
     print(f"== trace: {out['trace_events']} events "
