@@ -1,0 +1,409 @@
+"""Health-aware hedged routing over the replica pool.
+
+The front-end half of the fleet: one ``FleetRouter`` holds an endpoint
+table (stable ids + ports from the supervisor), polls each replica's
+``/readyz``, and routes every predict with the four tail-tolerance
+mechanics every serving system converges on (Dean & Barroso, "The Tail
+at Scale"):
+
+* **least-inflight selection** — among ready, admitted, non-open-breaker
+  replicas, the one with the fewest of THIS router's requests currently
+  outstanding (ties break on the lowest id, so tests pin exact choices);
+* **per-replica circuit breakers** — connect failures / read deadlines /
+  HTTP 5xx feed a ``resilience.overload.CircuitBreaker`` per endpoint:
+  a dead replica stops costing connect timeouts (open = excluded), and
+  a restarted one re-admits itself through the half-open probe;
+* **retry-with-replica-exclusion** — predicts are idempotent, so a
+  failed attempt retries on a *different* replica (the failed one
+  excluded for this request) until the pool is exhausted, at which point
+  the LAST typed error (or ``NoReplicaAvailableError``) surfaces;
+* **deterministic tail hedging** — a second copy of the request is
+  issued to a different replica once the primary has been outstanding
+  longer than the hedge delay: ``max(OTPU_FLEET_HEDGE_MS, EWMA-p95)``
+  where the p95 estimate is ``ewma_mean + z(OTPU_FLEET_HEDGE_PCTL) *
+  ewma_std`` over observed request latencies (:class:`HedgeSchedule` —
+  pure arithmetic, pinned on a fake clock in tests/test_fleet.py).
+  First response wins; the loser is cancelled by closing its connection.
+
+Every mechanism ticks an ``otpu_fleet_*`` registry metric
+(docs/observability.md catalog), and every request carries a
+router-minted trace id that the replica adopts and echoes —
+``otpu_fleet_trace_propagated_total / otpu_fleet_requests_total`` is the
+cross-process trace-coverage ratio the fleet bench pins at 1.0.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import statistics
+import threading
+import time
+
+import numpy as np
+
+from orange3_spark_tpu.fleet.rpc import (
+    TRACE_HEADER,
+    FleetClient,
+    NoReplicaAvailableError,
+    ReplicaDrainingError,
+    ReplicaUnavailableError,
+)
+from orange3_spark_tpu.obs.context import new_trace_id
+from orange3_spark_tpu.obs.registry import REGISTRY
+from orange3_spark_tpu.resilience.overload import CircuitBreaker
+from orange3_spark_tpu.utils import knobs
+
+__all__ = ["FleetRouter", "HedgeSchedule", "ReplicaEndpoint"]
+
+_M_REQS = REGISTRY.counter(
+    "otpu_fleet_requests_total", "predicts entering the fleet router")
+_M_HEDGES = REGISTRY.counter(
+    "otpu_fleet_hedges_total",
+    "hedge copies issued after the tail-hedging delay")
+_M_HEDGE_WINS = REGISTRY.counter(
+    "otpu_fleet_hedge_wins_total",
+    "requests whose hedge copy answered before the primary")
+_M_FAILOVERS = REGISTRY.counter(
+    "otpu_fleet_failovers_total",
+    "attempts retried on a different replica, by reason")
+_M_INFLIGHT = REGISTRY.gauge(
+    "otpu_fleet_inflight",
+    "router requests outstanding per replica")
+_M_PROPAGATED = REGISTRY.counter(
+    "otpu_fleet_trace_propagated_total",
+    "responses whose replica echoed the router-minted trace id")
+
+
+class HedgeSchedule:
+    """The deterministic tail-hedging delay: ``max(floor, EWMA-p95)``.
+
+    Latency observations feed an exponentially-weighted mean/variance
+    pair (West's EWMA update); the p-th percentile estimate is the
+    normal-tail read-off ``mean + z(p) * std``. Everything is pure
+    arithmetic on the observed values — no wall clock, no randomness —
+    so tests pin exact delays, and two routers fed the same latency
+    stream hedge identically."""
+
+    def __init__(self, *, floor_ms: float | None = None,
+                 pctl: float | None = None, alpha: float = 0.2):
+        self.floor_s = float(
+            floor_ms if floor_ms is not None
+            else knobs.get_float("OTPU_FLEET_HEDGE_MS")) / 1e3
+        self.pctl = float(pctl if pctl is not None
+                          else knobs.get_float("OTPU_FLEET_HEDGE_PCTL"))
+        self.alpha = alpha
+        self._z = statistics.NormalDist().inv_cdf(
+            min(max(self.pctl / 100.0, 0.5), 0.9999))
+        self._lock = threading.Lock()
+        self._n = 0
+        self._mean = 0.0
+        self._var = 0.0
+
+    def observe(self, dt_s: float) -> None:
+        """Fold one completed request's wall seconds into the EWMA."""
+        with self._lock:
+            if self._n == 0:
+                self._mean, self._var = float(dt_s), 0.0
+            else:
+                d = float(dt_s) - self._mean
+                incr = self.alpha * d
+                self._mean += incr
+                self._var = (1.0 - self.alpha) * (self._var + d * incr)
+            self._n += 1
+
+    def p_estimate_s(self) -> float:
+        """The EWMA-p95 (well, p-``pctl``) latency estimate; 0 before
+        the first observation."""
+        with self._lock:
+            if self._n == 0:
+                return 0.0
+            return self._mean + self._z * self._var ** 0.5
+
+    def hedge_delay_s(self) -> float:
+        return max(self.floor_s, self.p_estimate_s())
+
+
+class _HedgeCancelled(Exception):
+    """Internal: this request's connection was closed ON PURPOSE because
+    the other hedge copy won — never a replica failure."""
+
+
+class ReplicaEndpoint:
+    """One replica as the router sees it: client + breaker + live state."""
+
+    def __init__(self, replica_id: int, host: str, port: int, *,
+                 client=None, breaker: CircuitBreaker | None = None):
+        self.replica_id = replica_id
+        self.name = f"replica-{replica_id}"
+        self.client = client or FleetClient(host, port, name=self.name)
+        self.breaker = breaker or CircuitBreaker(f"fleet:{self.name}")
+        self.inflight = 0
+        self.ready = False             # last /readyz verdict (or success)
+        self.draining = False
+        self.admitted = True           # rollout's per-replica gate
+        self.version: str | None = None
+
+    def state(self) -> str:
+        if not self.admitted:
+            return "held"
+        if self.draining:
+            return "draining"
+        if self.breaker.state() == "open":
+            return "open"
+        return "ready" if self.ready else "unready"
+
+
+class FleetRouter:
+    """See module docstring. ``endpoints`` is a list of ``(id, host,
+    port)`` (``ReplicaManager.endpoints()``) or prebuilt
+    :class:`ReplicaEndpoint` objects (tests inject fake clients that
+    way). ``hedging=False`` disables the tail hedge (the bench's
+    unhedged A/B arm); ``client_factory`` builds clients for tuple
+    endpoints."""
+
+    def __init__(self, endpoints, *, hedging: bool = True,
+                 schedule: HedgeSchedule | None = None,
+                 health_poll_s: float = 0.25,
+                 client_factory=None):
+        factory = client_factory or (
+            lambda host, port, name: FleetClient(host, port, name=name))
+        self.endpoints: list[ReplicaEndpoint] = []
+        for ep in endpoints:
+            if isinstance(ep, ReplicaEndpoint):
+                self.endpoints.append(ep)
+            else:
+                rid, host, port = ep
+                self.endpoints.append(ReplicaEndpoint(
+                    rid, host, port,
+                    client=factory(host, port, f"replica-{rid}")))
+        self.hedging = hedging
+        self.schedule = schedule or HedgeSchedule()
+        self.health_poll_s = health_poll_s
+        self._lock = threading.Lock()
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(4, 4 * len(self.endpoints)),
+            thread_name_prefix="otpu-fleet-router")
+        self._poller: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------- health
+    def refresh(self, timeout_s: float = 0.5) -> dict[int, bool]:
+        """One synchronous /readyz sweep (tests, rollout, cold start)."""
+        out = {}
+        for ep in self.endpoints:
+            ok, body = ep.client.ready(timeout_s=timeout_s)
+            ep.ready = ok
+            ep.draining = bool(body.get("draining"))
+            if body.get("version"):
+                ep.version = body["version"]
+            out[ep.replica_id] = ok
+        return out
+
+    def start_health_poller(self) -> "FleetRouter":
+        if self._poller is None:
+            self._stop.clear()
+            self._poller = threading.Thread(
+                target=self._poll_loop, daemon=True,
+                name="otpu-fleet-health")
+            self._poller.start()
+        return self
+
+    def _poll_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.refresh()
+            except Exception:  # noqa: BLE001 - polling must never die
+                pass
+            self._stop.wait(self.health_poll_s)
+
+    def set_admitted(self, replica_id: int, admitted: bool) -> None:
+        """The rollout's per-replica traffic gate (drain one, roll it,
+        re-admit it)."""
+        for ep in self.endpoints:
+            if ep.replica_id == replica_id:
+                ep.admitted = bool(admitted)
+                return
+        raise KeyError(replica_id)
+
+    def endpoint(self, replica_id: int) -> ReplicaEndpoint:
+        for ep in self.endpoints:
+            if ep.replica_id == replica_id:
+                return ep
+        raise KeyError(replica_id)
+
+    def states(self) -> dict[str, str]:
+        return {ep.name: ep.state() for ep in self.endpoints}
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._poller is not None:
+            self._poller.join(timeout=2.0)
+            self._poller = None
+        self._pool.shutdown(wait=False)
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ----------------------------------------------------------- selection
+    def _pick(self, excluded: set) -> ReplicaEndpoint | None:
+        """Least-inflight over ready+admitted+breaker-allowed replicas;
+        falls back to unpolled-but-admitted ones (cold start) before
+        giving up. ``allow()`` is consulted LAST and only on the chosen
+        endpoint — it consumes the half-open probe slot."""
+        with self._lock:
+            ranked = sorted(
+                (ep for ep in self.endpoints
+                 if ep.replica_id not in excluded and ep.admitted
+                 and not ep.draining
+                 and ep.breaker.state() != "open"),
+                key=lambda ep: (not ep.ready, ep.inflight, ep.replica_id))
+        for ep in ranked:
+            if ep.breaker.allow():
+                return ep
+        return None
+
+    # ------------------------------------------------------------- calling
+    def _call(self, ep: ReplicaEndpoint, X, trace_id: str,
+              timeout_s: float | None, conn_slot: list | None = None,
+              cancel_event: threading.Event | None = None):
+        with self._lock:
+            ep.inflight += 1
+            _M_INFLIGHT.set(ep.inflight, replica=ep.name)
+        t0 = time.perf_counter()
+        try:
+            out, headers = ep.client.predict(
+                X, trace_id=trace_id, timeout_s=timeout_s,
+                conn_slot=conn_slot)
+        except ReplicaDrainingError:
+            # graceful refusal: not a breaker failure — the replica is
+            # healthy, it just wants no NEW work; stop routing to it
+            # until /readyz clears the drain flag
+            with self._lock:
+                ep.draining = True
+                ep.ready = False
+            raise
+        except ReplicaUnavailableError:
+            if cancel_event is not None and cancel_event.is_set():
+                # WE closed this connection because the other hedge copy
+                # won — the replica did nothing wrong; poisoning its
+                # breaker here would open healthy replicas under exactly
+                # the load hedging exists to absorb
+                raise _HedgeCancelled from None
+            ep.breaker.record_failure()
+            with self._lock:
+                ep.ready = False
+            raise
+        finally:
+            with self._lock:
+                ep.inflight -= 1
+                _M_INFLIGHT.set(ep.inflight, replica=ep.name)
+        dt = time.perf_counter() - t0
+        self.schedule.observe(dt)
+        ep.breaker.record_success()
+        with self._lock:
+            ep.ready = True
+            if headers.get("X-OTPU-Version"):
+                ep.version = headers["X-OTPU-Version"]
+        if headers.get(TRACE_HEADER) == trace_id:
+            # the replica's serving path carried OUR id end-to-end — the
+            # cross-process propagation the fleet bench pins at 1.0
+            _M_PROPAGATED.inc()
+        return np.asarray(out)
+
+    def _hedged_call(self, primary: ReplicaEndpoint, X, trace_id: str,
+                     timeout_s: float | None, excluded: set):
+        """Primary + (after the hedge delay) one hedge to a different
+        replica; first success wins, the loser's connection is closed.
+        Raises only when BOTH copies failed (primary's error surfaces;
+        both replicas land in ``excluded`` for the outer failover
+        loop)."""
+        slots: dict = {}
+        cancels: dict = {}
+
+        def run(ep):
+            slot: list = []
+            slots[ep.replica_id] = slot
+            cancels[ep.replica_id] = cancel = threading.Event()
+            return self._call(ep, X, trace_id, timeout_s, conn_slot=slot,
+                              cancel_event=cancel)
+
+        futs = {self._pool.submit(run, primary): primary}
+        done, _ = concurrent.futures.wait(
+            futs, timeout=self.schedule.hedge_delay_s())
+        hedge = None
+        if not done:
+            hedge = self._pick(excluded | {primary.replica_id})
+            if hedge is not None:
+                _M_HEDGES.inc()
+                futs[self._pool.submit(run, hedge)] = hedge
+        errors: dict = {}
+        pending = set(futs)
+        while pending:
+            done, pending = concurrent.futures.wait(
+                pending,
+                return_when=concurrent.futures.FIRST_COMPLETED)
+            for fut in done:
+                ep = futs[fut]
+                try:
+                    out = fut.result()
+                except (ReplicaUnavailableError,
+                        ReplicaDrainingError) as e:
+                    errors[ep.replica_id] = e
+                    continue
+                # winner: cancel the loser — mark it cancelled FIRST so
+                # its _call classifies the forced close as _HedgeCancelled
+                # (never a breaker failure), then close its socket
+                for lf, lep in futs.items():
+                    if lf is not fut and not lf.done():
+                        ev = cancels.get(lep.replica_id)
+                        if ev is not None:
+                            ev.set()
+                        for conn in slots.get(lep.replica_id, ()):
+                            try:
+                                conn.close()
+                            except Exception:  # noqa: BLE001
+                                pass
+                        lf.cancel()
+                if hedge is not None and ep is hedge:
+                    _M_HEDGE_WINS.inc()
+                return out
+        # both copies failed: exclude both, surface the primary's error
+        excluded.update(errors)
+        raise errors.get(primary.replica_id,
+                         next(iter(errors.values())))
+
+    # ------------------------------------------------------------- predict
+    def predict(self, X, *, deadline_s: float | None = None,
+                hedge: bool | None = None) -> np.ndarray:
+        """Route one idempotent predict through the fleet. Typed errors
+        only: ``ReplicaUnavailableError`` when every failover attempt
+        failed, ``NoReplicaAvailableError`` when there was nowhere to
+        send it — never a hang (every wait is deadline-bounded)."""
+        trace_id = new_trace_id("fleet")
+        _M_REQS.inc()
+        use_hedge = self.hedging if hedge is None else hedge
+        excluded: set = set()
+        last_err: Exception | None = None
+        for _attempt in range(max(2 * len(self.endpoints), 2)):
+            ep = self._pick(excluded)
+            if ep is None:
+                break
+            try:
+                if use_hedge and len(self.endpoints) > 1:
+                    return self._hedged_call(ep, X, trace_id, deadline_s,
+                                             excluded)
+                return self._call(ep, X, trace_id, deadline_s)
+            except ReplicaDrainingError as e:
+                _M_FAILOVERS.inc(1, reason="draining")
+                excluded.add(ep.replica_id)
+                last_err = e
+            except ReplicaUnavailableError as e:
+                _M_FAILOVERS.inc(1, reason=e.reason)
+                excluded.add(ep.replica_id)
+                last_err = e
+        if last_err is not None:
+            raise last_err
+        raise NoReplicaAvailableError(self.states(), trace_id=trace_id)

@@ -1,0 +1,418 @@
+"""Fleet RPC — the stdlib inference wire between router and replica.
+
+A replica is one Python process holding an activated ``ServingContext``
+(serve/context.py) behind a ``ThreadingHTTPServer`` — the data-plane
+sibling of the telemetry listener in obs/server.py. Wire format is
+binary npy (``np.save``/``np.load`` over the request/response body):
+zero dependencies, exact dtypes, and no JSON float round-trip on the
+hot path.
+
+Routes (loopback only, like the obs listener — exposure beyond the host
+is a reverse proxy's job):
+
+* ``POST /predict``  — body: one npy array of raw feature rows; response:
+  the npy prediction vector. The router-minted trace id rides the
+  ``X-OTPU-Trace`` header and is ADOPTED into obs/context.py
+  (:func:`~orange3_spark_tpu.obs.context.propagated_scope`), so one trace
+  spans router → replica → device dispatch across the process boundary;
+  the response echoes the id the serving path actually carried (the
+  router's cross-process coverage measurement) plus the serving model
+  version (``X-OTPU-Version``). A draining replica answers 503 with a
+  typed ``ReplicaDrainingError`` payload instead of accepting work.
+* ``GET /readyz`` / ``GET /healthz`` / ``GET /metrics`` — the obs
+  server's readiness/liveness/exposition bodies served off the data
+  port, so a router needs ONE address per replica.
+* ``POST /drain``    — the loopback drain hook (same path as SIGTERM):
+  finish in-flight work up to ``OTPU_DRAIN_S``, then exit 0.
+* ``POST /reload``   — zero-downtime rollout hook (fleet/rollout.py):
+  load the named published version into the standby model via the
+  existing ``load_state_pytree`` hot-reload keying, warm it, flip
+  atomically; 200 with the new version or 500 with the failure (the
+  old version keeps serving — reload is all-or-nothing per replica).
+
+The client half (:class:`FleetClient`) opens one connection per request
+(hedging cancels a loser by closing its connection), maps connect/read
+deadlines onto socket timeouts — an ambient
+:func:`~orange3_spark_tpu.resilience.overload.request_deadline` scope
+outranks the ``OTPU_FLEET_TIMEOUT_S`` default — and converts transport
+failures into the typed errors the router's failover logic classifies.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import math
+import socket
+import threading
+from http.client import HTTPConnection, HTTPException
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from orange3_spark_tpu.obs.registry import REGISTRY
+from orange3_spark_tpu.utils import knobs
+
+__all__ = [
+    "FleetClient",
+    "NoReplicaAvailableError",
+    "ReplicaDrainingError",
+    "ReplicaServer",
+    "ReplicaUnavailableError",
+    "drain_budget_s",
+]
+
+NPY_CONTENT_TYPE = "application/x-npy"
+TRACE_HEADER = "X-OTPU-Trace"
+VERSION_HEADER = "X-OTPU-Version"
+
+_M_RPC = REGISTRY.counter(
+    "otpu_fleet_rpc_requests_total",
+    "predict RPCs served by this replica process")
+_M_DRAINED = REGISTRY.counter(
+    "otpu_fleet_drained_requests_total",
+    "predict RPCs refused with ReplicaDrainingError mid-drain")
+
+
+def drain_budget_s() -> float:
+    return float(knobs.get_float("OTPU_DRAIN_S"))
+
+
+# ------------------------------------------------------------ typed errors
+class ReplicaDrainingError(RuntimeError):
+    """A request arrived at a replica that is draining (SIGTERM or
+    ``POST /drain``): new work is refused — shed-style, typed, carrying
+    the trace id — while in-flight requests finish. The router treats it
+    as a failover signal (retry on another replica), never a breaker
+    failure: draining is *graceful*."""
+
+    def __init__(self, *, replica: str = "", trace_id: str | None = None,
+                 in_flight: int = 0):
+        self.replica = replica
+        self.trace_id = trace_id
+        self.in_flight = in_flight
+        tid = f" [trace {trace_id}]" if trace_id else ""
+        super().__init__(
+            f"replica {replica or '?'} is draining "
+            f"({in_flight} in flight){tid}; retry on another replica")
+
+
+class ReplicaUnavailableError(RuntimeError):
+    """Transport/server failure talking to one replica (connect refused,
+    connection reset mid-read, read deadline, HTTP 5xx): the router's
+    failover-with-exclusion signal, and a breaker failure for that
+    replica. Carries the failure ``reason`` the failover counter is
+    labeled with."""
+
+    def __init__(self, message: str, *, replica: str = "",
+                 reason: str = "connect", trace_id: str | None = None):
+        self.replica = replica
+        self.reason = reason
+        self.trace_id = trace_id
+        super().__init__(message)
+
+
+class NoReplicaAvailableError(RuntimeError):
+    """Every replica is excluded, open-breakered or draining — the
+    router has nowhere left to send the request. Carries the per-replica
+    state map so a production log line is self-explaining."""
+
+    def __init__(self, states: dict, *, trace_id: str | None = None):
+        self.states = dict(states)
+        self.trace_id = trace_id
+        super().__init__(
+            f"no replica available to serve the request: {self.states}")
+
+
+# ------------------------------------------------------------- npy helpers
+def dump_npy(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def load_npy(data: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+# ------------------------------------------------------------------ server
+class _ReplicaHandler(BaseHTTPRequestHandler):
+    server_version = "otpu-fleet/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):  # replica stdout is not an access log
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str,
+              headers: dict | None = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj: dict,
+                   headers: dict | None = None) -> None:
+        self._send(code, json.dumps(obj).encode(), "application/json",
+                   headers)
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
+    # ------------------------------------------------------------- routes
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        runtime = self.server._otpu_runtime
+        try:
+            route = self.path.split("?")[0]
+            if route == "/readyz":
+                from orange3_spark_tpu.obs.server import ready_body
+
+                body, ready = ready_body(runtime.serving_context)
+                body["version"] = runtime.version
+                body["replica"] = runtime.name
+                self._send_json(200 if ready else 503, body)
+            elif route == "/healthz":
+                body, healthy = runtime.health()
+                self._send_json(200 if healthy else 503, body)
+            elif route == "/metrics":
+                from orange3_spark_tpu.obs.server import PROM_CONTENT_TYPE
+
+                self._send(200, REGISTRY.to_prometheus().encode(),
+                           PROM_CONTENT_TYPE)
+            else:
+                self._send(404, b"not found: try /predict (POST), "
+                                b"/readyz, /healthz or /metrics\n",
+                           "text/plain")
+        except Exception as e:  # noqa: BLE001 - never kill the listener
+            self._oops(e)
+
+    def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        runtime = self.server._otpu_runtime
+        try:
+            route = self.path.split("?")[0]
+            if route == "/predict":
+                self._predict(runtime)
+            elif route == "/drain":
+                runtime.initiate_drain(reason="drain_endpoint")
+                self._send_json(200, {"draining": True,
+                                      "budget_s": drain_budget_s()})
+            elif route == "/reload":
+                try:
+                    spec = json.loads(self._body() or b"{}")
+                    version = runtime.reload(str(spec["version"]))
+                    self._send_json(200, {"version": version})
+                except Exception as e:  # noqa: BLE001 - typed to caller
+                    # reload is all-or-nothing: the old version is still
+                    # serving, the caller (rollout) decides to roll back
+                    self._send_json(500, {
+                        "error": type(e).__name__, "message": str(e),
+                        "version": runtime.version})
+            else:
+                self._send(404, b"not found\n", "text/plain")
+        except Exception as e:  # noqa: BLE001 - never kill the listener
+            self._oops(e)
+
+    def _predict(self, runtime) -> None:
+        from orange3_spark_tpu.obs.context import (
+            current_trace_id, propagated_scope,
+        )
+
+        trace_id = self.headers.get(TRACE_HEADER) or None
+        if runtime.draining:
+            # typed, shed-style: carries the trace id of the request it
+            # refused, and ticks the drain counter — never silently drops
+            _M_DRAINED.inc()
+            err = ReplicaDrainingError(
+                replica=runtime.name, trace_id=trace_id,
+                in_flight=runtime.in_flight)
+            self._send_json(503, {
+                "error": "ReplicaDrainingError", "message": str(err),
+                "trace_id": trace_id},
+                headers={TRACE_HEADER: trace_id or ""})
+            return
+        X = load_npy(self._body())
+        _M_RPC.inc()
+        try:
+            # adopt the router-minted trace id for the whole serving path:
+            # the serve/serve_dispatch spans under route()/served_array
+            # reuse (never shadow) this identity
+            with propagated_scope(trace_id, "serve"):
+                # echo ONLY what the serving path actually carried: under
+                # OTPU_OBS=0 nothing is adopted, and parroting the request
+                # header back would let the router count a propagation
+                # that never happened (a vacuous trace_coverage == 1.0)
+                carried = current_trace_id() or ""
+                out = runtime.predict(X)
+        except ReplicaDrainingError as e:   # drain raced the flag check
+            _M_DRAINED.inc()
+            self._send_json(503, {
+                "error": "ReplicaDrainingError", "message": str(e),
+                "trace_id": trace_id},
+                headers={TRACE_HEADER: trace_id or ""})
+            return
+        except Exception as e:  # noqa: BLE001 - typed to the caller
+            self._send_json(500, {
+                "error": type(e).__name__, "message": str(e)[:500],
+                "trace_id": trace_id},
+                headers={TRACE_HEADER: trace_id or ""})
+            return
+        self._send(200, dump_npy(np.asarray(out)), NPY_CONTENT_TYPE,
+                   headers={TRACE_HEADER: carried,
+                            VERSION_HEADER: runtime.version or ""})
+
+    def _oops(self, e: Exception) -> None:
+        try:
+            self._send(500, f"{type(e).__name__}: {e}\n".encode(),
+                       "text/plain")
+        except Exception:  # noqa: BLE001 - client went away
+            pass
+
+
+class ReplicaServer:
+    """The replica's data-plane listener. ``runtime`` is the replica's
+    serving runtime (fleet/replica.py ``ReplicaRuntime`` — anything with
+    ``predict``/``reload``/``initiate_drain``/``health`` plus the
+    ``draining``/``in_flight``/``version``/``name``/``serving_context``
+    attributes works, which is what the in-process tests stub)."""
+
+    def __init__(self, runtime, port: int = 0):
+        self.runtime = runtime
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port),
+                                          _ReplicaHandler)
+        # NOT daemonic: in-flight handler threads must finish their
+        # response before the process exits (the drain contract)
+        self._httpd.daemon_threads = False
+        self._httpd._otpu_runtime = runtime
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def serve_forever(self) -> None:
+        """Block serving requests (the replica main loop); returns after
+        :meth:`shutdown` (the drain sequence)."""
+        self._httpd.serve_forever(poll_interval=0.05)
+
+    def start_background(self) -> "ReplicaServer":
+        """Serve from a background thread (in-process tests/drills)."""
+        self._thread = threading.Thread(
+            target=self.serve_forever, daemon=True, name="otpu-fleet-rpc")
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+# ------------------------------------------------------------------ client
+def _default_timeout_s() -> float:
+    """Explicit request_deadline() scope > OTPU_FLEET_TIMEOUT_S. An
+    ``inf`` deadline (the deadline-exempt convention) maps to the knob
+    default — a socket cannot wait forever and still be cancellable."""
+    from orange3_spark_tpu.resilience.overload import _ambient_deadline_s
+
+    d = _ambient_deadline_s()
+    if d is not None and math.isfinite(d) and d > 0:
+        return float(d)
+    return float(knobs.get_float("OTPU_FLEET_TIMEOUT_S"))
+
+
+class FleetClient:
+    """One replica's client: per-request connections with connect/read
+    deadlines. ``conn_slot`` (a list) receives the live connection so a
+    hedging router can cancel a losing request by closing it."""
+
+    def __init__(self, host: str, port: int, *, name: str = ""):
+        self.host = host
+        self.port = port
+        self.name = name or f"{host}:{port}"
+
+    # ------------------------------------------------------------ plumbing
+    def _request(self, method: str, path: str, body: bytes | None,
+                 headers: dict, timeout_s: float | None,
+                 conn_slot: list | None = None):
+        timeout = timeout_s if timeout_s else _default_timeout_s()
+        conn = HTTPConnection(self.host, self.port, timeout=timeout)
+        if conn_slot is not None:
+            conn_slot.append(conn)
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, dict(resp.headers), data
+        except (ConnectionError, socket.timeout, TimeoutError, OSError,
+                HTTPException) as e:
+            reason = ("timeout" if isinstance(
+                e, (socket.timeout, TimeoutError)) else "connect")
+            raise ReplicaUnavailableError(
+                f"replica {self.name} {method} {path} failed: "
+                f"{type(e).__name__}: {e}", replica=self.name,
+                reason=reason,
+                trace_id=headers.get(TRACE_HEADER)) from e
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _raise_for_status(status: int, data: bytes, replica: str,
+                          trace_id: str | None) -> None:
+        if status < 400:
+            return
+        try:
+            err = json.loads(data)
+        except ValueError:
+            err = {}
+        if err.get("error") == "ReplicaDrainingError":
+            raise ReplicaDrainingError(replica=replica, trace_id=trace_id)
+        raise ReplicaUnavailableError(
+            f"replica {replica} answered HTTP {status}: "
+            f"{err.get('error', '')} {err.get('message', '')}".strip(),
+            replica=replica, reason=f"http_{status}", trace_id=trace_id)
+
+    # ---------------------------------------------------------- data plane
+    def predict(self, X: np.ndarray, *, trace_id: str | None = None,
+                timeout_s: float | None = None,
+                conn_slot: list | None = None,
+                ) -> tuple[np.ndarray, dict]:
+        """One predict RPC → (prediction array, response headers)."""
+        headers = {"Content-Type": NPY_CONTENT_TYPE}
+        if trace_id:
+            headers[TRACE_HEADER] = trace_id
+        status, rheaders, data = self._request(
+            "POST", "/predict", dump_npy(np.asarray(X)), headers,
+            timeout_s, conn_slot)
+        self._raise_for_status(status, data, self.name, trace_id)
+        return load_npy(data), rheaders
+
+    # -------------------------------------------------------- control plane
+    def get_json(self, path: str, *, timeout_s: float | None = None,
+                 ) -> tuple[int, dict]:
+        status, _h, data = self._request("GET", path, None, {}, timeout_s)
+        try:
+            return status, json.loads(data)
+        except ValueError:
+            return status, {}
+
+    def post_json(self, path: str, obj: dict | None = None, *,
+                  timeout_s: float | None = None) -> tuple[int, dict]:
+        body = json.dumps(obj or {}).encode()
+        status, _h, data = self._request(
+            "POST", path, body, {"Content-Type": "application/json"},
+            timeout_s)
+        try:
+            return status, json.loads(data)
+        except ValueError:
+            return status, {}
+
+    def ready(self, *, timeout_s: float | None = None) -> tuple[bool, dict]:
+        """One /readyz poll → (ready?, body). Transport failures report
+        unready (the router's health view must never raise)."""
+        try:
+            status, body = self.get_json("/readyz", timeout_s=timeout_s)
+        except ReplicaUnavailableError as e:
+            return False, {"reason": e.reason}
+        return status == 200 and bool(body.get("ready")), body

@@ -53,6 +53,7 @@ __all__ = [
     "current_trace_id",
     "flag_current_trace",
     "new_trace_id",
+    "propagated_scope",
     "trace_scope",
 ]
 
@@ -178,6 +179,22 @@ def trace_scope(kind: str = "serve", *, reuse: bool = False,
         return _NULL
     trace_id = new_trace_id(kind)
     return _Scope(TraceContext(trace_id, kind, _sampled(trace_id, sample)))
+
+
+def propagated_scope(trace_id: str | None, kind: str = "serve"):
+    """Adopt a trace id minted in ANOTHER process — the fleet RPC header
+    (``X-OTPU-Trace``, fleet/rpc.py): the replica's serve/dispatch spans
+    then carry the router-minted identity, so one trace spans
+    router → replica → device dispatch across the process boundary.
+    Propagated requests never tail-sample (the router already owns the
+    retention decision for the trace; a replica dropping its half would
+    leave every exported cross-process trace dangling). No-op under
+    ``OTPU_OBS=0`` or with no id to adopt."""
+    from orange3_spark_tpu.obs import trace
+
+    if not trace_id or not trace.enabled():
+        return _NULL
+    return _Scope(TraceContext(trace_id, kind, sampled=True))
 
 
 @contextlib.contextmanager

@@ -5,6 +5,13 @@ here is a stdlib ``ThreadingHTTPServer`` on a daemon thread exposing:
 
 * ``GET /metrics``  — Prometheus text exposition of the whole registry
   (the aot/bucket/mb serving counters, dispatches, retries, histograms);
+* ``GET /readyz``   — JSON **readiness** (distinct from liveness): 200
+  only when a ``ServingContext`` is active, its warmup has completed
+  (``ServingContext.warmup`` notes it), and the process is not draining
+  (fleet/rpc.py sets the drain flag on SIGTERM / ``POST /drain``);
+  otherwise 503 with a ``reason``. This is what a fleet router routes
+  on — a replica mid-warmup or mid-drain is *alive* (``/healthz`` 200)
+  but must receive no new traffic;
 * ``GET /healthz``  — JSON liveness: seconds since the last progress beat
   (``utils.dispatch.beat`` — every step loop, prefetch worker, routed
   serve call and micro-batch flush ticks it), in-flight/wedge/retry
@@ -32,9 +39,88 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from orange3_spark_tpu.utils import knobs
 
-__all__ = ["TelemetryServer", "maybe_start_from_env"]
+__all__ = [
+    "TelemetryServer",
+    "is_draining",
+    "maybe_start_from_env",
+    "note_warmup_complete",
+    "ready_body",
+    "reset_readiness",
+    "set_draining",
+]
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+# ---------------------------------------------------------------- readiness
+# Process-wide readiness state, distinct from the liveness heartbeat:
+# /healthz answers "is this process making progress", /readyz answers
+# "should a router send this process NEW work". Warmup completion is noted
+# by ServingContext.warmup(); the drain flag is raised by the fleet
+# replica's SIGTERM handler / POST /drain hook (fleet/rpc.py). A fresh
+# serving window (first ServingContext activation with none already
+# active) resets warmup — a context is not ready until it is warm.
+_READY_LOCK = threading.Lock()
+_warmup_complete = False
+_draining = False
+
+
+def note_warmup_complete(done: bool = True) -> None:
+    """ServingContext.warmup() calls this on success — the readiness
+    half of "warmed ahead of traffic"."""
+    global _warmup_complete
+    with _READY_LOCK:
+        _warmup_complete = bool(done)
+
+
+def set_draining(on: bool = True) -> None:
+    """Raise/clear the process drain flag (fleet SIGTERM / POST /drain):
+    a draining process fails /readyz so routers stop sending new work,
+    while in-flight requests finish."""
+    global _draining
+    with _READY_LOCK:
+        _draining = bool(on)
+
+
+def is_draining() -> bool:
+    return _draining
+
+
+def reset_readiness() -> None:
+    """Fresh serving window: not warm, not draining."""
+    global _warmup_complete, _draining
+    with _READY_LOCK:
+        _warmup_complete = False
+        _draining = False
+
+
+def ready_body(context=None) -> tuple[dict, bool]:
+    """(/readyz body, ready?). Ready means: an active ServingContext,
+    warmup complete, and not draining — in that *reporting* order, with
+    draining outranking the rest (a draining replica must advertise WHY
+    it refuses work, not a stale warmup state)."""
+    from orange3_spark_tpu.serve.context import active_serving_context
+
+    ctx = context if context is not None else active_serving_context()
+    with _READY_LOCK:
+        draining, warm = _draining, _warmup_complete
+    if draining:
+        reason = "draining"
+    elif ctx is None:
+        reason = "no_active_context"
+    elif not warm:
+        reason = "warmup_pending"
+    else:
+        reason = None
+    ready = reason is None
+    return {
+        "status": "ready" if ready else "unready",
+        "ready": ready,
+        "reason": reason,
+        "draining": draining,
+        "warmup_complete": warm,
+        "context_active": ctx is not None,
+    }, ready
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -63,6 +149,10 @@ class _Handler(BaseHTTPRequestHandler):
                 body, healthy = owner.health()
                 self._send(200 if healthy else 503,
                            json.dumps(body).encode(), "application/json")
+            elif route == "/readyz":
+                body, ready = ready_body(owner._context)
+                self._send(200 if ready else 503,
+                           json.dumps(body).encode(), "application/json")
             elif route == "/debug/flight":
                 # the manual black-box pull on a LIVE process: write a
                 # bundle (no rate limit — the operator asked) and return
@@ -84,7 +174,8 @@ class _Handler(BaseHTTPRequestHandler):
                            "application/json")
             else:
                 self._send(404, b"not found: try /metrics, /healthz, "
-                                b"/debug/flight or /debug/stacks\n",
+                                b"/readyz, /debug/flight or "
+                                b"/debug/stacks\n",
                            "text/plain")
         except Exception as e:  # noqa: BLE001 - never kill the listener
             try:

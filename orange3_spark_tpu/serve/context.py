@@ -283,6 +283,14 @@ class ServingContext:
                     batch_cap=self.ladder.max_bucket,
                 )
             self._activations += 1
+            if not _ACTIVE:
+                # a FRESH serving window for the process (no context was
+                # active): it is not /readyz-ready until warmed — the
+                # readiness half of "warm ahead of traffic" (obs/server.py;
+                # overlapping activations inherit the window's state)
+                from orange3_spark_tpu.obs.server import reset_readiness
+
+                reset_readiness()
             if self._activations == 1:
                 from orange3_spark_tpu.obs.server import maybe_start_from_env
                 from orange3_spark_tpu.obs.trace import refreshed_enabled
@@ -823,6 +831,12 @@ class ServingContext:
                     n_pad=n_pad,
                 )
                 compiled += 0 if hit else 1
+        # readiness (obs/server.py /readyz): the ladder is compiled — a
+        # fleet router may now send this process traffic without any
+        # request paying an XLA compile
+        from orange3_spark_tpu.obs.server import note_warmup_complete
+
+        note_warmup_complete()
         return {"compiled": compiled, "buckets": buckets}
 
     # ------------------------------------------------------------- report

@@ -30,10 +30,21 @@ WORKFLOW_FILE = "workflow.json"
 
 
 def save_model(model: Model, path: str) -> None:
-    """Persist a fitted model (MLlib model.save equivalent)."""
+    """Persist a fitted model (MLlib model.save equivalent).
+
+    Write-to-temp + fsync + rename: a crash mid-save can never leave a
+    torn ``model.pkl`` where a reader expects a whole one — the fleet's
+    versioned publish (fleet/rollout.py) layers its atomic
+    directory-rename on top of this, so a replica either loads a
+    complete payload or a missing file, never garbage."""
     os.makedirs(path, exist_ok=True)
-    with open(os.path.join(path, MODEL_FILE), "wb") as f:
+    final = os.path.join(path, MODEL_FILE)
+    tmp = f"{final}.tmp-{os.getpid()}"
+    with open(tmp, "wb") as f:
         pickle.dump(model, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final)
 
 
 def load_model(path: str) -> Model:

@@ -132,6 +132,41 @@ _ALL = [
     # ----------------------------------------------------------- serve/
     Knob("OTPU_SERVE_REQUESTS", "int", 120, "serve",
          "bench.py serving-trace request count."),
+    # ----------------------------------------------------------- fleet/
+    Knob("OTPU_FLEET", "flag", "1", "fleet",
+         "Serving-fleet kill-switch; 0 = FleetFrontend serves on the "
+         "single-process path exactly (no replica subprocesses spawn, "
+         "predict() is the raw in-process call)."),
+    Knob("OTPU_FLEET_REPLICAS", "int", 4, "fleet",
+         "Replica subprocesses a ReplicaManager/FleetFrontend spawns by "
+         "default (bench.py --config fleet uses it for the N-replica "
+         "scaling arm)."),
+    Knob("OTPU_FLEET_PORT_BASE", "int", 0, "fleet",
+         "First replica RPC port (replica i binds base+i); 0 = pick a "
+         "free ephemeral port per replica."),
+    Knob("OTPU_FLEET_HEDGE_MS", "float", 30.0, "fleet",
+         "Floor on the router's tail-hedging delay: a second copy of an "
+         "idempotent predict is issued to a different replica once the "
+         "primary has been outstanding this long (raised by the "
+         "EWMA-p95 estimate; 0 keeps the pure percentile schedule)."),
+    Knob("OTPU_FLEET_HEDGE_PCTL", "float", 95.0, "fleet",
+         "Latency percentile the hedge delay derives from (EWMA "
+         "mean + z(pctl) * EWMA stddev of observed request latency)."),
+    Knob("OTPU_FLEET_TIMEOUT_S", "float", 30.0, "fleet",
+         "Default per-request connect/read deadline on the fleet RPC "
+         "client (an explicit deadline or request_deadline() scope "
+         "outranks it)."),
+    Knob("OTPU_DRAIN_S", "float", 5.0, "fleet",
+         "Graceful-drain budget: a draining replica (SIGTERM or POST "
+         "/drain) finishes in-flight requests up to this many seconds "
+         "before exiting."),
+    Knob("OTPU_ROLLOUT_CANARY", "int", 4, "fleet",
+         "Canary predicts the rollout sends through each freshly-flipped "
+         "replica; a failure trips the rollout breaker and rolls the "
+         "fleet back to the previous version."),
+    Knob("OTPU_ROLLOUT_TIMEOUT_S", "float", 60.0, "fleet",
+         "Per-replica budget for one rollout step (reload + warm + "
+         "readiness re-poll) before the rollout aborts and rolls back."),
     # ------------------------------------------------------------- obs/
     Knob("OTPU_OBS", "flag", "1", "obs",
          "Observability master switch; 0 = spans no-op, the telemetry "

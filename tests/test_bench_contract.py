@@ -97,6 +97,24 @@ def _run(argv, timeout=420):
     # typed errors — zero hung/lost futures — while OTPU_RESILIENCE=0
     # reproduces legacy behavior; plus the breaker half-open re-admission
     # and the memory-pressure brownout drills
+    # serving-fleet A/B (ISSUE 10): the multi-replica layer's measured
+    # claims — N-replica aggregate-throughput scaling, hedged-vs-unhedged
+    # tail latency under one injected straggler, the SIGKILL-mid-burst
+    # accounting (0 lost / 0 hung), the zero-downtime rollout with
+    # forced-bad-version rollback, cross-process trace coverage, and the
+    # OTPU_FLEET=0 single-process parity pin
+    (["bench.py", "--config", "fleet"],
+     "fleet_n_replica_scaling",
+     {"replicas", "scaling_factor", "throughput_single_rows_per_s_per_chip",
+      "throughput_fleet_rows_per_s_per_chip", "p99_ms_unhedged",
+      "p99_ms_hedged", "hedged_p99_ratio", "hedges_issued",
+      "kill_requests", "kill_completed", "kill_typed_failures",
+      "kill_hung", "kill_lost", "replica_restarted",
+      "killed_replica_readmitted", "rollout_outcome",
+      "rollout_failed_requests", "rollback_outcome",
+      "rollback_current_untouched", "kill_switch_local_parity",
+      "baseline_value", "baseline_note",
+      "traced_requests", "trace_coverage", "flight_bundles_written"}),
     (["bench.py", "--config", "overload"],
      "overload_admission_p99_bound_factor",
      {"p99_ms_admitted", "p99_ms_raw", "p99_bound_factor", "sheds",
@@ -176,6 +194,31 @@ def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
         assert d["trace_coverage"] == 1.0, (
             d["traced_requests"], d["requests"])
         assert isinstance(d["flight_bundles_written"], int)
+    if "scaling_factor" in extra_keys:
+        # the fleet claims (ISSUE 10 acceptance), semantics not just
+        # schema: N replicas scale aggregate throughput >= 2.5x the
+        # single-replica arm on the same burst; EWMA-p95 hedging holds
+        # p99 to <= 0.5x the unhedged arm under one injected straggler;
+        # the SIGKILL-mid-burst arm loses and hangs NOTHING (failover
+        # completes or fails typed) and the supervisor+breaker re-admit
+        # the replacement; the rolling version swap fails zero requests
+        # and the poisoned version auto-rolls back; the kill-switch arm
+        # served bitwise-identically on the single-process path
+        assert d["scaling_factor"] >= 2.5, d["scaling_factor"]
+        assert d["hedged_p99_ratio"] <= 0.5, (
+            d["p99_ms_hedged"], d["p99_ms_unhedged"])
+        assert d["hedges_issued"] >= 1
+        assert d["kill_hung"] == 0 and d["kill_lost"] == 0
+        assert d["kill_wrong_results"] == 0
+        assert (d["kill_completed"] + d["kill_typed_failures"]
+                == d["kill_requests"])
+        assert d["replica_restarted"] is True
+        assert d["killed_replica_readmitted"] is True
+        assert d["rollout_outcome"] == "completed"
+        assert d["rollout_failed_requests"] == 0
+        assert d["rollback_outcome"] == "rolled_back"
+        assert d["rollback_current_untouched"] is True
+        assert d["kill_switch_local_parity"] is True
     if "p99_bound_factor" in extra_keys:
         # the overload claims (ISSUE 8 acceptance): under the injected
         # overload trace the admission-controlled arm keeps p99 >= 3x

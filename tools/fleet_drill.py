@@ -1,0 +1,204 @@
+"""One-shot serving-fleet drill — watch the multi-replica ladder work.
+
+Spins a REAL local fleet (replica subprocesses, fleet/supervisor.py)
+behind a health-aware hedged router and walks the three serving-fleet
+failure drills (docs/serving.md §fleet), printing each rung:
+
+  burst+kill  a closed-loop burst while one replica is SIGKILLed
+              mid-flight: every request completes via failover (or
+              fails typed) — zero lost, zero hung — and the supervisor
+              restarts the replica, which re-admits itself via /readyz
+  rollout     publish a new model version and roll it one replica at a
+              time under continuous traffic: zero failed requests, then
+              a poisoned version auto-rolls back with CURRENT untouched
+  drain       graceful stop: POST /drain finishes in-flight work and
+              the replica exits 0
+
+Importable: ``run_drill(session=...)`` returns the row dicts (the
+not-slow smoke test in tests/test_fleet.py calls it directly).
+
+Usage:
+    python tools/fleet_drill.py [--replicas 2] [--requests 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_drill(session=None, replicas: int = 2, requests: int = 16) -> list:
+    import concurrent.futures
+
+    import numpy as np
+
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.fleet.rollout import (
+        Rollout, publish_version, read_current,
+    )
+    from orange3_spark_tpu.fleet.router import FleetRouter
+    from orange3_spark_tpu.fleet.rpc import (
+        NoReplicaAvailableError, ReplicaDrainingError,
+        ReplicaUnavailableError,
+    )
+    from orange3_spark_tpu.fleet.supervisor import ReplicaManager
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+    from orange3_spark_tpu.obs.registry import REGISTRY
+
+    session = session or TpuSession.builder_get_or_create()
+    rng = np.random.default_rng(3)
+    X = np.concatenate([
+        rng.standard_normal((4096, 4)).astype(np.float32),
+        rng.integers(0, 500, (4096, 4)).astype(np.float32),
+    ], axis=1)
+    y = (rng.random(4096) < 0.3).astype(np.float32)
+
+    def fit(epochs):
+        return StreamingHashedLinearEstimator(
+            n_dims=1 << 10, n_dense=4, n_cat=4, epochs=epochs,
+            step_size=0.05, chunk_rows=1024,
+        ).fit_stream(array_chunk_source(X, y, chunk_rows=1024),
+                     session=session)
+
+    def say(msg):
+        print(f"[drill] {msg}", file=sys.stderr)
+
+    model = fit(1)
+    root = tempfile.mkdtemp(prefix="otpu-fleet-drill-")
+    publish_version(model, root, n_cols=8)
+    rows_out: list = []
+    say(f"starting {replicas} replicas ...")
+    mgr = ReplicaManager(
+        root, n_replicas=replicas, ladder_max=256,
+        env={"JAX_PLATFORMS": "cpu", "OTPU_ADMISSION_MAX_INFLIGHT": "1",
+             "OTPU_FAULT_SPEC": "overload:delay_ms=25"})
+    mgr.start()
+    try:
+        if not mgr.wait_ready(timeout_s=120):
+            raise RuntimeError(f"fleet never ready; see {mgr.log_dir}")
+        router = FleetRouter(mgr.endpoints(), hedging=False)
+        router.refresh()
+        # reference from the HEALTHY FLEET itself: replicas pin CPU while
+        # this parent may sit on a TPU backend, and a cross-backend
+        # bitwise compare would flip threshold-adjacent labels
+        expect = np.asarray(router.predict(X[:64]))
+
+        # ---- rung 1: SIGKILL mid-burst, failover + supervised restart ----
+        restarts0 = int(REGISTRY.get(
+            "otpu_fleet_replica_restarts_total").total())
+
+        def one(i):
+            time.sleep(i * 0.01)
+            try:
+                out = router.predict(X[:64])
+                return "ok" if np.array_equal(out, expect) else "wrong"
+            except (ReplicaUnavailableError, ReplicaDrainingError,
+                    NoReplicaAvailableError):
+                return "typed"
+
+        with concurrent.futures.ThreadPoolExecutor(6) as ex:
+            futs = [ex.submit(one, i) for i in range(requests)]
+            time.sleep(0.08)
+            mgr.kill(0)                      # no warning, whole group
+            done, pending = concurrent.futures.wait(futs, timeout=60)
+            outcomes = [f.result() for f in done]
+        deadline = time.monotonic() + 60
+        readmitted = False
+        while time.monotonic() < deadline:
+            router.refresh()
+            ep = router.endpoint(0)
+            if ep.ready and ep.breaker.state() != "open":
+                readmitted = True
+                break
+            time.sleep(0.2)
+        restarted = int(REGISTRY.get(
+            "otpu_fleet_replica_restarts_total").total()) > restarts0
+        say(f"burst+kill: {outcomes.count('ok')} ok / "
+            f"{outcomes.count('typed')} typed / {len(pending)} hung; "
+            f"restarted={restarted} readmitted={readmitted}")
+        rows_out.append({
+            "rung": "burst_kill", "completed": outcomes.count("ok"),
+            "typed": outcomes.count("typed"), "hung": len(pending),
+            "restarted": restarted, "readmitted": readmitted,
+            "ok": (len(pending) == 0 and outcomes.count("wrong") == 0
+                   and outcomes.count("ok") + outcomes.count("typed")
+                   == requests and restarted and readmitted)})
+
+        # ---- rung 2: zero-downtime rollout + poisoned-version rollback ----
+        model2 = fit(2)
+        v2 = publish_version(model2, root, n_cols=8)
+        stop = threading.Event()
+        fails: list = []
+
+        def traffic():
+            while not stop.is_set():
+                try:
+                    router.predict(X[:64])
+                except Exception as e:  # noqa: BLE001 - the claim is zero
+                    fails.append(repr(e))
+                time.sleep(0.02)
+
+        th = threading.Thread(target=traffic)
+        th.start()
+        try:
+            res = Rollout(router, root, canary_input=X[:16]).roll(v2)
+        finally:
+            stop.set()
+            th.join(timeout=10)
+        bad = os.path.join(root, ".staging-bad")
+        os.makedirs(bad, exist_ok=True)
+        with open(os.path.join(bad, "model.pkl"), "wb") as f:
+            f.write(b"poisoned")
+        os.replace(bad, os.path.join(root, "v0099"))
+        rb = Rollout(router, root, canary_input=X[:16]).roll("v0099")
+        say(f"rollout: {res['outcome']} with {len(fails)} failed "
+            f"requests; poisoned version {rb['outcome']}, CURRENT="
+            f"{read_current(root)}")
+        rows_out.append({
+            "rung": "rollout", "outcome": res["outcome"],
+            "failed_requests": len(fails),
+            "rollback_outcome": rb["outcome"],
+            "ok": (res["outcome"] == "completed" and not fails
+                   and rb["outcome"] == "rolled_back"
+                   and read_current(root) == v2)})
+        router.close()
+    finally:
+        # ---- rung 3: graceful drain — every replica exits 0 ----
+        rcs = mgr.stop_all()
+    clean = all(rc == 0 for rc in rcs.values() if rc is not None)
+    say(f"drain: exit codes {rcs} (clean={clean})")
+    rows_out.append({"rung": "drain", "exit_codes": rcs, "ok": clean})
+    return rows_out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=16)
+    args = ap.parse_args()
+    sys.path.insert(0, REPO)
+    results = run_drill(replicas=args.replicas, requests=args.requests)
+    bad = [r for r in results if not r["ok"]]
+    print(json.dumps({
+        "metric": "fleet_drill",
+        "value": len(results),
+        "unit": "rungs_run",
+        "vs_baseline": None,
+        "rungs_ok": len(results) - len(bad),
+        "rungs": results,
+    }, default=str))
+    sys.exit(1 if bad else 0)
+
+
+if __name__ == "__main__":
+    main()
