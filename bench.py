@@ -1698,6 +1698,90 @@ def bench_fleet(*, requests: int = 64, service_ms: float = 30.0,
     assert bN["outcomes"].count("ok") == requests, bN["outcomes"]
     scaling = thr_n / thr_1
 
+    # ---- fleet-telemetry arm (ISSUE 11): collector A/B + SLO drill ----
+    # collector overhead: the SAME burst with the scrape loop on vs off,
+    # interleaved pairs with min wall per arm (the criteo obs-A/B
+    # convention — the injected service time makes walls service-bound,
+    # so the scraper's host cost is the measurand, not XLA noise)
+    _log("[fleet] collector-overhead A/B ...")
+    from orange3_spark_tpu.obs import fleetobs as fobs
+
+    col = fobs.FleetCollector(mgrN.endpoints(), router=rN, scrape_s=0.5)
+    walls_on: list = []
+    walls_off: list = []
+    for _ in range(4):
+        col.start()
+        walls_on.append(burst(rN)["wall_s"])
+        col.stop()
+        walls_off.append(burst(rN)["wall_s"])
+    wall_on, wall_off = min(walls_on), min(walls_off)
+    collector_overhead_pct = round(
+        (wall_on - wall_off) / wall_off * 100.0, 2)
+    # one fresh sweep pins the aggregation + staleness view the record
+    # embeds: every replica fresh, per-replica rpc counters summing to
+    # at least the bursts this fleet absorbed. Staleness is captured
+    # HERE, while the fleet lives — a post-teardown read would see every
+    # replica minutes stale and bank a vacuous count
+    fleet_digest = col.scrape_once()
+    fleetz = col.fleetz()
+    ages = [a for a in col.staleness().values() if a is not None]
+    scrape_stale_n = len(col.stale_replicas())
+    fleet_agg_rpc = fleetz["aggregates"].get(
+        "otpu_fleet_rpc_requests_total", 0.0)
+
+    # SLO burn drill: a deliberately-tight latency objective (p99 <= 1ms
+    # against the injected 30ms service time) burns budget on every
+    # request — the multi-window engine must page, and the alert must
+    # write EXACTLY ONE rate-limited fleet incident bundle carrying
+    # every live replica's flight pull
+    _log("[fleet] SLO burn drill ...")
+    fobs.reset_fleet_rate_limit()
+
+    def _slo_bundles():
+        m = REGISTRY.get("otpu_flight_bundles_total")
+        if m is None:
+            return 0
+        return int(sum(v for k, v in m.per_label("reason").items()
+                       if k.startswith("slo_")))
+
+    slo_bundles0 = _slo_bundles()
+    slo_engine = fobs.SLOEngine(
+        fobs.parse_slo_spec("burn_drill:target=99.0,p99_ms=1"),
+        fast_s=5.0, slow_s=20.0)
+    rS = FleetRouter(mgrN.endpoints(), hedging=False, slo=slo_engine)
+    rS.refresh()
+    colS = fobs.FleetCollector(mgrN.endpoints(), router=rS,
+                               slo=slo_engine, scrape_s=0.25)
+    for _i in range(24):
+        rS.predict(X[:64])
+    slo_verdicts = slo_engine.evaluate()
+    colS.scrape_once()
+    colS.join_incident_dump()     # the dump runs on a dedicated thread
+    rS.close()
+    slo_alerts = len(slo_engine.alerts)
+    fleet_incident_bundles = _slo_bundles() - slo_bundles0
+    fleet_bundle_replicas = None
+    if colS.last_incident_path:
+        with open(colS.last_incident_path) as f:
+            fb = json.load(f)
+        fleet_bundle_replicas = len(fb.get("live_replicas", []))
+
+    # kill-switch: OTPU_FLEETOBS=0 must serve bitwise-identically on the
+    # bare PR-10 path (no collector thread, no span, no SLO sample)
+    ref_fobs = np.asarray(rN.predict(X[:128]))
+    saved_fobs = os.environ.get("OTPU_FLEETOBS")
+    os.environ["OTPU_FLEETOBS"] = "0"
+    try:
+        off_fobs = np.asarray(rN.predict(X[:128]))
+        col_off = fobs.FleetCollector(mgrN.endpoints()).start()
+        fleetobs_parity = (bool(np.array_equal(ref_fobs, off_fobs))
+                           and not col_off.active)
+    finally:
+        if saved_fobs is None:
+            os.environ.pop("OTPU_FLEETOBS", None)
+        else:
+            os.environ["OTPU_FLEETOBS"] = saved_fobs
+
     # ---- kill arm: SIGKILL one replica mid-burst ----
     _log("[fleet] SIGKILL-mid-burst arm ...")
     # the reference answer comes from the HEALTHY FLEET, not the parent
@@ -1889,6 +1973,25 @@ def bench_fleet(*, requests: int = 64, service_ms: float = 30.0,
         "trace_coverage": (round(propagated / traced_requests, 3)
                            if traced_requests else None),
         "flight_bundles_written": flight.bundles_written(),
+        # ---- fleet telemetry plane (ISSUE 11) ----
+        "collector_overhead_pct": collector_overhead_pct,
+        "wall_scrape_on_s": round(wall_on, 3),
+        "wall_scrape_off_s": round(wall_off, 3),
+        "scrape_stale_replicas": scrape_stale_n,
+        "scrape_age_max_s": round(max(ages), 3) if ages else None,
+        "fleet_agg_rpc_requests": fleet_agg_rpc,
+        "fleet": {"aggregates": fleetz["aggregates"],
+                  "replicas": fleetz["replicas"],
+                  "digest": fleet_digest.to_dict()},
+        "slo_alerts": slo_alerts,
+        "slo_verdicts": slo_verdicts,
+        "slo_burn_long": round(
+            slo_verdicts[0]["rules"]["fast"]["burn_long"], 2),
+        "slo_budget_remaining": slo_verdicts[0]["budget_remaining"],
+        "fleet_incident_bundles": fleet_incident_bundles,
+        "fleet_bundle_replicas": fleet_bundle_replicas,
+        "fleet_bundle_path": colS.last_incident_path,
+        "fleetobs_kill_switch_parity": fleetobs_parity,
         # ---- kill-switch contract ----
         "kill_switch_local_parity": kill_switch_parity,
         "kill_switch_no_subprocesses": kill_switch_local,

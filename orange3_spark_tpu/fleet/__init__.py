@@ -16,7 +16,14 @@ router with request hedging and zero-downtime version rollout —
   per-replica circuit breakers, retry-with-replica-exclusion,
   deterministic EWMA-p95 tail hedging (``OTPU_FLEET_HEDGE_*``);
 * ``rollout``    atomic versioned publish + one-replica-at-a-time roll
-  with canaries and automatic rollback.
+  with canaries and automatic rollback (an attached SLO engine's
+  mid-roll burn-rate alert rolls back too).
+
+Fleet-WIDE telemetry — aggregated /metrics + /fleetz, cross-process
+trace assembly, SLO burn-rate alerting, fleet incident bundles and the
+FleetDigest load-signal snapshot — lives in obs/fleetobs.py
+(kill-switch ``OTPU_FLEETOBS=0``; docs/observability.md §fleet
+telemetry).
 
 Kill-switch: ``OTPU_FLEET=0`` — :class:`FleetFrontend` then serves on
 the single-process path *exactly* (the raw in-process ``predict``, no

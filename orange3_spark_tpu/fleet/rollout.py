@@ -174,6 +174,7 @@ class Rollout:
     def __init__(self, router, root: str, *, canary_input=None,
                  canary_n: int | None = None,
                  timeout_s: float | None = None,
+                 slo_engine=None,
                  clock=time.monotonic):
         self.router = router
         self.root = root
@@ -183,6 +184,10 @@ class Rollout:
         self.timeout_s = float(
             timeout_s if timeout_s is not None
             else knobs.get_float("OTPU_ROLLOUT_TIMEOUT_S"))
+        # fleet SLO feed (obs/fleetobs.py SLOEngine): a burn-rate alert
+        # that fires while the roll is in progress counts like a canary
+        # breaker trip — the fleet-level error-rate half of rollback
+        self.slo_engine = slo_engine
         self.clock = clock
 
     # -------------------------------------------------------------- steps
@@ -236,6 +241,21 @@ class Rollout:
                         f"{type(e).__name__}: {e}",
                         replica_id=ep.replica_id, step="canary") from e
 
+    def _check_slo(self, ep, version: str, alerts0: int) -> None:
+        """A fleet burn-rate alert fired since the roll started means
+        live traffic is burning error budget UNDER the new version —
+        stop and roll back, exactly like a tripped canary breaker."""
+        if self.slo_engine is None:
+            return
+        self.slo_engine.evaluate()
+        fresh = self.slo_engine.alerts[alerts0:]
+        if fresh:
+            a = fresh[-1]
+            raise RolloutError(
+                f"SLO {a.slo!r} burn-rate alert ({a.rule} rule, burn "
+                f"{a.burn_long:.1f}x) fired during the rollout of "
+                f"{version}", replica_id=ep.replica_id, step="slo_burn")
+
     def _verify_ready(self, ep, version: str) -> None:
         deadline = self.clock() + self.timeout_s
         while self.clock() < deadline:
@@ -280,6 +300,8 @@ class Rollout:
         if not os.path.isdir(os.path.join(self.root, version)):
             raise RolloutError(f"version {version} not published under "
                                f"{self.root}")
+        alerts0 = (len(self.slo_engine.alerts)
+                   if self.slo_engine is not None else 0)
         flipped: list = []
         for ep in list(self.router.endpoints):
             self.router.set_admitted(ep.replica_id, False)
@@ -288,6 +310,7 @@ class Rollout:
                 self._reload(ep, version)
                 self._canary(ep, version)
                 self._verify_ready(ep, version)
+                self._check_slo(ep, version, alerts0)
             except Exception as e:  # noqa: BLE001 - roll back, report typed
                 log.warning("fleet: rollout of %s halted at %s: %s; "
                             "rolling back %d replica(s)", version, ep.name,
@@ -295,7 +318,8 @@ class Rollout:
                 # the failing replica still serves OLD (reload is
                 # all-or-nothing) unless it flipped and failed later
                 maybe_flipped = ([ep] if getattr(e, "step", "")
-                                 in ("canary", "readyz") else [])
+                                 in ("canary", "readyz", "slo_burn")
+                                 else [])
                 rollback_failed = self._rollback(
                     flipped + maybe_flipped, old)
                 # (the finally below re-admits the failing replica)

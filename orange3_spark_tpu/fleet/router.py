@@ -164,7 +164,7 @@ class FleetRouter:
     def __init__(self, endpoints, *, hedging: bool = True,
                  schedule: HedgeSchedule | None = None,
                  health_poll_s: float = 0.25,
-                 client_factory=None):
+                 client_factory=None, slo=None):
         factory = client_factory or (
             lambda host, port, name: FleetClient(host, port, name=name))
         self.endpoints: list[ReplicaEndpoint] = []
@@ -178,6 +178,10 @@ class FleetRouter:
                     client=factory(host, port, f"replica-{rid}")))
         self.hedging = hedging
         self.schedule = schedule or HedgeSchedule()
+        # fleet telemetry (obs/fleetobs.py): every predict outcome feeds
+        # the SLO burn-rate engine when one is attached — gated, like the
+        # router-side serve span, on OTPU_FLEETOBS (read per request)
+        self.slo = slo
         self.health_poll_s = health_poll_s
         self._lock = threading.Lock()
         self._pool = concurrent.futures.ThreadPoolExecutor(
@@ -381,10 +385,38 @@ class FleetRouter:
         """Route one idempotent predict through the fleet. Typed errors
         only: ``ReplicaUnavailableError`` when every failover attempt
         failed, ``NoReplicaAvailableError`` when there was nowhere to
-        send it — never a hang (every wait is deadline-bounded)."""
+        send it — never a hang (every wait is deadline-bounded).
+
+        With the fleet telemetry plane on (``OTPU_FLEETOBS``, default),
+        the request runs under a router-side ``serve`` span carrying the
+        minted trace id — the router half the cross-process trace
+        assembler stitches to the replica's spans — and its outcome +
+        latency feed the attached SLO engine. ``OTPU_FLEETOBS=0`` takes
+        the bare PR-10 path: no scope, no span, no sample."""
         trace_id = new_trace_id("fleet")
         _M_REQS.inc()
         use_hedge = self.hedging if hedge is None else hedge
+        from orange3_spark_tpu.obs.fleetobs import fleetobs_enabled
+
+        if not fleetobs_enabled():
+            return self._route(X, trace_id, deadline_s, use_hedge)
+        from orange3_spark_tpu.obs import trace as _trace
+        from orange3_spark_tpu.obs.context import propagated_scope
+
+        t0 = time.perf_counter()
+        ok = False
+        try:
+            with propagated_scope(trace_id, "fleet"):
+                with _trace.span("serve", kind="fleet"):
+                    out = self._route(X, trace_id, deadline_s, use_hedge)
+            ok = True
+            return out
+        finally:
+            if self.slo is not None:
+                self.slo.record(ok, time.perf_counter() - t0)
+
+    def _route(self, X, trace_id: str, deadline_s: float | None,
+               use_hedge: bool) -> np.ndarray:
         excluded: set = set()
         last_err: Exception | None = None
         for _attempt in range(max(2 * len(self.endpoints), 2)):

@@ -22,6 +22,13 @@ is a reverse proxy's job):
 * ``GET /readyz`` / ``GET /healthz`` / ``GET /metrics`` — the obs
   server's readiness/liveness/exposition bodies served off the data
   port, so a router needs ONE address per replica.
+* ``GET /debug/flight`` / ``GET /debug/stacks`` /
+  ``GET /debug/spans?trace_id=`` — the replica's black box pulled off
+  the SAME port (an operator needs no second listener): the flight
+  bundle (written + returned, like the obs server's), every thread's
+  stack + open spans, and the span-ring payload (with a wall/perf clock
+  anchor) the fleet collector's cross-process trace assembly stitches
+  (obs/fleetobs.py). Loopback-only like everything here.
 * ``POST /drain``    — the loopback drain hook (same path as SIGTERM):
   finish in-flight work up to ``OTPU_DRAIN_S``, then exit 0.
 * ``POST /reload``   — zero-downtime rollout hook (fleet/rollout.py):
@@ -155,8 +162,10 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
 
     def _send_json(self, code: int, obj: dict,
                    headers: dict | None = None) -> None:
-        self._send(code, json.dumps(obj).encode(), "application/json",
-                   headers)
+        # default=str matches the obs server's serializer: a debug body
+        # carrying a non-JSON-native span arg must render, not 500
+        self._send(code, json.dumps(obj, default=str).encode(),
+                   "application/json", headers)
 
     def _body(self) -> bytes:
         n = int(self.headers.get("Content-Length") or 0)
@@ -182,9 +191,24 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
 
                 self._send(200, REGISTRY.to_prometheus().encode(),
                            PROM_CONTENT_TYPE)
+            elif route == "/debug/flight":
+                from orange3_spark_tpu.obs import flight
+
+                self._send_json(200, flight.debug_bundle(
+                    context=runtime.serving_context))
+            elif route == "/debug/stacks":
+                from orange3_spark_tpu.obs.server import stacks_body
+
+                self._send_json(200, stacks_body())
+            elif route == "/debug/spans":
+                from orange3_spark_tpu.obs.server import spans_body
+
+                self._send_json(200, spans_body(self.path))
             else:
                 self._send(404, b"not found: try /predict (POST), "
-                                b"/readyz, /healthz or /metrics\n",
+                                b"/readyz, /healthz, /metrics, "
+                                b"/debug/flight, /debug/stacks or "
+                                b"/debug/spans\n",
                            "text/plain")
         except Exception as e:  # noqa: BLE001 - never kill the listener
             self._oops(e)
@@ -396,6 +420,13 @@ class FleetClient:
             return status, json.loads(data)
         except ValueError:
             return status, {}
+
+    def get_text(self, path: str, *, timeout_s: float | None = None,
+                 ) -> tuple[int, str]:
+        """One GET → (status, body text) — the fleet collector's
+        /metrics scrape (Prometheus exposition is text, not JSON)."""
+        status, _h, data = self._request("GET", path, None, {}, timeout_s)
+        return status, data.decode("utf-8", errors="replace")
 
     def post_json(self, path: str, obj: dict | None = None, *,
                   timeout_s: float | None = None) -> tuple[int, dict]:

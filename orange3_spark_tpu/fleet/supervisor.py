@@ -33,6 +33,7 @@ import sys
 import threading
 import time
 
+from orange3_spark_tpu.obs import trace
 from orange3_spark_tpu.obs.registry import REGISTRY
 from orange3_spark_tpu.utils import knobs
 from orange3_spark_tpu.utils.procs import kill_process_group
@@ -44,6 +45,12 @@ log = logging.getLogger("orange3_spark_tpu")
 _M_RESTARTS = REGISTRY.counter(
     "otpu_fleet_replica_restarts_total",
     "crashed replica subprocesses restarted by the supervisor")
+#: the labeled lifecycle view (obs/fleetobs.py): crash-loops show up on
+#: the fleet timeline per replica and reason, not only in supervisor state
+_M_LIFECYCLE = REGISTRY.counter(
+    "otpu_fleet_restarts_total",
+    "supervised replica lifecycle events, by replica and reason "
+    "(crash / drain / kill)")
 
 #: a replica that survives this long has "started": its restart-backoff
 #: ladder resets (a crash loop keeps climbing, a one-off crash does not
@@ -120,6 +127,11 @@ class ReplicaManager:
         self._monitor: threading.Thread | None = None
         self._stop = threading.Event()
         self._clients: dict[int, object] = {}
+        # fleet-digest hook (obs/fleetobs.py FleetCollector publishes a
+        # FleetDigest here each scrape): the load-signal surface the
+        # ROADMAP-3 autoscaler will grow/shrink replicas from
+        self._digest = None
+        self._digest_cbs: list = []
 
     # ------------------------------------------------------------- spawning
     def _spawn(self, handle: ReplicaHandle) -> None:
@@ -180,6 +192,25 @@ class ReplicaManager:
     def endpoints(self) -> list[tuple[int, str, int]]:
         return [(h.replica_id, "127.0.0.1", h.port) for h in self.handles]
 
+    # --------------------------------------------------------- digest hook
+    def on_digest(self, cb) -> None:
+        """Register a FleetDigest consumer (the autoscaler hook)."""
+        self._digest_cbs.append(cb)
+
+    def publish_digest(self, digest) -> None:
+        """FleetCollector's per-scrape publish: store the latest digest
+        and fan it out to registered consumers (each guarded — a broken
+        consumer must not kill the scrape loop's publish)."""
+        self._digest = digest
+        for cb in list(self._digest_cbs):
+            try:
+                cb(digest)
+            except Exception:  # noqa: BLE001 - consumer's problem
+                pass
+
+    def latest_digest(self):
+        return self._digest
+
     def wait_ready(self, timeout_s: float = 60.0,
                    poll_s: float = 0.1) -> bool:
         """Block until every replica answers /readyz 200 (or timeout)."""
@@ -215,12 +246,25 @@ class ReplicaManager:
                         log.warning(
                             "fleet: replica-%d exited rc=%s; restart %d "
                             "in %.2fs", h.replica_id, rc, h.restarts + 1, d)
+                        # the crash lands on the fleet timeline the moment
+                        # it is DETECTED (the interesting instant), not
+                        # only once the backed-off respawn happens
+                        trace.instant(
+                            "replica_exit", replica=h.replica_id, rc=rc,
+                            restart_in_s=round(d, 3),
+                            restarts=h.restarts + 1)
                         continue
                     if now < h.restart_due_at:
                         continue
                     h.restart_due_at = None
                     h.restarts += 1
                     _M_RESTARTS.inc()
+                    _M_LIFECYCLE.inc(
+                        1, replica=f"replica-{h.replica_id}",
+                        reason="crash")
+                    trace.instant("replica_restart",
+                                  replica=h.replica_id,
+                                  restarts=h.restarts)
                     self._spawn(h)
             self._stop.wait(self.monitor_period_s)
 
@@ -230,6 +274,10 @@ class ReplicaManager:
         — the monitor must notice and restart it."""
         h = self.handles[replica_id]
         if h.proc is not None:
+            _M_LIFECYCLE.inc(1, replica=f"replica-{replica_id}",
+                             reason="kill")
+            trace.instant("replica_kill", replica=replica_id,
+                          pid=h.proc.pid)
             kill_process_group(h.proc, drain_s=5.0)
 
     def drain_stop(self, replica_id: int, *,
@@ -245,6 +293,10 @@ class ReplicaManager:
             h.stopping = True
         if h.proc is None:
             return None
+        _M_LIFECYCLE.inc(1, replica=f"replica-{replica_id}",
+                         reason="drain")
+        trace.instant("replica_drain", replica=replica_id,
+                      pid=h.proc.pid)
         budget = drain_budget_s() + extra_wait_s
         try:
             self.client(replica_id).post_json("/drain", timeout_s=2.0)

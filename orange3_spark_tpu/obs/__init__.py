@@ -22,6 +22,11 @@ Six pieces, one kill-switch (``OTPU_OBS=0``):
 * ``server``    — opt-in stdlib ``/metrics`` + ``/healthz`` +
   ``/debug/flight`` + ``/debug/stacks`` endpoint on serving processes
   (``OTPU_OBS_PORT``). Never binds under the kill-switch.
+* ``fleetobs``  — the fleet telemetry plane (its own kill-switch,
+  ``OTPU_FLEETOBS``): router-side /metrics aggregation over every
+  replica's scrape, cross-process trace assembly, the SLO burn-rate
+  engine, fleet incident bundles and the FleetDigest load-signal
+  snapshot (docs/observability.md §fleet telemetry).
 """
 
 from orange3_spark_tpu.obs.registry import (  # noqa: F401

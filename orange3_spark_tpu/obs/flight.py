@@ -195,9 +195,9 @@ def _flight_dir() -> str:
     return knobs.get_str("OTPU_FLIGHT_DIR")
 
 
-def _prune(directory: str, keep: int) -> None:
+def _prune(directory: str, keep: int, prefix: str = "flight") -> None:
     names = sorted(n for n in os.listdir(directory)
-                   if n.startswith("flight-") and n.endswith(".json"))
+                   if n.startswith(prefix + "-") and n.endswith(".json"))
     for n in names[:max(0, len(names) - keep)]:
         try:
             os.remove(os.path.join(directory, n))
@@ -205,14 +205,28 @@ def _prune(directory: str, keep: int) -> None:
             pass
 
 
+def debug_bundle(context=None) -> dict:
+    """The shared ``GET /debug/flight`` body (obs server AND the fleet
+    RPC port): collect one bundle NOW — the manual black-box pull, no
+    rate limit, the operator asked — write it, and return it with its
+    ``path`` so the caller sees where it landed."""
+    bundle = collect_bundle("debug_endpoint", context=context)
+    path = dump("debug_endpoint", bundle=bundle)
+    bundle["path"] = path
+    return bundle
+
+
 def dump(reason: str, error: BaseException | None = None, *,
          context=None, path: str | None = None, bundle: dict | None = None,
-         **extra) -> str | None:
+         prefix: str = "flight", **extra) -> str | None:
     """Write one flight bundle NOW; returns its path (None when the
     recorder is disabled). The manual entry point — no rate limit.
     Atomic write (tmp + ``os.replace``): a concurrent reader always sees
     complete, valid JSON. ``bundle`` reuses an already-collected bundle
-    (the /debug/flight endpoint collects once, returns AND writes it)."""
+    (the /debug/flight endpoint collects once, returns AND writes it).
+    ``prefix`` names the bundle family — single-process bundles are
+    ``flight-*``, the fleet incident recorder (obs/fleetobs.py) writes
+    ``fleet-*`` through the same atomic-write + per-family retention."""
     if not flight_enabled():
         return None
     if bundle is None:
@@ -224,7 +238,7 @@ def dump(reason: str, error: BaseException | None = None, *,
         safe = "".join(c if c.isalnum() or c in "-_" else "_"
                        for c in reason)[:48]
         path = os.path.join(
-            directory, f"flight-{time.time_ns()}-{safe}.json")
+            directory, f"{prefix}-{time.time_ns()}-{safe}.json")
     tmp = path + ".tmp"
     try:
         with open(tmp, "w") as f:
@@ -243,7 +257,7 @@ def dump(reason: str, error: BaseException | None = None, *,
         #                      an explicit path is the caller's business
         keep = int(knobs.get_int("OTPU_FLIGHT_MAX"))
         if keep > 0:
-            _prune(os.path.dirname(path) or ".", keep)
+            _prune(os.path.dirname(path) or ".", keep, prefix)
     return path
 
 

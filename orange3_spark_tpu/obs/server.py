@@ -24,6 +24,12 @@ here is a stdlib ``ThreadingHTTPServer`` on a daemon thread exposing:
   200-healthy, so a load balancer acting on this endpoint never ejects
   a backend for a quiet minute.
 
+With a fleet collector attached (``TelemetryServer(fleet=...)``, the
+router-side shape — obs/fleetobs.py), ``/metrics`` serves the FLEET
+exposition (per-replica-labeled series + aggregates) and ``/fleetz``
+the JSON fleet view; ``/debug/spans?trace_id=`` serves this process's
+span-ring payload for cross-process trace assembly either way.
+
 Opt-in by ``OTPU_OBS_PORT`` (0 = ephemeral, for tests): ``ServingContext``
 activation starts it, the last deactivation stops it. Inert under
 ``OTPU_OBS=0`` — the endpoint never binds. Binds 127.0.0.1 only; exposing
@@ -123,6 +129,28 @@ def ready_body(context=None) -> tuple[dict, bool]:
     }, ready
 
 
+def spans_body(path: str) -> dict:
+    """The shared ``GET /debug/spans?trace_id=`` body (this server AND
+    the fleet RPC port): this process's span-ring payload, optionally
+    filtered to the trace id in the query string."""
+    from urllib.parse import parse_qs, urlsplit
+
+    from orange3_spark_tpu.obs import trace
+
+    q = parse_qs(urlsplit(path).query)
+    tid = (q.get("trace_id") or [None])[0] or None
+    return trace.spans_payload(tid)
+
+
+def stacks_body() -> dict:
+    """The shared ``GET /debug/stacks`` body: every thread's Python
+    stack plus the open spans each was inside."""
+    from orange3_spark_tpu.obs import flight, trace
+
+    return {"stacks": flight.thread_stacks(),
+            "open_spans": trace.open_spans()}
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "otpu-obs/1"
 
@@ -143,8 +171,30 @@ class _Handler(BaseHTTPRequestHandler):
             if route == "/metrics":
                 from orange3_spark_tpu.obs.registry import REGISTRY
 
-                self._send(200, REGISTRY.to_prometheus().encode(),
-                           PROM_CONTENT_TYPE)
+                fleet = owner._fleet
+                if fleet is not None:
+                    # the fleet exposition: this process's registry is
+                    # one more source ("router") beside every scraped
+                    # replica, re-labeled + aggregated by the collector
+                    body = fleet.to_prometheus().encode()
+                else:
+                    body = REGISTRY.to_prometheus().encode()
+                self._send(200, body, PROM_CONTENT_TYPE)
+            elif route == "/fleetz":
+                fleet = owner._fleet
+                if fleet is None:
+                    self._send(404, b"no fleet collector attached\n",
+                               "text/plain")
+                else:
+                    self._send(200,
+                               json.dumps(fleet.fleetz(),
+                                          default=str).encode(),
+                               "application/json")
+            elif route == "/debug/spans":
+                self._send(200,
+                           json.dumps(spans_body(self.path),
+                                      default=str).encode(),
+                           "application/json")
             elif route == "/healthz":
                 body, healthy = owner.health()
                 self._send(200 if healthy else 503,
@@ -159,23 +209,18 @@ class _Handler(BaseHTTPRequestHandler):
                 # it; loopback-only like everything on this listener
                 from orange3_spark_tpu.obs import flight
 
-                bundle = flight.collect_bundle(
-                    "debug_endpoint", context=owner._context)
-                path = flight.dump("debug_endpoint", bundle=bundle)
-                bundle["path"] = path
+                bundle = flight.debug_bundle(context=owner._context)
                 self._send(200, json.dumps(bundle, default=str).encode(),
                            "application/json")
             elif route == "/debug/stacks":
-                from orange3_spark_tpu.obs import flight, trace
-
-                body = {"stacks": flight.thread_stacks(),
-                        "open_spans": trace.open_spans()}
-                self._send(200, json.dumps(body, default=str).encode(),
+                self._send(200,
+                           json.dumps(stacks_body(),
+                                      default=str).encode(),
                            "application/json")
             else:
                 self._send(404, b"not found: try /metrics, /healthz, "
-                                b"/readyz, /debug/flight or "
-                                b"/debug/stacks\n",
+                                b"/readyz, /fleetz, /debug/flight, "
+                                b"/debug/stacks or /debug/spans\n",
                            "text/plain")
         except Exception as e:  # noqa: BLE001 - never kill the listener
             try:
@@ -189,11 +234,15 @@ class TelemetryServer:
     """One /metrics + /healthz listener; start() binds, stop() joins."""
 
     def __init__(self, port: int = 0, *, stale_s: float | None = None,
-                 context=None):
+                 context=None, fleet=None):
         self.port = port
         self.stale_s = (stale_s if stale_s is not None
                         else float(knobs.get_float("OTPU_OBS_STALE_S")))
         self._context = context      # owning ServingContext (queue depth)
+        # attached FleetCollector (obs/fleetobs.py): /metrics becomes the
+        # fleet exposition and /fleetz serves the JSON fleet view — the
+        # router-side shape of this server
+        self._fleet = fleet
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 

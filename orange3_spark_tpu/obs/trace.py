@@ -77,6 +77,7 @@ __all__ = [
     "slowest_traces",
     "span",
     "span_iter",
+    "spans_payload",
     "validate_chrome_trace",
 ]
 
@@ -414,6 +415,33 @@ def open_spans() -> list[dict]:
                 "age_ms": round((now - t0) / 1e6, 3), "open": True,
             })
     return out
+
+
+def spans_payload(trace_id: str | None = None,
+                  limit: int = 4096) -> dict:
+    """JSON-able view of this PROCESS's ring (optionally filtered to one
+    trace id) for cross-process trace assembly (obs/fleetobs.py): the
+    fleet ``GET /debug/spans?trace_id=`` body. Ring tuples are process-
+    local ``perf_counter_ns`` values, so the payload carries a
+    wall/perf **clock anchor** sampled at build time — the assembler
+    rebases every timestamp as ``wall_ns + (t_ns - perf_ns)``, putting
+    router- and replica-side spans on one shared wall-clock axis."""
+    evs = events()
+    if trace_id is not None:
+        evs = [e for e in evs if e[6] == trace_id]
+    opened = open_spans()
+    if trace_id is not None:
+        opened = [s for s in opened if s["trace_id"] == trace_id]
+    return {
+        "pid": os.getpid(),
+        "anchor": {"wall_ns": time.time_ns(),
+                   "perf_ns": time.perf_counter_ns()},
+        "events": [[ph, name, t0, dur, ident,
+                    dict(args) if args else None, tid, sid, pid_]
+                   for (ph, name, t0, dur, ident, args, tid, sid, pid_)
+                   in evs[-max(limit, 0):]],
+        "open_spans": opened,
+    }
 
 
 def clear() -> None:

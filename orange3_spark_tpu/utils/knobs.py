@@ -188,6 +188,36 @@ _ALL = [
     Knob("OTPU_TRACE_SLOW_MS", "float", 250.0, "obs",
          "Latency above which an unsampled serve trace is retained "
          "anyway (the tail the ring exists to explain)."),
+    Knob("OTPU_FLEETOBS", "flag", "1", "obs",
+         "Fleet telemetry-plane kill-switch; 0 restores the plain PR-10 "
+         "fleet exactly (no collector scrapes, no router serve spans, no "
+         "SLO samples, no fleet bundles)."),
+    Knob("OTPU_FLEETOBS_SCRAPE_S", "float", 2.0, "obs",
+         "FleetCollector scrape cadence: seconds between /metrics pulls "
+         "from each replica (deterministically jittered ±10% so fleet "
+         "scrapes decorrelate)."),
+    Knob("OTPU_FLEETOBS_STALE_X", "float", 3.0, "obs",
+         "Staleness multiplier: a replica whose last successful scrape is "
+         "older than STALE_X * SCRAPE_S gets its fleet series stale-"
+         "flagged instead of silently frozen."),
+    Knob("OTPU_SLO_SPEC", "str",
+         "availability:target=99.0;latency:target=99.0,p99_ms=1000", "obs",
+         "Declarative SLO specs, ';'-separated name:key=val,... items; "
+         "target= is the good-request percent, p99_ms= makes it a "
+         "latency SLO (a request slower than the bound burns budget)."),
+    Knob("OTPU_SLO_WINDOW_FAST_S", "float", 60.0, "obs",
+         "Fast (paging) burn-rate window in seconds; the confirming "
+         "short window is 1/12 of it (SRE-workbook multi-window rule)."),
+    Knob("OTPU_SLO_WINDOW_SLOW_S", "float", 600.0, "obs",
+         "Slow (ticket) burn-rate window in seconds; the confirming "
+         "short window is 1/12 of it."),
+    Knob("OTPU_SLO_BURN_FAST", "float", 14.4, "obs",
+         "Burn-rate threshold for the fast rule: alert when the error "
+         "budget burns this many times faster than uniform in BOTH the "
+         "fast window and its short confirm window."),
+    Knob("OTPU_SLO_BURN_SLOW", "float", 6.0, "obs",
+         "Burn-rate threshold for the slow rule (same two-window shape "
+         "over the slow window)."),
     Knob("OTPU_FLIGHT", "flag", "1", "obs",
          "Anomaly flight-recorder kill-switch; 0 = typed anomalies write "
          "no bundles (OTPU_OBS=0 disables it too)."),

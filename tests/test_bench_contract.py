@@ -114,7 +114,15 @@ def _run(argv, timeout=420):
       "rollout_failed_requests", "rollback_outcome",
       "rollback_current_untouched", "kill_switch_local_parity",
       "baseline_value", "baseline_note",
-      "traced_requests", "trace_coverage", "flight_bundles_written"}),
+      "traced_requests", "trace_coverage", "flight_bundles_written",
+      # fleet telemetry plane (ISSUE 11): the collector-overhead A/B,
+      # the aggregated fleet snapshot + staleness, the SLO burn drill's
+      # alert + single rate-limited fleet incident bundle, and the
+      # OTPU_FLEETOBS=0 parity pin
+      "collector_overhead_pct", "scrape_stale_replicas",
+      "fleet_agg_rpc_requests", "fleet", "slo_alerts", "slo_burn_long",
+      "slo_budget_remaining", "fleet_incident_bundles",
+      "fleet_bundle_replicas", "fleetobs_kill_switch_parity"}),
     (["bench.py", "--config", "overload"],
      "overload_admission_p99_bound_factor",
      {"p99_ms_admitted", "p99_ms_raw", "p99_bound_factor", "sheds",
@@ -219,6 +227,24 @@ def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
         assert d["rollback_outcome"] == "rolled_back"
         assert d["rollback_current_untouched"] is True
         assert d["kill_switch_local_parity"] is True
+        # fleet telemetry plane (ISSUE 11 acceptance): the collector is
+        # measurably free on the service-bound burst (< 2% same-run A/B,
+        # negative = noise), every replica scraped fresh with the
+        # per-replica rpc counters summing across the fleet, the
+        # injected-overload SLO drill paged and wrote EXACTLY ONE
+        # rate-limited fleet incident bundle carrying every live
+        # replica's flight pull, and OTPU_FLEETOBS=0 served bitwise on
+        # the bare PR-10 path
+        assert d["collector_overhead_pct"] is not None
+        assert d["collector_overhead_pct"] < 2.0, d["collector_overhead_pct"]
+        assert d["scrape_stale_replicas"] == 0
+        assert d["fleet_agg_rpc_requests"] >= d["requests"]
+        assert isinstance(d["fleet"], dict) and d["fleet"]["replicas"]
+        assert d["slo_alerts"] >= 1
+        assert d["slo_burn_long"] >= 14.4   # past the paging threshold
+        assert d["fleet_incident_bundles"] == 1
+        assert d["fleet_bundle_replicas"] == d["replicas"]
+        assert d["fleetobs_kill_switch_parity"] is True
     if "p99_bound_factor" in extra_keys:
         # the overload claims (ISSUE 8 acceptance): under the injected
         # overload trace the admission-controlled arm keeps p99 >= 3x

@@ -1,0 +1,770 @@
+"""obs/fleetobs.py — the fleet telemetry plane (docs/observability.md
+§fleet telemetry).
+
+Four layers of drills:
+
+* pure arithmetic (no sleeps, no processes): the Prometheus parser
+  round-trips the registry's own exposition, the fleet exposition's
+  per-replica labels + aggregates pass the exposition grammar, the SLO
+  burn-rate engine's multi-window math is pinned on a fake clock, the
+  spec grammar rejects typos loudly;
+* fake-client collector drills: staleness flags a dead replica's series
+  instead of freezing them, the incident bundle pulls every live
+  replica's flight data exactly once per rate window, a mid-roll SLO
+  alert rolls a rollout back, the digest reaches the supervisor hook;
+* in-process endpoint drills: /fleetz + the fleet /metrics on the obs
+  server, /debug/spans payload anchoring, the debug proxies on the
+  fleet RPC port, trace assembly over synthetic cross-process payloads;
+* ONE real-subprocess golden drill: a real replica serves one traced
+  predict, the assembled Chrome trace carries router- and replica-side
+  spans under one trace id with a valid cross-process flow link, and
+  the supervisor's kill/restart lands on the labeled lifecycle counter
+  and the fleet timeline.
+
+Plus the loopback-bind lint: every HTTPServer bind site in the source
+tree must bind 127.0.0.1 — a new endpoint cannot accidentally expose
+the fleet.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.obs import fleetobs, trace
+from orange3_spark_tpu.obs.fleetobs import (
+    FleetCollector, SLOEngine, SLOSpec, assemble_trace, parse_prometheus,
+    parse_slo_spec,
+)
+from orange3_spark_tpu.obs.registry import REGISTRY, MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# one metric line (the test_obs.py exposition grammar, shared contract)
+_PROM_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*")*\})?'
+    r' (?:[-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?)|\+Inf|-Inf|NaN)$')
+
+
+def _assert_grammar(text: str) -> None:
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert re.match(
+                r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+$", line), line
+        else:
+            assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
+
+
+class FakeScrapeClient:
+    """In-memory replica for collector drills: serves a scripted
+    exposition text (or raises), and a scripted /debug/flight body."""
+
+    def __init__(self, name: str, text: str = "", flight: dict | None = None):
+        self.name = name
+        self.text = text
+        self.flight = flight if flight is not None else {
+            "flight_schema": 1, "reason": "debug_endpoint",
+            "pid": 1234, "stacks": {}}
+        self.fail = False
+        self.metrics_calls = 0
+        self.flight_calls = 0
+
+    def get_text(self, path, timeout_s=None):
+        assert path == "/metrics"
+        self.metrics_calls += 1
+        if self.fail:
+            raise ConnectionRefusedError("replica gone")
+        return 200, self.text
+
+    def get_json(self, path, timeout_s=None):
+        if path.startswith("/debug/flight"):
+            self.flight_calls += 1
+            if self.fail:
+                raise ConnectionRefusedError("replica gone")
+            return 200, dict(self.flight)
+        return 404, {}
+
+
+def _replica_text(rpc=10, inflight=2.0, shed=0, brownout=0):
+    reg = MetricsRegistry()
+    reg.counter("otpu_fleet_rpc_requests_total", "rpc").inc(rpc)
+    reg.counter("otpu_shed_total", "sheds").inc(shed, reason="queue_full")
+    reg.gauge("otpu_serve_inflight", "inflight").set(inflight)
+    reg.gauge("otpu_brownout_level", "brownout").set(brownout)
+    reg.gauge("otpu_admission_queue_depth", "depth").set(1)
+    h = reg.histogram("otpu_timed_seconds", "timed", buckets=(0.1, 1.0))
+    h.observe(0.05, label="x")
+    h.observe(5.0, label="x")
+    return reg.to_prometheus()
+
+
+# ----------------------------------------------------- prometheus parser
+def test_parse_prometheus_round_trips_registry_exposition():
+    reg = MetricsRegistry()
+    c = reg.counter("p_requests_total", 'doc with "quotes"')
+    c.inc(3, path='/a"b\\c', verb="GET")
+    c.inc(2)
+    reg.gauge("p_depth", "queue depth").set(2.5)
+    h = reg.histogram("p_lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05, route="x")
+    h.observe(5.0, route="x")
+    parsed = parse_prometheus(reg.to_prometheus())
+    assert parsed["p_requests_total"]["type"] == "counter"
+    assert parsed["p_requests_total"]["values"][()] == 2.0
+    key = (("path", '/a"b\\c'), ("verb", "GET"))
+    assert parsed["p_requests_total"]["values"][key] == 3.0
+    assert parsed["p_depth"] == {"type": "gauge", "values": {(): 2.5}}
+    hv = parsed["p_lat_seconds"]["values"][(("route", "x"),)]
+    assert hv["bounds"] == [0.1, 1.0, math.inf]
+    assert hv["cum"] == [1, 1, 2]          # cumulative, +Inf == count
+    assert hv["count"] == 2 and hv["sum"] == pytest.approx(5.05)
+
+
+# --------------------------------------------------- fleet exposition
+def test_fleet_exposition_labels_aggregates_and_grammar():
+    clients = [FakeScrapeClient("replica-0", _replica_text(10, 2.0)),
+               FakeScrapeClient("replica-1", _replica_text(30, 5.0))]
+    col = FleetCollector(clients, scrape_s=10.0)
+    col.scrape_once()
+    text = col.to_prometheus(include_local=False)
+    _assert_grammar(text)
+    lines = text.splitlines()
+    # per-replica labels plus the counter-sum aggregate
+    assert 'otpu_fleet_rpc_requests_total{replica="replica-0"} 10' in lines
+    assert 'otpu_fleet_rpc_requests_total{replica="replica-1"} 30' in lines
+    assert 'otpu_fleet_rpc_requests_total{replica="_fleet"} 40' in lines
+    # gauges aggregate per-replica + max/min (the ISSUE-11 contract)
+    assert 'otpu_serve_inflight{agg="max",replica="_fleet"} 5' in lines
+    assert 'otpu_serve_inflight{agg="min",replica="_fleet"} 2' in lines
+    # histograms merge buckets (cumulative counts stay cumulative)
+    assert ('otpu_timed_seconds_bucket{label="x",le="+Inf",'
+            'replica="_fleet"} 4') in lines
+    assert [ln for ln in lines
+            if ln.startswith("# TYPE otpu_timed_seconds ")] \
+        == ["# TYPE otpu_timed_seconds histogram"]
+    # ONE TYPE line per metric even with two sources + aggregates
+    types = [ln for ln in lines if ln.startswith("# TYPE ")]
+    assert len(types) == len(set(types))
+    # the fleetz JSON view agrees with the aggregate
+    fz = col.fleetz()
+    assert fz["aggregates"]["otpu_fleet_rpc_requests_total"] == 40.0
+    assert fz["replicas"]["replica-0"]["up"] is True
+
+
+def test_fleet_exposition_replica_label_collision_uses_scraped_from():
+    reg = MetricsRegistry()
+    reg.gauge("otpu_fleet_inflight", "per-replica").set(
+        3, replica="replica-9")
+    col = FleetCollector(
+        [FakeScrapeClient("replica-0", reg.to_prometheus())],
+        scrape_s=10.0)
+    col.scrape_once()
+    text = col.to_prometheus(include_local=False)
+    _assert_grammar(text)
+    assert ('otpu_fleet_inflight{replica="replica-9",'
+            'scraped_from="replica-0"} 3') in text
+    # the aggregate keeps the child's own replica label too — never two
+    # replica= labels in one series
+    assert ('otpu_fleet_inflight{agg="max",replica="replica-9",'
+            'scraped_from="_fleet"} 3') in text
+
+
+def test_scrape_staleness_flags_dead_replica_not_frozen():
+    t = [100.0]
+    ok = FakeScrapeClient("replica-0", _replica_text(5, 1.0))
+    dead = FakeScrapeClient("replica-1", _replica_text(7, 9.0))
+    col = FleetCollector([ok, dead], scrape_s=1.0, stale_x=3.0,
+                         clock=lambda: t[0])
+    before = REGISTRY.get("otpu_fleetobs_scrapes_total").value(
+        replica="replica-1", outcome="error")
+    col.scrape_once()
+    assert col.stale_replicas() == []
+    # the replica dies; scrapes keep failing while the clock advances
+    dead.fail = True
+    for _ in range(4):
+        t[0] += 1.0
+        col.scrape_once()
+    assert col.stale_replicas() == ["replica-1"]
+    assert REGISTRY.get("otpu_fleetobs_scrapes_total").value(
+        replica="replica-1", outcome="error") == before + 4
+    assert REGISTRY.get("otpu_fleetobs_stale_replicas").value() == 1
+    text = col.to_prometheus(include_local=False)
+    _assert_grammar(text)
+    # last-known series survive, STALE-FLAGGED — never silently frozen
+    assert ('otpu_fleet_rpc_requests_total{replica="replica-1",'
+            'stale="1"} 7') in text
+    # counters still sum (monotonic); gauges drop the stale replica
+    assert 'otpu_fleet_rpc_requests_total{replica="_fleet"} 12' in text
+    assert 'otpu_serve_inflight{agg="max",replica="_fleet"} 1' in text
+    fz = col.fleetz()
+    assert fz["replicas"]["replica-1"]["stale"] is True
+    assert fz["replicas"]["replica-1"]["last_error"]
+    assert col.digest().stale_replicas == 1
+
+
+# ------------------------------------------------------------ SLO engine
+def test_slo_spec_grammar_and_errors():
+    specs = parse_slo_spec(
+        "availability:target=99.9;p99:target=99,p99_ms=250")
+    assert [s.name for s in specs] == ["availability", "p99"]
+    assert specs[0].target == pytest.approx(0.999)
+    assert specs[0].p99_ms is None
+    assert (specs[1].target, specs[1].p99_ms) == (0.99, 250.0)
+    assert specs[0].kind == "availability" and specs[1].kind == "latency"
+    assert specs[1].good(True, 0.2) and not specs[1].good(True, 0.3)
+    assert not specs[1].good(False, 0.001)       # an error burns latency SLOs
+    assert parse_slo_spec("") == []
+    for bad in ("noparams", "x:frobnicate=1", "x:target=abc",
+                "x:target=0", "x:p99_ms=5"):
+        with pytest.raises(ValueError):
+            parse_slo_spec(bad)
+
+
+def test_slo_burn_rate_multi_window_pinned_on_fake_clock():
+    """The burn arithmetic and the two-window rule, exactly: burn =
+    (bad/total)/(1-target); the fast rule needs BOTH the 60s window and
+    its 5s confirm window over threshold — a historic burst with a
+    clean recent window must NOT page (the workbook's reason for the
+    confirm window)."""
+    t = [1000.0]
+    # burn_slow deliberately ABOVE the drill's 20x burn so exactly one
+    # rule (fast) fires and the rising-edge count is pinned at 1
+    eng = SLOEngine([SLOSpec("avail", 0.99)], fast_s=60.0, slow_s=600.0,
+                    burn_fast=10.0, burn_slow=30.0, clock=lambda: t[0])
+    burn0 = REGISTRY.get("otpu_slo_burn_total").value(
+        slo="avail", rule="fast")
+    # 20% bad over the fast window: burn = 0.2 / 0.01 = 20 >= 10; the
+    # first record is GOOD so record()'s opportunistic evaluate sees a
+    # clean window and the alert arithmetic is pinned at the explicit
+    # evaluate below, not mid-feed
+    for i in range(40):
+        eng.record(i < 32, 0.01)
+    v = eng.evaluate()[0]
+    assert v["rules"]["fast"]["burn_long"] == pytest.approx(20.0)
+    assert v["rules"]["fast"]["alerting"] is True
+    assert v["alerting"] is True
+    assert len(eng.alerts) == 1 and eng.alerts[0].rule == "fast"
+    assert REGISTRY.get("otpu_slo_burn_total").value(
+        slo="avail", rule="fast") == burn0 + 1
+    # budget remaining over the slow window: 8 bad / (40 * 0.01) = 20x
+    # overspent -> clamped to 0
+    assert v["budget_remaining"] == 0.0
+    assert REGISTRY.get("otpu_slo_budget_remaining").value(
+        slo="avail") == 0.0
+    # sustained alert = ONE rising edge, not one per evaluation
+    eng.evaluate()
+    assert len(eng.alerts) == 1
+    # 30s later the 5s confirm window is clean: burn_long still high,
+    # but the rule must de-assert (and re-arm for the next real burn)
+    t[0] += 30.0
+    for _ in range(20):
+        eng.record(True, 0.01)
+    v = eng.evaluate()[0]
+    assert v["rules"]["fast"]["burn_long"] > 10.0   # history still burns
+    assert v["rules"]["fast"]["burn_short"] == 0.0
+    assert v["rules"]["fast"]["alerting"] is False
+    assert len(eng.alerts) == 1
+    # events past the slow window age out entirely
+    t[0] += 1000.0
+    eng.record(True, 0.01)
+    v = eng.evaluate()[0]
+    assert v["rules"]["slow"]["burn_long"] == 0.0
+    assert v["budget_remaining"] == 1.0
+
+
+def test_slo_latency_spec_burns_on_slow_requests():
+    t = [50.0]
+    eng = SLOEngine([SLOSpec("p99", 0.99, p99_ms=100.0)],
+                    fast_s=12.0, slow_s=60.0, burn_fast=14.4,
+                    burn_slow=6.0, clock=lambda: t[0])
+    for _ in range(30):
+        eng.record(True, 0.5)            # completed but 5x the bound
+    v = eng.evaluate()[0]
+    assert v["rules"]["fast"]["burn_long"] == pytest.approx(100.0)
+    assert any(a.slo == "p99" for a in eng.alerts)
+
+
+def test_slo_alert_rolls_back_a_live_rollout(tmp_path):
+    """The ISSUE-11 wiring: a burn-rate alert firing DURING a roll
+    counts like a tripped canary breaker — the fleet rolls back and
+    CURRENT never moves."""
+    from orange3_spark_tpu.fleet import rollout as ro
+    from orange3_spark_tpu.fleet.router import FleetRouter, ReplicaEndpoint
+
+    t = [10.0]
+    eng = SLOEngine([SLOSpec("avail", 0.99)], fast_s=12.0, slow_s=60.0,
+                    burn_fast=10.0, burn_slow=6.0, clock=lambda: t[0])
+
+    class RollFake:
+        def __init__(self, name):
+            self.name = name
+            self.reloads: list = []
+
+        def post_json(self, path, obj=None, *, timeout_s=None):
+            self.reloads.append(obj["version"])
+            # live traffic starts burning budget the moment v2 serves
+            for _ in range(20):
+                eng.record(False, 0.01)
+            return 200, {"version": obj["version"]}
+
+        def predict(self, X, *, trace_id=None, timeout_s=None,
+                    conn_slot=None):
+            return np.asarray(X)[:, 0], {}
+
+        def ready(self, *, timeout_s=None):
+            return True, {"ready": True,
+                          "version": self.reloads[-1]
+                          if self.reloads else "v0001"}
+
+    root = str(tmp_path / "models")
+    os.makedirs(os.path.join(root, "v0002"))
+    ro._atomic_write(os.path.join(root, ro.CURRENT_FILE), "v0001\n")
+    eps = []
+    for i in range(2):
+        ep = ReplicaEndpoint(i, "127.0.0.1", 0,
+                             client=RollFake(f"replica-{i}"))
+        ep.ready = True
+        eps.append(ep)
+    router = FleetRouter(eps, hedging=False)
+    res = ro.Rollout(router, root, canary_input=np.ones((2, 2), np.float32),
+                     canary_n=1, timeout_s=5.0, slo_engine=eng,
+                     ).roll("v0002")
+    assert res["outcome"] == "rolled_back"
+    assert "slo" in res["error"].lower() or "burn" in res["error"].lower()
+    # replica 0 flipped then was restored; replica 1 untouched
+    assert eps[0].client.reloads == ["v0002", "v0001"]
+    assert eps[1].client.reloads == []
+    assert ro.read_current(root) == "v0001"
+    router.close()
+
+
+# ---------------------------------------------------- incident bundles
+def test_fleet_incident_bundle_pulls_live_replicas_rate_limited(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("OTPU_FLIGHT_DIR", str(tmp_path / "flight"))
+    fleetobs.reset_fleet_rate_limit()
+    ok = FakeScrapeClient("replica-0", _replica_text())
+    dead = FakeScrapeClient("replica-1", _replica_text())
+    dead.fail = True
+    clients = [("replica-0", ok), ("replica-1", dead)]
+    path = fleetobs.auto_fleet_dump("slo_avail_fast", clients,
+                                    digest={"x": 1}, slo=[])
+    assert path and os.path.exists(path)
+    assert os.path.basename(path).startswith("fleet-")
+    with open(path) as f:
+        bundle = json.load(f)
+    assert bundle["fleet_flight_schema"] == 1
+    assert bundle["reason"] == "slo_avail_fast"
+    # the router's OWN bundle rides along, schema-complete
+    assert bundle["router"]["flight_schema"] == 1
+    assert "stacks" in bundle["router"] and "registry" in bundle["router"]
+    # every LIVE replica's flight pull; the dead one contributes its
+    # transport error, not silence
+    assert bundle["live_replicas"] == ["replica-0"]
+    assert bundle["replicas"]["replica-0"]["flight_schema"] == 1
+    assert "pull_error" in bundle["replicas"]["replica-1"]
+    assert bundle["digest"] == {"x": 1}
+    # the rate limit: a second alert inside the window writes NOTHING
+    assert fleetobs.auto_fleet_dump("slo_avail_slow", clients) is None
+    assert ok.flight_calls == 1
+    fleetobs.reset_fleet_rate_limit()
+    assert fleetobs.auto_fleet_dump("slo_avail_slow", clients) is not None
+
+
+def test_fleet_dump_inert_under_kill_switches(tmp_path, monkeypatch):
+    monkeypatch.setenv("OTPU_FLIGHT_DIR", str(tmp_path / "flight"))
+    fleetobs.reset_fleet_rate_limit()
+    clients = [("replica-0", FakeScrapeClient("replica-0"))]
+    monkeypatch.setenv("OTPU_FLEETOBS", "0")
+    assert fleetobs.auto_fleet_dump("slo_x_fast", clients) is None
+    monkeypatch.setenv("OTPU_FLEETOBS", "1")
+    monkeypatch.setenv("OTPU_FLIGHT", "0")
+    assert fleetobs.auto_fleet_dump("slo_x_fast", clients) is None
+
+
+def test_collector_alert_hook_writes_one_bundle(tmp_path, monkeypatch):
+    """End to end without processes: router-fed SLO engine pages, the
+    collector's alert hook writes exactly one fleet bundle."""
+    monkeypatch.setenv("OTPU_FLIGHT_DIR", str(tmp_path / "flight"))
+    fleetobs.reset_fleet_rate_limit()
+    t = [10.0]
+    eng = SLOEngine([SLOSpec("avail", 0.99)], fast_s=12.0, slow_s=60.0,
+                    burn_fast=10.0, burn_slow=6.0, clock=lambda: t[0])
+    clients = [FakeScrapeClient("replica-0", _replica_text())]
+    col = FleetCollector(clients, slo=eng, scrape_s=10.0,
+                         clock=lambda: t[0])
+    for _ in range(30):
+        eng.record(False, 0.01)
+    eng.evaluate()
+    col.join_incident_dump()      # the dump runs on a dedicated thread
+    assert col.last_incident_path and os.path.exists(col.last_incident_path)
+    with open(col.last_incident_path) as f:
+        bundle = json.load(f)
+    assert bundle["live_replicas"] == ["replica-0"]
+    assert bundle["extra"]["alert"]["slo"] == "avail"
+    only = [n for n in os.listdir(str(tmp_path / "flight"))
+            if n.startswith("fleet-")]
+    assert len(only) == 1, only           # both rules fired, ONE bundle
+
+
+# --------------------------------------------------------- digest hook
+def test_digest_published_on_supervisor_hook(tmp_path):
+    from orange3_spark_tpu.fleet.supervisor import ReplicaManager
+
+    mgr = ReplicaManager(str(tmp_path), n_replicas=2)   # never started
+    seen: list = []
+    mgr.on_digest(seen.append)
+    col = FleetCollector(
+        [FakeScrapeClient("replica-0", _replica_text(5, 1.0, shed=3)),
+         FakeScrapeClient("replica-1", _replica_text(9, 4.0,
+                                                     brownout=2))],
+        supervisor=mgr, scrape_s=10.0)
+    digest = col.scrape_once()
+    assert mgr.latest_digest() is digest and seen == [digest]
+    by_name = {r.replica: r for r in digest.replicas}
+    assert by_name["replica-0"].shed_total == 3.0
+    assert by_name["replica-0"].inflight == 1.0
+    assert by_name["replica-1"].brownout_level == 2.0
+    assert by_name["replica-1"].rpc_requests == 9.0
+    d = digest.to_dict()
+    assert {"at_wall", "replicas", "ewma_p95_ms", "slo",
+            "stale_replicas"} <= set(d)
+    json.dumps(d)                         # the autoscaler-facing contract
+
+
+# ------------------------------------------------------ trace assembly
+def test_assemble_trace_rebases_clocks_and_links_processes():
+    """Pure assembly: a real router-side span plus a synthetic payload
+    from a 'replica' with a DIFFERENT perf-clock origin land on one
+    wall-clock axis, each in its own pid lane, with the xproc flow pair
+    linking serve -> dispatch — and the result validates."""
+    from orange3_spark_tpu.obs.context import propagated_scope
+
+    tid = "fleet-cafe-777777"
+    with propagated_scope(tid, "fleet"):
+        with trace.span("serve", kind="fleet"):
+            time.sleep(0.002)
+    router_payload = trace.spans_payload(tid)
+    assert router_payload["events"], "router serve span not in the ring"
+    assert {"wall_ns", "perf_ns"} <= set(router_payload["anchor"])
+    # synthetic replica: perf clock starts at ~0 (a fresh process), its
+    # serve_dispatch ran 1ms after the router span's wall start
+    wall_now = time.time_ns()
+    replica_payload = {
+        "pid": 999999, "anchor": {"wall_ns": wall_now, "perf_ns": 0},
+        "events": [
+            ["X", "serve", 1_000_000, 4_000_000, 1,
+             {"kind": "array"}, tid, 71, None],
+            ["X", "serve_dispatch", 2_000_000, 1_000_000, 1,
+             None, tid, 72, 71],
+        ],
+        "open_spans": [],
+    }
+    obj = assemble_trace(tid, [("router", router_payload),
+                               ("replica-0", replica_payload)])
+    evs = trace.validate_chrome_trace(obj)
+    pids = {e["pid"] for e in evs if e["ph"] == "X"}
+    assert os.getpid() in pids and 999999 in pids
+    for e in evs:
+        if e["ph"] == "X":
+            assert e["args"]["trace_id"] == tid
+    # process lanes are named
+    names = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+    assert {"router", "replica-0"} <= names
+    # the cross-process flow: s inside the router's serve, f inside the
+    # replica's DISPATCH (innermost preferred), same id
+    flows = [e for e in evs if e["name"] == "xproc"]
+    assert sorted(e["ph"] for e in flows) == ["f", "s"]
+    s = next(e for e in flows if e["ph"] == "s")
+    f = next(e for e in flows if e["ph"] == "f")
+    assert s["pid"] == os.getpid() and f["pid"] == 999999
+    assert s["id"] == f["id"] == tid
+    # clock rebasing: the replica dispatch's wall timestamp lands within
+    # a second of the router span's (same wall clock, different origins)
+    router_serve = next(e for e in evs if e["ph"] == "X"
+                        and e["pid"] == os.getpid()
+                        and e["name"] == "serve")
+    replica_disp = next(e for e in evs if e["ph"] == "X"
+                        and e["name"] == "serve_dispatch")
+    assert abs(router_serve["ts"] - replica_disp["ts"]) < 2e6  # < 2 s
+
+
+# -------------------------------------------- obs-server fleet endpoints
+def test_obs_server_serves_fleet_metrics_fleetz_and_spans():
+    from orange3_spark_tpu.obs.server import TelemetryServer
+
+    col = FleetCollector(
+        [FakeScrapeClient("replica-0", _replica_text(42, 1.0))],
+        scrape_s=10.0)
+    col.scrape_once()
+    srv = TelemetryServer(0, fleet=col).start()
+    try:
+        def get(path):
+            with urllib.request.urlopen(srv.url + path, timeout=5) as r:
+                return r.status, r.read().decode()
+
+        status, text = get("/metrics")
+        assert status == 200
+        _assert_grammar(text)
+        # the fleet exposition: scraped series labeled, local registry
+        # riding as replica="router", aggregates computed
+        assert 'otpu_fleet_rpc_requests_total{replica="replica-0"} 42' \
+            in text
+        assert 'replica="router"' in text
+        assert 'otpu_fleet_rpc_requests_total{replica="_fleet"}' in text
+        status, body = get("/fleetz")
+        fz = json.loads(body)
+        assert status == 200 and fz["fleetz_schema"] == 1
+        assert fz["replicas"]["replica-0"]["up"] is True
+        assert fz["digest"]["replicas"][0]["rpc_requests"] == 42.0
+        status, body = get("/debug/spans?trace_id=no-such-trace")
+        payload = json.loads(body)
+        assert status == 200 and payload["pid"] == os.getpid()
+        assert payload["events"] == []
+        assert {"wall_ns", "perf_ns"} <= set(payload["anchor"])
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------- RPC debug proxies
+def test_rpc_port_proxies_debug_endpoints(tmp_path, monkeypatch):
+    """Satellite: the replica's black box — /debug/flight, /debug/stacks,
+    /debug/spans — served off the SAME loopback data port as /predict,
+    no second listener needed (a stub runtime; the real-subprocess path
+    is the golden test below)."""
+    from orange3_spark_tpu.fleet.rpc import FleetClient, ReplicaServer
+
+    monkeypatch.setenv("OTPU_FLIGHT_DIR", str(tmp_path / "flight"))
+
+    class StubRuntime:
+        name = "stub"
+        version = "v0001"
+        draining = False
+        in_flight = 0
+        serving_context = None
+
+    server = ReplicaServer(StubRuntime(), 0).start_background()
+    try:
+        client = FleetClient("127.0.0.1", server.port, name="stub")
+        status, body = client.get_json("/debug/stacks")
+        assert status == 200 and body["stacks"]
+        assert any("MainThread" in k for k in body["stacks"])
+        status, body = client.get_json("/debug/flight")
+        assert status == 200 and body["flight_schema"] == 1
+        assert body["path"] and os.path.exists(body["path"])
+        status, body = client.get_json("/debug/spans?trace_id=zzz")
+        assert status == 200 and body["pid"] == os.getpid()
+        assert body["events"] == [] and "anchor" in body
+    finally:
+        server.shutdown()
+
+
+# ------------------------------------------------------- kill-switch
+def test_fleetobs_kill_switch_restores_pr10_router(monkeypatch):
+    """OTPU_FLEETOBS=0: no collector thread, no router serve span, no
+    SLO sample — and the routed answer is bitwise the PR-10 one."""
+    from orange3_spark_tpu.fleet.router import FleetRouter, ReplicaEndpoint
+
+    class EchoClient:
+        name = "replica-0"
+
+        def predict(self, X, *, trace_id=None, timeout_s=None,
+                    conn_slot=None):
+            return np.asarray(X)[:, 0], {}
+
+        def ready(self, *, timeout_s=None):
+            return True, {"ready": True}
+
+    def build():
+        ep = ReplicaEndpoint(0, "127.0.0.1", 0, client=EchoClient())
+        ep.ready = True
+        eng = SLOEngine([SLOSpec("avail", 0.99)])
+        return FleetRouter([ep], hedging=False, slo=eng), eng
+
+    X = np.arange(12, dtype=np.float32).reshape(4, 3)
+    router_on, eng_on = build()
+    on = router_on.predict(X)
+    assert sum(b["total"] for b in eng_on._buckets.values()) == 1
+    router_on.close()
+
+    monkeypatch.setenv("OTPU_FLEETOBS", "0")
+    assert fleetobs.fleetobs_enabled() is False
+    trace.clear()
+    router_off, eng_off = build()
+    off = router_off.predict(X)
+    np.testing.assert_array_equal(on, off)
+    assert eng_off._buckets == {}                  # no SLO sample
+    assert not any(e[1] == "serve" for e in trace.events())  # no span
+    col = FleetCollector([FakeScrapeClient("replica-0")]).start()
+    assert col.active is False                     # no scrape thread
+    router_off.close()
+
+
+# -------------------------------------------------- loopback-bind lint
+def test_every_httpserver_bind_site_is_loopback_only():
+    """Grep every HTTPServer construction in the source tree: the bind
+    address must be the 127.0.0.1 literal — a new fleet/obs endpoint
+    cannot accidentally listen beyond the host (exposure is a reverse
+    proxy's job, never a data-plane library's)."""
+    sites = []
+    roots = [os.path.join(REPO, "orange3_spark_tpu"),
+             os.path.join(REPO, "tools")]
+    for root in roots:
+        for dirpath, _dirs, names in os.walk(root):
+            if "__pycache__" in dirpath:
+                continue
+            for n in names:
+                if not n.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, n)
+                with open(path, encoding="utf-8") as f:
+                    text = f.read()
+                for m in re.finditer(r"HTTPServer\(", text):
+                    window = text[m.end():m.end() + 120]
+                    if window.lstrip().startswith(")"):
+                        continue          # bare reference, not a bind
+                    sites.append((os.path.relpath(path, REPO),
+                                  '"127.0.0.1"' in window, window))
+    assert len(sites) >= 2, "HTTPServer grep found nothing — pattern rot?"
+    bad = [(p, w) for p, ok, w in sites if not ok]
+    assert not bad, (
+        f"HTTPServer bind sites without the 127.0.0.1 literal: {bad} — "
+        "fleet/obs listeners are loopback-only by contract")
+
+
+# ---------------------------------------------------- fleet_top smoke
+def test_fleet_top_smoke(session):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "fleet_top", os.path.join(REPO, "tools", "fleet_top.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.run_top(session=session, requests=4)
+    assert {"digest", "slo", "staleness", "fleetz"} <= set(out)
+    rows = out["digest"]["replicas"]
+    assert len(rows) == 1 and rows[0]["up"] is True
+    assert rows[0]["rpc_requests"] >= 4
+    assert out["digest"]["stale_replicas"] == 0
+    assert out["fleetz"]["fleetz_schema"] == 1
+    assert not any(v["alerting"] for v in out["slo"])
+
+
+# ----------------------------------------------- golden subprocess drill
+def _fit_hashed(session, n_dims=1 << 10):
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+
+    rng = np.random.default_rng(3)
+    X = np.concatenate([
+        rng.standard_normal((4096, 4)).astype(np.float32),
+        rng.integers(0, 500, (4096, 4)).astype(np.float32),
+    ], axis=1)
+    y = (rng.random(4096) < 0.3).astype(np.float32)
+    model = StreamingHashedLinearEstimator(
+        n_dims=n_dims, n_dense=4, n_cat=4, epochs=1, step_size=0.05,
+        chunk_rows=1024,
+    ).fit_stream(array_chunk_source(X, y, chunk_rows=1024),
+                 session=session)
+    return model, X
+
+
+def test_golden_cross_process_trace_assembly_and_lifecycle(
+        tmp_path, session):
+    """THE ISSUE-11 acceptance drill, real subprocess: one traced fleet
+    predict assembles into ONE Chrome trace holding router- and
+    replica-side spans under the same trace id with a valid xproc flow
+    link (validate_chrome_trace-checked); the replica's black box is
+    pulled through its data port; and the supervisor's kill/restart
+    lands on otpu_fleet_restarts_total{replica=,reason=} and the fleet
+    timeline."""
+    from orange3_spark_tpu.fleet import rollout as ro
+    from orange3_spark_tpu.fleet.router import FleetRouter
+    from orange3_spark_tpu.fleet.supervisor import ReplicaManager
+
+    model, X = _fit_hashed(session)
+    root = str(tmp_path / "models")
+    ro.publish_version(model, root, n_cols=8)
+    mgr = ReplicaManager(
+        root, n_replicas=1, ladder_max=256,
+        env={"JAX_PLATFORMS": "cpu",
+             "OTPU_FLIGHT_DIR": str(tmp_path / "flight")})
+    mgr.start()
+    try:
+        assert mgr.wait_ready(timeout_s=90), "replica never ready"
+        router = FleetRouter(mgr.endpoints(), hedging=False)
+        router.refresh()
+        collector = FleetCollector(mgr.endpoints(), router=router,
+                                   supervisor=mgr, scrape_s=5.0)
+        out = router.predict(X[:96])
+        assert out.shape == (96,)
+        # the router-side serve span in OUR ring names the trace id
+        serve_evs = [e for e in trace.events()
+                     if e[0] == "X" and e[1] == "serve" and e[6]
+                     and e[6].startswith("fleet-")]
+        assert serve_evs, "router recorded no fleet serve span"
+        tid = max(serve_evs, key=lambda e: e[2])[6]
+        assembled = collector.assemble_trace(tid)
+        evs = trace.validate_chrome_trace(assembled)       # (a) valid
+        router_pid = os.getpid()
+        router_spans = [e for e in evs if e["ph"] == "X"
+                        and e["pid"] == router_pid]
+        replica_spans = [e for e in evs if e["ph"] == "X"
+                         and e["pid"] != router_pid]
+        assert any(e["name"] == "serve" for e in router_spans)
+        assert any(e["name"] == "serve" for e in replica_spans), (
+            "replica-side spans missing from the assembled trace")
+        # (b) every span shares the router-minted trace id
+        for e in router_spans + replica_spans:
+            assert e["args"]["trace_id"] == tid
+        # (c) the cross-process flow event links them
+        flows = [e for e in evs if e["name"] == "xproc"]
+        assert sorted(e["ph"] for e in flows) == ["f", "s"]
+        s = next(e for e in flows if e["ph"] == "s")
+        f = next(e for e in flows if e["ph"] == "f")
+        assert s["pid"] == router_pid and f["pid"] != router_pid
+        assert s["id"] == f["id"] == tid
+        # the replica's black box off the data port (satellite)
+        status, bundle = mgr.client(0).get_json("/debug/flight",
+                                                timeout_s=10.0)
+        assert status == 200 and bundle["flight_schema"] == 1
+        status, stacks = mgr.client(0).get_json("/debug/stacks",
+                                                timeout_s=10.0)
+        assert status == 200 and stacks["stacks"]
+        # the fleet view over the real replica
+        collector.scrape_once()
+        assert collector.stale_replicas() == []
+        digest = mgr.latest_digest()
+        assert digest is not None and digest.replicas[0].up is True
+        assert digest.replicas[0].rpc_requests >= 1
+        # ---- supervised kill: the labeled lifecycle counter + timeline
+        m = REGISTRY.get("otpu_fleet_restarts_total")
+        kills0 = m.value(replica="replica-0", reason="kill")
+        crashes0 = m.value(replica="replica-0", reason="crash")
+        mgr.kill(0)
+        deadline = time.monotonic() + 45
+        while time.monotonic() < deadline:
+            if m.value(replica="replica-0", reason="crash") > crashes0:
+                break
+            time.sleep(0.2)
+        assert m.value(replica="replica-0", reason="kill") == kills0 + 1
+        assert m.value(replica="replica-0", reason="crash") > crashes0
+        names = [e[1] for e in trace.events() if e[0] == "i"]
+        assert "replica_kill" in names and "replica_restart" in names
+        router.close()
+    finally:
+        mgr.stop_all()
+    drains = REGISTRY.get("otpu_fleet_restarts_total").value(
+        replica="replica-0", reason="drain")
+    assert drains >= 1

@@ -1,0 +1,172 @@
+"""One-shot fleet telemetry dashboard — the FleetDigest, rendered.
+
+``top`` for the serving fleet: scrape every replica's ``/metrics``
+through the fleet collector (obs/fleetobs.py), then print one table of
+the load signals ROADMAP item 3's autoscaler consumes — per-replica
+in-flight, admission queue depth, shed total, brownout rung, RPC count,
+scrape staleness — plus the router's EWMA-p95 and the SLO burn-rate
+verdicts.
+
+Two modes:
+
+* **attach** (``--endpoints host:port,host:port``): scrape a LIVE fleet
+  you already run — no model, no subprocesses, read-only;
+* **demo** (default): fit a tiny CTR model, serve it from an in-process
+  replica runtime on a loopback port, drive a few predicts through a
+  hedged router with an SLO engine attached, and render the digest that
+  produces — the zero-setup way to see the fleet plane work (and the
+  tier-1 smoke in tests/test_fleetobs.py).
+
+Importable: ``run_top(...)`` returns ``{"digest", "slo", "staleness",
+"fleetz"}``.
+
+Usage:
+    python tools/fleet_top.py [--endpoints H:P,H:P] [--requests 8]
+                              [--watch SECONDS]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _render(digest: dict, slo: list, out=sys.stderr) -> None:
+    rows = digest["replicas"]
+    hdr = (f"{'replica':<14} {'up':<3} {'stale':<5} {'age_s':>6} "
+           f"{'inflt':>5} {'queue':>5} {'shed':>6} {'brown':>5} "
+           f"{'rpc':>8}")
+    print(f"[fleet-top] {hdr}", file=out)
+    for r in rows:
+        age = "-" if r["scrape_age_s"] is None else f"{r['scrape_age_s']:.1f}"
+        print(f"[fleet-top] {r['replica']:<14} "
+              f"{'y' if r['up'] else 'n':<3} "
+              f"{'Y' if r['stale'] else '.':<5} {age:>6} "
+              f"{r['inflight']:>5.0f} {r['queue_depth']:>5.0f} "
+              f"{r['shed_total']:>6.0f} {r['brownout_level']:>5.0f} "
+              f"{r['rpc_requests']:>8.0f}", file=out)
+    p95 = digest.get("ewma_p95_ms")
+    print(f"[fleet-top] router ewma_p95_ms="
+          f"{'-' if p95 is None else p95} "
+          f"stale_replicas={digest['stale_replicas']}", file=out)
+    for v in slo:
+        fast = v["rules"]["fast"]
+        print(f"[fleet-top] slo {v['slo']:<14} ({v['kind']}) "
+              f"burn_fast={fast['burn_long']:.2f} "
+              f"budget={v['budget_remaining']:.3f} "
+              f"{'ALERT' if v['alerting'] else 'ok'}", file=out)
+
+
+def run_top(session=None, *, requests: int = 8, endpoints=None,
+            scrape_s: float = 0.5) -> dict:
+    """One collection cycle → rendered table + the structured views."""
+    import numpy as np
+
+    from orange3_spark_tpu.fleet.rpc import FleetClient
+    from orange3_spark_tpu.fleet.router import FleetRouter
+    from orange3_spark_tpu.obs.fleetobs import FleetCollector, SLOEngine
+
+    runtime = router = None
+    tmp_root = None
+    try:
+        if endpoints:
+            clients = [FleetClient(h, int(p), name=f"{h}:{p}")
+                       for h, p in (e.split(":") for e in endpoints)]
+            collector = FleetCollector(clients, scrape_s=scrape_s)
+        else:
+            # demo fleet: one in-process replica runtime on loopback
+            from orange3_spark_tpu.core.session import TpuSession
+            from orange3_spark_tpu.fleet.replica import ReplicaRuntime
+            from orange3_spark_tpu.fleet.rollout import publish_version
+            from orange3_spark_tpu.io.streaming import array_chunk_source
+            from orange3_spark_tpu.models.hashed_linear import (
+                StreamingHashedLinearEstimator,
+            )
+            from orange3_spark_tpu.serve import BucketLadder
+
+            session = session or TpuSession.builder_get_or_create()
+            rng = np.random.default_rng(5)
+            X = np.concatenate([
+                rng.standard_normal((2048, 4)).astype(np.float32),
+                rng.integers(0, 500, (2048, 4)).astype(np.float32),
+            ], axis=1)
+            y = (rng.random(2048) < 0.3).astype(np.float32)
+            model = StreamingHashedLinearEstimator(
+                n_dims=1 << 10, n_dense=4, n_cat=4, epochs=1,
+                step_size=0.05, chunk_rows=1024,
+            ).fit_stream(array_chunk_source(X, y, chunk_rows=1024),
+                         session=session)
+            tmp_root = tempfile.mkdtemp(prefix="otpu-fleet-top-")
+            publish_version(model, tmp_root, n_cols=8)
+            runtime = ReplicaRuntime(
+                tmp_root, name="replica-0", session=session,
+                ladder=BucketLadder(min_bucket=64, max_bucket=256))
+            runtime.activate()
+            server = runtime.serve_background()
+            slo = SLOEngine()
+            router = FleetRouter([(0, "127.0.0.1", server.port)],
+                                 hedging=False, slo=slo)
+            router.refresh()
+            collector = FleetCollector(
+                router.endpoints, router=router, slo=slo,
+                scrape_s=scrape_s)
+            for _ in range(max(requests, 1)):
+                router.predict(X[:96])
+        digest = collector.scrape_once()
+        fleetz = collector.fleetz()
+        _render(digest.to_dict(), fleetz["slo"])
+        return {
+            "digest": digest.to_dict(),
+            "slo": fleetz["slo"],
+            "staleness": collector.staleness(),
+            "fleetz": fleetz,
+        }
+    finally:
+        if router is not None:
+            router.close()
+        if runtime is not None:
+            runtime.close()
+        if tmp_root is not None:
+            import shutil
+
+            shutil.rmtree(tmp_root, ignore_errors=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--endpoints", default="",
+                    help="comma-separated host:port list of a LIVE fleet "
+                         "to attach to (default: spin the demo fleet)")
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--watch", type=float, default=0.0,
+                    help="re-render every N seconds until ^C (attach "
+                         "mode only; 0 = one shot)")
+    args = ap.parse_args()
+    sys.path.insert(0, REPO)
+    eps = [e for e in args.endpoints.split(",") if e.strip()]
+    if args.watch > 0 and eps:
+        try:
+            while True:
+                run_top(endpoints=eps, requests=args.requests)
+                time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return
+    out = run_top(endpoints=eps or None, requests=args.requests)
+    print(json.dumps({
+        "metric": "fleet_top",
+        "value": len(out["digest"]["replicas"]),
+        "unit": "replicas",
+        "vs_baseline": None,
+        "stale_replicas": out["digest"]["stale_replicas"],
+        "slo_alerting": any(v["alerting"] for v in out["slo"]),
+    }, default=str))
+
+
+if __name__ == "__main__":
+    main()

@@ -41,8 +41,48 @@ def _tree_lines(node: dict, depth: int = 0, out: list | None = None) -> list:
     return out
 
 
+def render_fleet(bundle: dict, *, stack_tail: int = 6) -> str:
+    """Human-readable report of one FLEET incident bundle
+    (obs/fleetobs.py ``fleet-*.json``): the alert line, per-replica
+    flight summaries, the digest, then the router's own bundle in
+    full."""
+    lines = [f"== fleet incident bundle "
+             f"(schema {bundle.get('fleet_flight_schema')}) "
+             f"pid {bundle.get('pid')} ==",
+             f"reason:   {bundle.get('reason')}",
+             f"live:     {bundle.get('live_replicas')}"]
+    alert = (bundle.get("extra") or {}).get("alert")
+    if alert:
+        lines.append(f"alert:    slo={alert.get('slo')} "
+                     f"rule={alert.get('rule')} "
+                     f"burn={alert.get('burn_long'):.2f} "
+                     f"budget={alert.get('budget_remaining'):.3f}")
+    digest = bundle.get("digest") or {}
+    for r in digest.get("replicas", ()):
+        lines.append(
+            f"  {r['replica']:<14} up={r['up']} stale={r['stale']} "
+            f"inflight={r['inflight']:.0f} queue={r['queue_depth']:.0f} "
+            f"shed={r['shed_total']:.0f} brownout="
+            f"{r['brownout_level']:.0f}")
+    for name, rb in sorted((bundle.get("replicas") or {}).items()):
+        if "pull_error" in rb:
+            lines.append(f"-- {name}: UNREACHABLE ({rb['pull_error']}) --")
+            continue
+        lines.append(f"-- {name}: reason={rb.get('reason')} "
+                     f"trace={rb.get('trace_id')} "
+                     f"open_spans={len(rb.get('open_spans') or [])} "
+                     f"events={len(rb.get('events') or [])} --")
+    router = bundle.get("router")
+    if router:
+        lines.append("== router-side bundle ==")
+        lines.append(render(router, stack_tail=stack_tail))
+    return "\n".join(lines)
+
+
 def render(bundle: dict, *, stack_tail: int = 6) -> str:
     """Human-readable report of one flight bundle."""
+    if "fleet_flight_schema" in bundle:
+        return render_fleet(bundle, stack_tail=stack_tail)
     lines = []
     err = bundle.get("error") or {}
     lines.append(f"== flight bundle (schema {bundle.get('flight_schema')}) "
@@ -121,13 +161,23 @@ def main() -> int:
         from orange3_spark_tpu.utils import knobs as _knobs
 
         directory = args.dir or _knobs.get_str("OTPU_FLIGHT_DIR")
-        names = sorted(n for n in os.listdir(directory)
-                       if n.startswith("flight-") and n.endswith(".json")
-                       ) if os.path.isdir(directory) else []
+        names = [n for n in os.listdir(directory)
+                 if (n.startswith("flight-") or n.startswith("fleet-"))
+                 and n.endswith(".json")] if os.path.isdir(directory) else []
         if not names:
             print(f"no flight bundles in {directory}", file=sys.stderr)
             return 1
-        path = os.path.join(directory, names[-1])
+
+        def _ns(name: str) -> int:
+            # flight-<ns>-<reason>.json / fleet-<ns>-<reason>.json —
+            # newest across BOTH families, by write timestamp not by the
+            # prefix's alphabetical accident
+            try:
+                return int(name.split("-", 2)[1])
+            except (IndexError, ValueError):
+                return 0
+
+        path = os.path.join(directory, max(names, key=_ns))
     with open(path) as f:
         bundle = json.load(f)
     print(render(bundle))
