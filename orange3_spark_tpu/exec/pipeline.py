@@ -34,6 +34,7 @@ import time
 from typing import Callable, Iterator
 
 from orange3_spark_tpu.obs import context as obs_context
+from orange3_spark_tpu.obs import prof
 from orange3_spark_tpu.obs.trace import span
 from orange3_spark_tpu.utils.dispatch import beat
 
@@ -121,7 +122,13 @@ class PipelinedExecutor:
             while True:
                 t0 = time.perf_counter()
                 got = q.get()
-                stats.wait_s += time.perf_counter() - t0
+                dt_wait = time.perf_counter() - t0
+                stats.wait_s += dt_wait
+                # goodput attribution (obs/prof.py): the consumer is the
+                # fit's thread of control, so this wait IS input_wait —
+                # fed live (not at stream end) so per-epoch bottleneck
+                # classification sees intra-epoch waits
+                prof.note_input_wait(dt_wait)
                 if (isinstance(got, tuple) and len(got) == 2
                         and got[0] is _EOF):
                     if got[1] is not None:

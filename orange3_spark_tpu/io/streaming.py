@@ -27,6 +27,7 @@ difference.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import os
 import uuid
 import warnings
@@ -42,6 +43,7 @@ from orange3_spark_tpu.core.session import TpuSession
 from orange3_spark_tpu.exec.donate import donating_jit
 from orange3_spark_tpu.exec.pipeline import PipelineStats, prefetch_iter
 from orange3_spark_tpu.io.multihost import put_sharded
+from orange3_spark_tpu.obs import prof
 from orange3_spark_tpu.obs.report import RunReport
 from orange3_spark_tpu.obs.trace import refreshed_enabled as obs_enabled
 from orange3_spark_tpu.obs.trace import span, span_iter, traced
@@ -601,6 +603,10 @@ class StreamingLinearParams(Params):
     cache_dtype: str = "f32"     # 'f32' | 'bf16' | 'packed' | 'auto'
 
 
+#: per-process ledger-entry numbering for _DeviceCache instances
+_CACHE_LEDGER_SEQ = itertools.count()
+
+
 class _DeviceCache:
     """Epoch-1 HBM batch cache shared by the streaming estimators — one
     place for the budget/degrade rule: batches accumulate until ``budget``
@@ -637,6 +643,19 @@ class _DeviceCache:
         self.degraded = False
         self.offered = 0           # total offer() calls
         self.first_miss: int | None = None   # ordinal of the first miss
+        # device-memory ledger entry (obs/prof.py owner "cache_chunks"):
+        # codec-aware bytes, updated on every nbytes change, released by
+        # finalize when the cache dies (an aborted fit leaks no entry;
+        # the GC-safe deferred form — finalizers must not take the
+        # ledger lock)
+        self.ledger_key = f"chunk_cache-{next(_CACHE_LEDGER_SEQ)}"
+        import weakref
+
+        weakref.finalize(self, prof.ledger_release_on_gc, "cache_chunks",
+                         self.ledger_key)
+
+    def _ledger_sync(self) -> None:
+        prof.ledger_set("cache_chunks", self.ledger_key, self.nbytes)
 
     def offer(self, batch: tuple) -> None:
         if not self.enabled:
@@ -656,6 +675,7 @@ class _DeviceCache:
             self.batches = []
             self.nbytes = 0
             self.first_miss = None
+            self._ledger_sync()
             return
         budget = self.budget // 2 if lvl == 1 else self.budget
         sz = self._size(batch)
@@ -663,6 +683,7 @@ class _DeviceCache:
                 and self.nbytes + sz <= budget):
             self.batches.append(batch)
             self.nbytes += sz
+            self._ledger_sync()
         else:
             if self.first_miss is None:
                 self.first_miss = self.offered - 1
@@ -674,6 +695,7 @@ class _DeviceCache:
                 self.batches = []
                 self.nbytes = 0  # honest accounting for downstream gates
                 self.first_miss = None
+                self._ledger_sync()
 
     def forgive_tail(self, k: int) -> None:
         """The last ``k`` offers were excluded from training (holdout):
@@ -708,6 +730,7 @@ class _DeviceCache:
             else:
                 kept.append(b)
         self.batches = kept
+        self._ledger_sync()
 
     def settle(self) -> None:
         """End-of-ingest resolution: a cache still missing batches cannot
@@ -720,6 +743,7 @@ class _DeviceCache:
             self.batches = []
             self.nbytes = 0
             self.first_miss = None
+            self._ledger_sync()
 
 
 def _spill_cleanup(f, path: str, named: list) -> None:
@@ -1406,6 +1430,9 @@ class StreamingKMeans(Estimator):
         report = (RunReport("fit_stream", estimator=type(self).__name__,
                             k=p.k, epochs=p.epochs)
                   if obs_enabled() else None)
+        # goodput accountant (obs/prof.py): wall decomposition fed by
+        # the dispatch/prefetch chokepoints; None under OTPU_PROF=0
+        acc = prof.begin_fit()
         from orange3_spark_tpu.resilience.retry import resilient_source
 
         source = resilient_source(source)
@@ -1555,6 +1582,7 @@ class StreamingKMeans(Estimator):
                               estimator="StreamingKMeans")
         model = KMeansModel(KMeansParams(k=p.k), centers)
         model.n_iter_ = n_steps
+        prof.attach_fit_report(report, acc, cache_key=cache.ledger_key)
         if report is not None:
             report.stage_times["n_steps"] = n_steps
             model.run_report_ = report.finish()
@@ -1614,6 +1642,9 @@ class StreamingLinearEstimator(Estimator):
         report = (RunReport("fit_stream", estimator=type(self).__name__,
                             loss=p.loss, epochs=p.epochs)
                   if obs_enabled() else None)
+        # goodput accountant (obs/prof.py): wall decomposition fed by
+        # the dispatch/prefetch chokepoints; None under OTPU_PROF=0
+        acc = prof.begin_fit()
         from orange3_spark_tpu.resilience.retry import resilient_source
 
         # THE source chokepoint (docs/resilience.md): fault injection +
@@ -1874,6 +1905,7 @@ class StreamingLinearEstimator(Estimator):
         model = self._wrap_model(theta, k, class_values)
         model.n_steps_ = n_steps
         model.final_loss_ = float(last_loss) if last_loss is not None else None
+        prof.attach_fit_report(report, acc, cache_key=cache.ledger_key)
         if report is not None:
             report.stage_times["n_steps"] = n_steps
             report.stage_times["replay_source"] = (

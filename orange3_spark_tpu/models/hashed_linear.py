@@ -45,6 +45,7 @@ TPU-native redesign — fixed-nnz-per-row, not CSR:
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import time
 from functools import partial
 from typing import Callable, Iterator
@@ -72,6 +73,7 @@ from orange3_spark_tpu.optim.sparse import (
     plan_packed_field_shapes, resolve_optim_update, resolve_sparse_lowering,
     sparse_embedding_update, unpack_plan,
 )
+from orange3_spark_tpu.obs import prof
 from orange3_spark_tpu.obs.report import RunReport
 from orange3_spark_tpu.obs.trace import span, span_iter, traced
 from orange3_spark_tpu.obs.trace import refreshed_enabled as obs_enabled
@@ -81,6 +83,9 @@ from orange3_spark_tpu.utils.profiling import count_dispatch
 
 # unit-lr adam; the traced lr scales its updates (see io/streaming.py)
 _ADAM_UNIT = optax.adam(1.0)
+
+#: per-process ledger-entry numbering for hashed fits (obs/prof.py)
+_FIT_LEDGER_SEQ = itertools.count()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1314,12 +1319,29 @@ class StreamingHashedLinearEstimator(Estimator):
         report = (RunReport("fit_stream", estimator=type(self).__name__,
                             n_dims=p.n_dims, epochs=p.epochs)
                   if obs_enabled() else None)
+        # goodput accountant (obs/prof.py): per-epoch bottleneck
+        # classification + the five-way wall decomposition; None under
+        # OTPU_PROF=0 (every downstream hook no-ops on the contextvar)
+        acc = prof.begin_fit()
         session = session or TpuSession.active()
         k = _effective_k(p)
         n_cols = _chunk_cols(p)
         theta, opt_state, salts_np, salts, static_kw = _init_fit_state(
             p, session
         )
+        # device-memory ledger: the table + optimizer slots are the
+        # other big HBM tenant beside the chunk cache — named so an
+        # OOM-adjacent post-mortem can tell table growth from cache
+        # growth. Re-set to theta-only at fit end (slots die with the
+        # fit); released when the fitted model itself dies.
+        state_key = f"hashed-{next(_FIT_LEDGER_SEQ)}"
+        prof.ledger_set("model_state", state_key,
+                        prof.tree_device_bytes((theta, opt_state)))
+        # frame-scoped guard: a fit that ABORTS (divergence, wedge,
+        # retry exhaustion) must not strand its model_state entry — the
+        # guard's death releases it; the success tail detaches it and
+        # hands ownership to the model's own finalizer
+        _state_guard = prof.ledger_guard("model_state", state_key)
         resume_from = 0
         ckpt_meta = {"params": p.to_dict(), "k": k}
         # epoch-cadence snapshots (checkpoint_every_epochs): the shared
@@ -1850,8 +1872,20 @@ class StreamingHashedLinearEstimator(Estimator):
             )
             if times is not None:
                 if honest_walls and last_loss is not None:
+                    t_bar = time.perf_counter()
                     jax.block_until_ready(last_loss)  # honest epoch wall
+                    # an explicit epoch barrier is synchronization, not
+                    # device pace (the periodic sync already charged that)
+                    prof.note_sync(time.perf_counter() - t_bar,
+                                   barrier=True)
                 epoch_walls.append(time.perf_counter() - t_epoch)
+            if acc is not None:
+                # close the goodput window: per-epoch stage deltas +
+                # hysteresis bottleneck classification (obs/prof.py)
+                acc.epoch_boundary(
+                    epoch,
+                    encode_s=pipe_stats.encode_s
+                    + (times or {}).get("plan_s", 0.0))
             if (epoch == 0 and fuse_replay and cache.enabled
                     and cache.batches
                     and 2 * cache.nbytes <= cache_device_bytes
@@ -1881,6 +1915,17 @@ class StreamingHashedLinearEstimator(Estimator):
                 # the sparse 'plan' lowering
                 stacks = jax.tree.map(
                     lambda *xs: jnp.stack(xs), *cache.batches)
+                # the stack is a SECOND device copy of the cache (chunk
+                # arrays + sparse plans) — a distinct ledger tenant for
+                # exactly as long as it lives. Name keyed per FIT (two
+                # concurrent replays must not share one entry); the
+                # guard releases on an aborted replay (device OOM while
+                # holding the copy is THE likely failure here), the
+                # explicit release below makes its firing a no-op
+                rp_key = f"replay_stack-{state_key}"
+                _rp_guard = prof.ledger_guard("replay_plans", rp_key)
+                prof.ledger_set("replay_plans", rp_key,
+                                prof.tree_device_bytes(stacks))
                 if p.replay_granularity == "epoch":
                     # one n_epochs=1 scan dispatch per epoch over the same
                     # stack — the tunnel-fragility middle ground (see the
@@ -1919,8 +1964,19 @@ class StreamingHashedLinearEstimator(Estimator):
                     last_loss = chunk_losses[-1, -1]
                     n_steps += n_rep * spe
                 del stacks
+                prof.ledger_release("replay_plans", rp_key)
+                t_bar = time.perf_counter()
                 jax.block_until_ready(last_loss)
+                # this block drains the WHOLE fused replay — it is the
+                # one place the driver observes the replay's device
+                # compute, so it charges device_compute, not sync_wait
+                prof.note_sync(time.perf_counter() - t_bar)
                 replay_fused_s = time.perf_counter() - t_rep
+                if acc is not None:
+                    acc.epoch_boundary(
+                        p.epochs - 1,
+                        encode_s=pipe_stats.encode_s
+                        + (times or {}).get("plan_s", 0.0))
                 if times is not None:
                     epoch_walls.append(replay_fused_s)
                 break
@@ -1990,6 +2046,24 @@ class StreamingHashedLinearEstimator(Estimator):
         model.device_chunks_ = cache.batches if cache_device else None
         model.holdout_chunks_ = holdout if holdout_chunks > 0 else None
         model.cache_codec_ = codec   # evaluate_device's decode key
+        # ledger: the optimizer slots die with the fit — the entry
+        # shrinks to the table itself and lives as long as the model
+        # (the abort guard hands ownership to the model's finalizer)
+        _state_guard.finalizer.detach()
+        prof.ledger_set("model_state", state_key,
+                        prof.tree_device_bytes(theta))
+        import weakref
+
+        weakref.finalize(model, prof.ledger_release_on_gc, "model_state",
+                         state_key)
+        # freeze the goodput decomposition + the ledger view into the
+        # report's goodput/device_memory sections (obs/prof.py);
+        # cache_key names THIS fit's cache entry so the bench can
+        # cross-check it against the legacy cache_bytes stage key
+        prof.attach_fit_report(
+            report, acc,
+            encode_s=pipe_stats.encode_s + (times or {}).get("plan_s", 0.0),
+            cache_key=cache.ledger_key)
         if report is not None:
             model.run_report_ = report.add(n_steps=n_steps).finish()
         if checkpointer is not None:

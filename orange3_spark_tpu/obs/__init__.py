@@ -27,6 +27,11 @@ Six pieces, one kill-switch (``OTPU_OBS=0``):
   replica's scrape, cross-process trace assembly, the SLO burn-rate
   engine, fleet incident bundles and the FleetDigest load-signal
   snapshot (docs/observability.md §fleet telemetry).
+* ``prof``      — the goodput & memory attribution plane (its own
+  kill-switch, ``OTPU_PROF``): five-way step-time decomposition with
+  per-epoch bottleneck classification, the named device-memory ledger
+  (``otpu_device_bytes{owner=}``), and on-demand deep-profile capture
+  (``POST /debug/profile``) — docs/observability.md §goodput.
 """
 
 from orange3_spark_tpu.obs.registry import (  # noqa: F401

@@ -634,6 +634,13 @@ class ReplicaLoad:
     brownout_level: float = 0.0
     rpc_requests: float = 0.0
     router_inflight: int | None = None
+    # goodput & memory attribution plane (obs/prof.py, scraped off the
+    # replica's own gauges): per-stage wall fractions of its last fit
+    # (None until one ran) and live device bytes per ledger owner —
+    # tools/fleet_top.py renders both, the ROADMAP-3 autoscaler reads
+    # device_bytes as the capacity half of its load signal
+    goodput: dict | None = None
+    device_bytes: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -673,6 +680,20 @@ def _values_total(parsed: dict, name: str) -> float:
     if not m or m["type"] == "histogram":
         return 0.0
     return float(sum(m["values"].values()))
+
+
+def _values_by_label(parsed: dict, name: str, label: str) -> dict:
+    """{label value: metric value} for one label dimension of one scraped
+    metric (the per-owner/per-stage view of the prof-plane gauges)."""
+    m = parsed.get(name)
+    if not m or m["type"] == "histogram":
+        return {}
+    out: dict = {}
+    for key, v in m["values"].items():
+        for k, val in key:
+            if k == label:
+                out[val] = out.get(val, 0.0) + float(v)
+    return out
 
 
 # =========================================================== collector
@@ -1008,6 +1029,8 @@ class FleetCollector:
                 up = sc is not None and sc.at != -math.inf
                 age = (None if not up else self.clock() - sc.at)
                 samples = sc.samples if sc else {}
+                goodput = _values_by_label(
+                    samples, "otpu_goodput_fraction", "stage")
                 loads.append(ReplicaLoad(
                     replica=name, up=up, stale=name in stale,
                     scrape_age_s=(round(age, 3)
@@ -1021,6 +1044,9 @@ class FleetCollector:
                     rpc_requests=_values_total(
                         samples, "otpu_fleet_rpc_requests_total"),
                     router_inflight=router_inflight.get(name),
+                    goodput=goodput or None,
+                    device_bytes=_values_by_label(
+                        samples, "otpu_device_bytes", "owner"),
                 ))
         return FleetDigest(
             at_wall=time.time(), scrape_s=self.scrape_s, replicas=loads,

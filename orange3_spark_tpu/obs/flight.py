@@ -185,6 +185,17 @@ def collect_bundle(reason: str, error: BaseException | None = None,
         "knobs": knobs.resolved(),
         "stacks": thread_stacks(),
     }
+    try:
+        # device-memory ledger table (obs/prof.py): an OOM-adjacent
+        # brownout post-mortem finally names the tenant. Best-effort —
+        # and the ledger itself is cheap to snapshot (one lock, no IO)
+        from orange3_spark_tpu.obs.prof import LEDGER
+
+        dm = LEDGER.snapshot()
+        dm["reconciliation"] = LEDGER.reconcile()
+        bundle["device_memory"] = dm
+    except Exception:  # noqa: BLE001 - diagnostics only
+        pass
     bundle.update(_control_plane(context))
     if extra:
         bundle["extra"] = extra

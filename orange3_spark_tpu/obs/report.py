@@ -26,7 +26,15 @@ from __future__ import annotations
 import json
 import time
 
-__all__ = ["RunReport", "counter_families"]
+__all__ = ["REPORT_SCHEMA_VERSION", "RunReport", "counter_families"]
+
+#: bumped when the report dict gains/changes sections. 2 = the goodput
+#: (step-time decomposition) and device_memory (ledger) sections from
+#: obs/prof.py — both ABSENT (not null) under OTPU_PROF=0, so a
+#: schema-1 consumer reading a kill-switched process sees the schema-1
+#: keys plus only this version marker (emitted unconditionally — a
+#: versioned dict must always say which version it is).
+REPORT_SCHEMA_VERSION = 2
 
 #: derived ratio fields recomputed by the shims — meaningless to delta
 _DERIVED = {"overlap_pct", "pad_overhead", "mb_merge_factor"}
@@ -81,6 +89,10 @@ class RunReport:
         self.wall_s: float | None = None
         self.counters: dict | None = None
         self.slow_traces: list | None = None
+        # obs/prof.py sections (attach_fit_report): the wall-time
+        # decomposition and the device-memory ledger view at fit end
+        self.goodput: dict | None = None
+        self.device_memory: dict | None = None
 
     def _slow_traces(self) -> list:
         """Top-k slowest trace trees among spans recorded since this run
@@ -116,7 +128,8 @@ class RunReport:
             wall = round(time.perf_counter() - self._t0, 6)
             counters = _delta(self._c0, counter_families())
             slow = self._slow_traces()
-        return {
+        out = {
+            "report_schema": REPORT_SCHEMA_VERSION,
             "kind": self.kind,
             "meta": dict(self.meta),
             "started_at": self.started_at,
@@ -125,6 +138,11 @@ class RunReport:
             "counters": counters,
             "slow_traces": slow,
         }
+        if self.goodput is not None:
+            out["goodput"] = self.goodput
+        if self.device_memory is not None:
+            out["device_memory"] = self.device_memory
+        return out
 
     def to_json(self, path: str | None = None, **dump_kw) -> str:
         text = json.dumps(self.to_dict(), default=str, **dump_kw)

@@ -50,6 +50,7 @@ __all__ = [
     "is_draining",
     "maybe_start_from_env",
     "note_warmup_complete",
+    "profile_capture_body",
     "ready_body",
     "reset_readiness",
     "set_draining",
@@ -151,6 +152,43 @@ def stacks_body() -> dict:
             "open_spans": trace.open_spans()}
 
 
+def profile_capture_body(path: str) -> tuple[int, dict]:
+    """The ``POST /debug/profile?duration_ms=`` body (obs/prof.py deep
+    capture): status mapping is part of the contract — 503 under the
+    ``OTPU_PROF=0`` kill-switch, 409 while another capture runs
+    (captures serialize), 429 inside the ``OTPU_PROF_RATE_S`` window,
+    200 with the artifact path. The response is a summary, not the full
+    snapshot — the artifact dir holds the real thing."""
+    from urllib.parse import parse_qs, urlsplit
+
+    from orange3_spark_tpu.obs import prof
+
+    q = parse_qs(urlsplit(path).query)
+    raw = (q.get("duration_ms") or [None])[0]
+    try:
+        duration_ms = float(raw) if raw not in (None, "") else 500.0
+    except ValueError:
+        return 400, {"error": "bad_duration_ms", "duration_ms": raw}
+    try:
+        out = prof.capture(duration_ms, reason="debug_endpoint")
+    except prof.CaptureDisabledError as e:
+        return 503, {"error": "prof_disabled", "message": str(e)}
+    except prof.CaptureBusyError as e:
+        return 409, {"error": "capture_busy", "message": str(e)}
+    except prof.CaptureRateLimitedError as e:
+        return 429, {"error": "rate_limited", "message": str(e)}
+    except Exception as e:  # noqa: BLE001 - typed to the caller
+        return 500, {"error": type(e).__name__, "message": str(e)[:500]}
+    snap = out["snapshot"]
+    return 200, {
+        "path": out["path"],
+        "reason": out["reason"],
+        "duration_ms": out["duration_ms"],
+        "ledger_total_bytes": snap["ledger"]["total_bytes"],
+        "goodput": snap["goodput"],
+    }
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "otpu-obs/1"
 
@@ -220,7 +258,28 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send(404, b"not found: try /metrics, /healthz, "
                                 b"/readyz, /fleetz, /debug/flight, "
-                                b"/debug/stacks or /debug/spans\n",
+                                b"/debug/stacks, /debug/spans or "
+                                b"POST /debug/profile\n",
+                           "text/plain")
+        except Exception as e:  # noqa: BLE001 - never kill the listener
+            try:
+                self._send(500, f"{type(e).__name__}: {e}\n".encode(),
+                           "text/plain")
+            except Exception:  # noqa: BLE001 - client went away
+                pass
+
+    def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        try:
+            route = self.path.split("?")[0]
+            if route == "/debug/profile":
+                # on-demand deep capture (obs/prof.py): loopback-only
+                # like everything on this listener, serialized (409),
+                # rate-limited (429), refused under OTPU_PROF=0 (503)
+                code, body = profile_capture_body(self.path)
+                self._send(code, json.dumps(body, default=str).encode(),
+                           "application/json")
+            else:
+                self._send(404, b"not found: POST /debug/profile\n",
                            "text/plain")
         except Exception as e:  # noqa: BLE001 - never kill the listener
             try:

@@ -23,16 +23,47 @@ from __future__ import annotations
 
 import threading
 import time
+import zlib
 from collections import OrderedDict
 from concurrent.futures import Future
 from typing import Any, Callable
 
+from orange3_spark_tpu.obs import prof
 from orange3_spark_tpu.utils.profiling import record_serve
 
 _MISSING = object()
 #: countless LRU placeholder for keys that own no executable (pad-path
 #: buckets, failed builds); never returned as a build product
 _PAD_MARKER = "pad-marker"
+
+
+def _ledger_name(key) -> str:
+    """Stable short ledger-entry name for one cache key (keys are long
+    tuples carrying fingerprints/shardings — the crc names the entry,
+    the bytes are what the post-mortem reads)."""
+    return f"exe-{zlib.crc32(repr(key).encode()) & 0xFFFFFFFF:08x}"
+
+
+def _entry_device_bytes(entry) -> int:
+    """Best-effort device bytes of one cached build product: AOT
+    executables report via ``memory_analysis()`` where the backend
+    implements it (temp + output buffers — the serving-path residency);
+    anything else counts 0 but still appears as a named tenant."""
+    objs = entry if isinstance(entry, (tuple, list)) else (entry,)
+    total = 0
+    for obj in objs:
+        ma = getattr(obj, "memory_analysis", None)
+        if not callable(ma):
+            continue
+        try:
+            m = ma()
+            total += int(getattr(m, "temp_size_in_bytes", 0) or 0)
+            total += int(getattr(m, "output_size_in_bytes", 0) or 0)
+            total += int(getattr(m, "generated_code_size_in_bytes", 0)
+                         or 0)
+        except Exception:  # noqa: BLE001 - sizing is best-effort
+            continue
+    return total
 
 
 def _build_resilient(key, build):
@@ -130,6 +161,12 @@ class ExecutableCache:
             raise
         dt = time.perf_counter() - t0
         evicted = []
+        # size OUTSIDE the lock (memory_analysis can walk HLO), but
+        # ledger set/release INSIDE it: they must serialize with a
+        # concurrent clear()/mark() eviction of the same key, or a
+        # delayed set re-creates the entry for an executable the cache
+        # no longer holds (lock order is always cache -> ledger)
+        nbytes = _entry_device_bytes(entry)
         with self._lock:
             record_serve(aot_misses=1, aot_compile_s=dt)
             self._entries[key] = entry
@@ -138,6 +175,14 @@ class ExecutableCache:
                 evicted.append(self._entries.popitem(last=False)[0])
             if evicted:
                 record_serve(aot_evictions=len(evicted))
+            # device-memory ledger (obs/prof.py): every cached
+            # executable is a named serve_executables tenant, released
+            # when it leaves the cache (eviction, mark-forced eviction,
+            # or clear)
+            prof.ledger_set("serve_executables", _ledger_name(key),
+                            nbytes)
+            for k in evicted:
+                prof.ledger_release("serve_executables", _ledger_name(k))
         fut.set_result(entry)
         if self.on_evict is not None:
             for k in evicted:
@@ -160,6 +205,8 @@ class ExecutableCache:
                 evicted.append(self._entries.popitem(last=False)[0])
             if evicted:
                 record_serve(aot_evictions=len(evicted))
+            for k in evicted:
+                prof.ledger_release("serve_executables", _ledger_name(k))
         if self.on_evict is not None:
             for k in evicted:
                 self.on_evict(k)
@@ -168,6 +215,8 @@ class ExecutableCache:
         with self._lock:
             dropped = list(self._entries)
             self._entries.clear()
+            for k in dropped:
+                prof.ledger_release("serve_executables", _ledger_name(k))
         if self.on_evict is not None:
             # same contract as LRU eviction: every dropped key fires, so
             # the owning context releases its per-model/per-graph pins

@@ -218,6 +218,24 @@ _ALL = [
     Knob("OTPU_SLO_BURN_SLOW", "float", 6.0, "obs",
          "Burn-rate threshold for the slow rule (same two-window shape "
          "over the slow window)."),
+    Knob("OTPU_PROF", "flag", "1", "obs",
+         "Goodput & memory-attribution plane kill-switch; 0 restores the "
+         "pre-prof behavior bitwise: no goodput accounting, no device-"
+         "memory ledger ticks, deep capture refused (503)."),
+    Knob("OTPU_PROF_DIR", "str", "/tmp/otpu_prof", "obs",
+         "Directory on-demand deep-profile capture artifacts "
+         "(capture-<ns>-<reason>/ dirs) are written to, atomically."),
+    Knob("OTPU_PROF_RATE_S", "float", 60.0, "obs",
+         "Min seconds between deep-profile captures (the /debug/profile "
+         "endpoint answers 429 inside the window; captures are also "
+         "serialized — one at a time, 409 while one runs)."),
+    Knob("OTPU_PROF_MAX_MS", "float", 10000.0, "obs",
+         "Ceiling on the duration_ms a /debug/profile capture may hold "
+         "the jax profiler open (longer requests are clamped)."),
+    Knob("OTPU_PROF_HYST", "float", 0.1, "obs",
+         "Bottleneck-classifier hysteresis: a challenger stage must beat "
+         "the incumbent's wall fraction by this margin before an epoch's "
+         "classification flips (no flapping at the boundary)."),
     Knob("OTPU_FLIGHT", "flag", "1", "obs",
          "Anomaly flight-recorder kill-switch; 0 = typed anomalies write "
          "no bundles (OTPU_OBS=0 disables it too)."),

@@ -283,8 +283,18 @@ def xla_compile_count() -> int:
 
 @contextlib.contextmanager
 def profile_trace(log_dir: str):
-    """Device+host profile into log_dir (view with TensorBoard's profile tab)."""
-    with jax.profiler.trace(log_dir):
+    """Device+host profile into log_dir (view with TensorBoard's profile
+    tab). Routes through the deep-capture path (obs/prof.py): serialized
+    with every other capture (a second concurrent profile raises
+    ``CaptureBusyError`` instead of corrupting the jax profiler's global
+    session), rate-limited by ``OTPU_PROF_RATE_S``, written ATOMICALLY
+    (trace lands in a tmp sibling, renamed complete) with a
+    ``snapshot.json`` (goodput + ledger + registry + knobs) beside the
+    device profile. ``OTPU_PROF=0`` restores the bare
+    ``jax.profiler.trace`` wrapper, bitwise."""
+    from orange3_spark_tpu.obs.prof import trace_capture
+
+    with trace_capture(log_dir):
         yield
 
 

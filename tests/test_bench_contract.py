@@ -66,7 +66,11 @@ def _run(argv, timeout=420):
       "cache_step_speedup", "encode_s",
       # obs A/B (ISSUE 7): the same-run spans+registry-on vs OTPU_OBS=0
       # step arm, and the embedded registry snapshot
-      "obs_overhead_pct", "pure_step_ms_obs", "obs"}),
+      "obs_overhead_pct", "pure_step_ms_obs", "obs",
+      # goodput & memory attribution (ISSUE 12): the five-way wall
+      # decomposition, the device-memory ledger, and the same-run
+      # OTPU_PROF on/off step A/B
+      "goodput", "ledger", "prof_overhead_pct", "pure_step_ms_prof"}),
     (["bench_suite.py", "--config", "5", "--rows-scale", "0.002"],
      "taxi_kmeans_pca_pipeline",
      {"staged_speedup", "workflow_fit_s"}),
@@ -122,7 +126,10 @@ def _run(argv, timeout=420):
       "collector_overhead_pct", "scrape_stale_replicas",
       "fleet_agg_rpc_requests", "fleet", "slo_alerts", "slo_burn_long",
       "slo_budget_remaining", "fleet_incident_bundles",
-      "fleet_bundle_replicas", "fleetobs_kill_switch_parity"}),
+      "fleet_bundle_replicas", "fleetobs_kill_switch_parity",
+      # goodput & memory attribution (ISSUE 12): the parent fit's
+      # decomposition + per-replica device-bytes via the fleet digest
+      "goodput", "ledger"}),
     (["bench.py", "--config", "overload"],
      "overload_admission_p99_bound_factor",
      {"p99_ms_admitted", "p99_ms_raw", "p99_bound_factor", "sheds",
@@ -188,6 +195,35 @@ def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
         else:
             assert d.get("probe_error"), \
                 "obs A/B arm missing without a probe_error explanation"
+    if "prof_overhead_pct" in extra_keys:
+        # the ISSUE-12 criteria, semantics not just schema: the goodput
+        # fractions PARTITION the fit wall (sum 1.0 ± 0.02, contract-
+        # gated), the ledger's cache entry agrees with the legacy
+        # cache_bytes key within 1%, and the same-run OTPU_PROF on/off
+        # step A/B stays < 2% (negative = noise, accounting free)
+        gp = d["goodput"]
+        assert isinstance(gp, dict) and gp["fractions"], gp
+        s = sum(gp["fractions"].values())
+        assert abs(s - 1.0) <= 0.02, gp["fractions"]
+        assert set(gp["fractions"]) == {
+            "device_compute", "input_wait", "host_encode", "sync_wait",
+            "framework"}
+        assert gp["bottleneck"] in (
+            "input_bound", "compute_bound", "sync_bound",
+            "framework_bound")
+        led = d["ledger"]
+        assert isinstance(led, dict) and isinstance(led["owners"], dict)
+        if d.get("cache_bytes") and led.get("cache_entry_bytes"):
+            rel = abs(led["cache_entry_bytes"] - d["cache_bytes"]) \
+                / d["cache_bytes"]
+            assert rel <= 0.01, (led["cache_entry_bytes"],
+                                 d["cache_bytes"])
+        if d.get("prof_overhead_pct") is not None:
+            assert d["prof_overhead_pct"] < 2.0, d["prof_overhead_pct"]
+            assert d["pure_step_ms_prof"] and d["pure_step_ms_prof"] > 0
+        else:
+            assert d.get("probe_error"), \
+                "prof A/B arm missing without a probe_error explanation"
     if "parity_bitwise" in extra_keys:
         # the resilience claims, not just the schema: injected faults were
         # absorbed (retries happened, output bitwise-identical) and the
@@ -245,6 +281,16 @@ def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
         assert d["fleet_incident_bundles"] == 1
         assert d["fleet_bundle_replicas"] == d["replicas"]
         assert d["fleetobs_kill_switch_parity"] is True
+        # ISSUE 12: the parent fit's goodput decomposition rides the
+        # fleet record, and the digest carried every replica's
+        # per-owner device bytes (the serving executables named)
+        gp = d["goodput"]
+        assert isinstance(gp, dict) and abs(
+            sum(gp["fractions"].values()) - 1.0) <= 0.02
+        led = d["ledger"]
+        assert len(led["replicas"]) == d["replicas"]
+        assert any("serve_executables" in dev
+                   for dev in led["replicas"].values()), led["replicas"]
     if "p99_bound_factor" in extra_keys:
         # the overload claims (ISSUE 8 acceptance): under the injected
         # overload trace the admission-controlled arm keeps p99 >= 3x

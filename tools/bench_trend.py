@@ -1,0 +1,173 @@
+"""One-shot comparator over banked bench rounds — ``BENCH_r*.json``.
+
+Each round the driver banks one ``BENCH_rNN.json`` per capture: a dict
+whose ``parsed`` key holds the bench's single stdout JSON record (some
+rounds bank a LIST of such captures). This tool aligns those records
+across rounds by their ``metric`` name and prints per-metric deltas —
+and flags regressions **only on same-run ratio metrics**: absolute
+rows/s are not cross-container comparable (the ROUND notes' standing
+caveat — r05's host measured ~14x slower than r03's on identical code),
+but a ratio both arms of which ran in the SAME process (speedups,
+compression, scaling factors) carries across containers. A ratio that
+drops more than ``threshold`` (default 20%) vs the previous round it
+appeared in is flagged.
+
+Importable: ``run_trend(paths=None, root=REPO, threshold=0.2) -> dict``
+(the tier-1 smoke calls it on synthetic rounds and on the real bank).
+
+Usage:
+    python tools/bench_trend.py [--root DIR] [--threshold 0.2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+#: higher-is-better SAME-RUN ratios — the only metrics whose cross-round
+#: drop is a regression signal rather than a container artifact
+RATIO_KEYS = frozenset({
+    "vs_baseline",
+    "optim_step_speedup",
+    "cache_step_speedup",
+    "compression_ratio",
+    "compile_reduction",
+    "mb_merge_factor",
+    "overlap_pct",
+    "scaling_factor",
+    "p99_bound_factor",
+    "trace_coverage",
+})
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def _round_paths(root: str) -> list[tuple[int, str]]:
+    out = []
+    for p in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(p))
+        if m:
+            out.append((int(m.group(1)), p))
+    return sorted(out)
+
+
+def _records(path: str) -> list[dict]:
+    """The parsed bench records inside one round file (dict or list of
+    capture dicts; a malformed/empty file contributes nothing)."""
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, ValueError):
+        return []
+    captures = d if isinstance(d, list) else [d]
+    out = []
+    for c in captures:
+        p = c.get("parsed") if isinstance(c, dict) else None
+        if isinstance(p, dict) and p.get("metric"):
+            out.append(p)
+    return out
+
+
+def run_trend(paths: list[str] | None = None, *, root: str = REPO,
+              threshold: float = 0.2) -> dict:
+    """Align rounds, diff numerics, flag ratio regressions. Returns::
+
+        {"rounds": [n, ...],
+         "metrics": {metric: {"rounds": [n, ...],
+                              "keys": {key: {"values": {n: v},
+                                             "delta_pct": f | None}}}},
+         "regressions": [{"metric", "key", "round", "prev_round",
+                          "prev", "value", "drop_pct"}]}
+    """
+    if paths is not None:
+        rounds = []
+        for i, p in enumerate(paths):
+            m = _ROUND_RE.search(os.path.basename(p))
+            rounds.append((int(m.group(1)) if m else i + 1, p))
+        rounds.sort()
+    else:
+        rounds = _round_paths(root)
+    metrics: dict[str, dict] = {}
+    for n, path in rounds:
+        for rec in _records(path):
+            name = rec["metric"]
+            m = metrics.setdefault(name, {"rounds": [], "keys": {}})
+            if n not in m["rounds"]:
+                m["rounds"].append(n)
+            for k, v in rec.items():
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    continue
+                m["keys"].setdefault(k, {"values": {}})["values"][n] = v
+    regressions: list[dict] = []
+    for name, m in metrics.items():
+        for k, info in m["keys"].items():
+            vals = sorted(info["values"].items())
+            if len(vals) >= 2:
+                (pn, pv), (cn, cv) = vals[-2], vals[-1]
+                info["delta_pct"] = (round((cv - pv) / pv * 100.0, 2)
+                                     if pv else None)
+            else:
+                info["delta_pct"] = None
+            if k not in RATIO_KEYS:
+                continue
+            # walk CONSECUTIVE appearances: a regression that healed in
+            # the latest round still happened, and the table should say
+            # in which round it landed
+            for (pn, pv), (cn, cv) in zip(vals, vals[1:]):
+                if pv and (pv - cv) / pv > threshold:
+                    regressions.append({
+                        "metric": name, "key": k,
+                        "round": cn, "prev_round": pn,
+                        "prev": pv, "value": cv,
+                        "drop_pct": round((pv - cv) / pv * 100.0, 1),
+                    })
+    return {"rounds": [n for n, _ in rounds], "metrics": metrics,
+            "regressions": regressions}
+
+
+def _print_table(trend: dict, out=sys.stderr) -> None:
+    for name, m in sorted(trend["metrics"].items()):
+        print(f"[trend] == {name} (rounds {m['rounds']}) ==", file=out)
+        for k, info in sorted(m["keys"].items()):
+            vals = sorted(info["values"].items())
+            series = " -> ".join(f"r{n}:{v:g}" for n, v in vals)
+            flag = " [ratio]" if k in RATIO_KEYS else ""
+            delta = (f"  ({info['delta_pct']:+.1f}%)"
+                     if info["delta_pct"] is not None else "")
+            print(f"[trend]   {k:<42} {series}{delta}{flag}", file=out)
+    for r in trend["regressions"]:
+        print(f"[trend] REGRESSION {r['metric']}.{r['key']}: "
+              f"r{r['prev_round']} {r['prev']:g} -> r{r['round']} "
+              f"{r['value']:g} (-{r['drop_pct']}%)", file=out)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", default=REPO,
+                    help="directory holding BENCH_r*.json (default: repo)")
+    ap.add_argument("--threshold", type=float, default=0.2,
+                    help="ratio-drop fraction that flags a regression")
+    args = ap.parse_args()
+    trend = run_trend(root=args.root, threshold=args.threshold)
+    _print_table(trend)
+    print(json.dumps({
+        "metric": "bench_trend",
+        "value": len(trend["metrics"]),
+        "unit": "metrics",
+        "vs_baseline": None,
+        "rounds": trend["rounds"],
+        "regressions": trend["regressions"],
+    }, default=str))
+    return 1 if trend["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,16 +41,22 @@ def _render(digest: dict, slo: list, out=sys.stderr) -> None:
     rows = digest["replicas"]
     hdr = (f"{'replica':<14} {'up':<3} {'stale':<5} {'age_s':>6} "
            f"{'inflt':>5} {'queue':>5} {'shed':>6} {'brown':>5} "
-           f"{'rpc':>8}")
+           f"{'rpc':>8} {'devMB':>7} {'goodput':<14}")
     print(f"[fleet-top] {hdr}", file=out)
     for r in rows:
         age = "-" if r["scrape_age_s"] is None else f"{r['scrape_age_s']:.1f}"
+        dev_mb = sum((r.get("device_bytes") or {}).values()) / 1e6
+        gp = r.get("goodput") or {}
+        # fleet-wide goodput at a glance: the dominant stage of each
+        # replica's last fit (obs/prof.py decomposition), '-' until one ran
+        gp_s = (max(gp, key=gp.get) if gp else "-")
         print(f"[fleet-top] {r['replica']:<14} "
               f"{'y' if r['up'] else 'n':<3} "
               f"{'Y' if r['stale'] else '.':<5} {age:>6} "
               f"{r['inflight']:>5.0f} {r['queue_depth']:>5.0f} "
               f"{r['shed_total']:>6.0f} {r['brownout_level']:>5.0f} "
-              f"{r['rpc_requests']:>8.0f}", file=out)
+              f"{r['rpc_requests']:>8.0f} {dev_mb:>7.1f} {gp_s:<14}",
+              file=out)
     p95 = digest.get("ewma_p95_ms")
     print(f"[fleet-top] router ewma_p95_ms="
           f"{'-' if p95 is None else p95} "

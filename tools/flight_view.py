@@ -107,6 +107,14 @@ def render(bundle: dict, *, stack_tail: int = 6) -> str:
             lines.append(f"  [{s['thread']}] {s['name']} "
                          f"open {s['age_ms']:.1f}ms "
                          f"trace={s.get('trace_id')}")
+    dm = bundle.get("device_memory")   # additive: old bundles render fine
+    if dm:
+        # ONE table definition for both viewers (tools/goodput_view.py)
+        from tools.goodput_view import ledger_lines
+
+        table = ledger_lines(dm, max_entries=8)
+        lines.append(f"-- {table[0]} --")
+        lines.extend(table[1:])
     slow = bundle.get("slow_traces") or []
     if slow:
         lines.append("-- slowest traces --")

@@ -20,9 +20,14 @@ Importable: ``run_dump(rows=..., session=...)`` returns the summary dict
 manual ``obs.flight.dump()`` after the fit+serve window, the bundle
 re-read and schema-checked, its path in the summary line.
 
+``--profile`` pulls one deep-profile capture (obs/prof.py): a short
+``jax.profiler`` window plus the goodput+ledger+registry snapshot into
+an atomic ``capture-*`` dir under ``OTPU_PROF_DIR`` — the manual twin
+of ``POST /debug/profile``; render it with ``tools/goodput_view.py``.
+
 Usage:
     python tools/obs_dump.py [--rows 8192] [--trace-out /tmp/otpu_trace.json]
-                             [--flight]
+                             [--flight] [--profile]
 """
 
 from __future__ import annotations
@@ -39,7 +44,7 @@ if REPO not in sys.path:
 
 def run_dump(rows: int = 8192, session=None,
              trace_out: str | None = None,
-             flight: bool = False) -> dict:
+             flight: bool = False, profile: bool = False) -> dict:
     import numpy as np
 
     from orange3_spark_tpu.core.session import TpuSession
@@ -100,6 +105,27 @@ def run_dump(rows: int = 8192, session=None,
                 bundle.get("flight_schema") == _flight.FLIGHT_SCHEMA_VERSION
                 and bool(bundle.get("stacks"))
                 and "registry" in bundle and "knobs" in bundle)
+    profile_path = profile_valid = None
+    if profile:
+        from orange3_spark_tpu.obs import prof as _prof
+
+        try:
+            cap = _prof.capture(duration_ms=10, reason="obs_dump")
+        except (_prof.CaptureDisabledError, _prof.CaptureBusyError,
+                _prof.CaptureRateLimitedError):
+            # OTPU_PROF=0 / another capture running / inside the rate
+            # window: the dump DEGRADES (path stays None) — the metrics
+            # snapshot and trace already gathered must still land
+            cap = None
+        if cap is not None:
+            profile_path = cap["path"]
+            snap_path = os.path.join(profile_path, "snapshot.json")
+            with open(snap_path) as f:
+                snap = json.load(f)          # must be complete, valid JSON
+            profile_valid = (
+                snap.get("prof_schema") == _prof.PROF_SCHEMA_VERSION
+                and "ledger" in snap and "registry" in snap
+                and "knobs" in snap)
     return {
         "metric": "obs_dump",
         "rows": rows,
@@ -112,6 +138,8 @@ def run_dump(rows: int = 8192, session=None,
         "trace_path": trace_out,
         "flight_path": flight_path,
         "flight_valid": flight_valid,
+        "profile_path": profile_path,
+        "profile_valid": profile_valid,
         "snapshot_metrics": len(snapshot),
         "snapshot": snapshot,
     }
@@ -123,9 +151,11 @@ def main() -> int:
     ap.add_argument("--trace-out", default="/tmp/otpu_trace.json")
     ap.add_argument("--flight", action="store_true",
                     help="also exercise a manual flight-recorder dump")
+    ap.add_argument("--profile", action="store_true",
+                    help="also pull one deep-profile capture (obs/prof.py)")
     args = ap.parse_args()
     out = run_dump(rows=args.rows, trace_out=args.trace_out,
-                   flight=args.flight)
+                   flight=args.flight, profile=args.profile)
     print("== metrics snapshot ==")
     print(json.dumps(out["snapshot"], indent=2))
     print(f"== trace: {out['trace_events']} events "
