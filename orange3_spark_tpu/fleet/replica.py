@@ -132,8 +132,14 @@ class ReplicaRuntime:
                     in_flight=self._in_flight)
             self._in_flight += 1
         try:
-            model = self._model        # atomic ref read — the flip point
-            return np.asarray(model.predict(X))
+            from orange3_spark_tpu.online.tap import tap_scope
+
+            # the replica boundary is the online tap point: one log record
+            # per request; the scope suppresses the inner served_array tap
+            # so a tapped request is never double-logged
+            with tap_scope(X):
+                model = self._model    # atomic ref read — the flip point
+                return np.asarray(model.predict(X))
         finally:
             with self._inflight_lock:
                 self._in_flight -= 1

@@ -46,9 +46,13 @@ from orange3_spark_tpu.utils import knobs
 __all__ = [
     "Rollout",
     "RolloutError",
+    "is_quarantined",
+    "list_quarantined",
     "load_version_model",
     "publish_version",
+    "quarantine",
     "read_current",
+    "read_quarantine_meta",
     "read_version_meta",
 ]
 
@@ -56,6 +60,7 @@ log = logging.getLogger("orange3_spark_tpu")
 
 CURRENT_FILE = "CURRENT"
 META_FILE = "VERSION.json"
+REJECTED_DIR = "REJECTED"
 _VERSION_RE = re.compile(r"^v(\d{4,})$")
 
 _M_ROLLOUTS = REGISTRY.counter(
@@ -161,6 +166,49 @@ def load_version_model(root: str, version: str):
     from orange3_spark_tpu.utils.checkpoint import load_model
 
     return load_model(os.path.join(root, version))
+
+
+# --------------------------------------------------------------- quarantine
+def quarantine(root: str, version: str, reason: str, *,
+               detail: dict | None = None) -> str:
+    """Record ``version`` in the store's ``REJECTED/`` ledger. A
+    quarantined version stays on disk (post-mortem evidence) but
+    :meth:`Rollout.roll` refuses it forever — a candidate that tripped a
+    promotion gate (or rolled back under canary/SLO fire) must never be
+    re-promoted by a later cycle that no longer remembers why it failed.
+    Idempotent (first reason wins); returns the ledger path."""
+    ledger = os.path.join(root, REJECTED_DIR)
+    os.makedirs(ledger, exist_ok=True)
+    path = os.path.join(ledger, f"{version}.json")
+    if not os.path.exists(path):
+        _atomic_write(path, json.dumps(
+            {"version": version, "reason": reason,
+             "quarantined_at": time.time(), **(detail or {})}))
+        log.warning("fleet: quarantined %s under %s (%s)", version, root,
+                    reason)
+    return path
+
+
+def is_quarantined(root: str, version: str) -> bool:
+    return os.path.exists(os.path.join(root, REJECTED_DIR,
+                                       f"{version}.json"))
+
+
+def list_quarantined(root: str) -> list[str]:
+    try:
+        names = os.listdir(os.path.join(root, REJECTED_DIR))
+    except FileNotFoundError:
+        return []
+    return sorted(n[:-len(".json")] for n in names if n.endswith(".json"))
+
+
+def read_quarantine_meta(root: str, version: str) -> dict:
+    try:
+        with open(os.path.join(root, REJECTED_DIR, f"{version}.json"),
+                  encoding="utf-8") as f:
+            return json.load(f)
+    except (FileNotFoundError, ValueError):
+        return {}
 
 
 # ------------------------------------------------------------------ rollout
@@ -300,6 +348,13 @@ class Rollout:
         if not os.path.isdir(os.path.join(self.root, version)):
             raise RolloutError(f"version {version} not published under "
                                f"{self.root}")
+        if is_quarantined(self.root, version):
+            meta = read_quarantine_meta(self.root, version)
+            raise RolloutError(
+                f"version {version} is quarantined under {self.root} "
+                f"(REJECTED ledger: {meta.get('reason', 'unknown')}) — "
+                "a rejected candidate is never re-promoted; publish a "
+                "new version", step="quarantine")
         alerts0 = (len(self.slo_engine.alerts)
                    if self.slo_engine is not None else 0)
         flipped: list = []

@@ -511,6 +511,9 @@ class ServingContext:
         fn's output rows for ``Xall`` or None when serving does not apply
         (caller falls through to its raw path)."""
         Xall = np.asarray(Xall)
+        from orange3_spark_tpu.online.tap import maybe_tap_request
+
+        maybe_tap_request(Xall)
         n = Xall.shape[0]
         # serving-doesn't-apply checks BEFORE the trace mint: a request
         # falling straight through to its raw path must neither record a

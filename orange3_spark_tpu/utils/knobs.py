@@ -167,6 +167,38 @@ _ALL = [
     Knob("OTPU_ROLLOUT_TIMEOUT_S", "float", 60.0, "fleet",
          "Per-replica budget for one rollout step (reload + warm + "
          "readiness re-poll) before the rollout aborts and rolls back."),
+    # ----------------------------------------------------------- online/
+    Knob("OTPU_ONLINE", "flag", "1", "online",
+         "Continuous train-while-serve kill-switch; 0 = the serving tap, "
+         "incremental trainer and guarded promotion loop are all inert "
+         "(the pre-online serving path, bitwise)."),
+    Knob("OTPU_ONLINE_PUBLISH_S", "float", 30.0, "online",
+         "Guarded-promotion cadence: seconds between publish cycles of "
+         "the online loop's background publisher thread."),
+    Knob("OTPU_ONLINE_JOIN_WINDOW", "int", 4096, "online",
+         "Label-join window: unlabeled requests held for their label "
+         "before eviction (a label arriving later counts as 'late')."),
+    Knob("OTPU_ONLINE_CHUNK_ROWS", "int", 1024, "online",
+         "Joined examples per incremental-trainer device step."),
+    Knob("OTPU_ONLINE_MIN_EXAMPLES", "int", 512, "online",
+         "Joined examples the trainer must consume before a candidate "
+         "may enter the promotion gate ladder."),
+    Knob("OTPU_ONLINE_DRIFT_Z", "float", 6.0, "online",
+         "Drift gate: max normalized per-feature mean shift (z-score) of "
+         "recent tapped traffic vs the serving model's training stats."),
+    Knob("OTPU_ONLINE_HOLDOUT_DROP", "float", 0.02, "online",
+         "Drift gate: max holdout-metric regression (AUC, falling back "
+         "to accuracy) the candidate may show vs the serving model."),
+    Knob("OTPU_ONLINE_SHADOW_SAMPLE", "float", 0.25, "online",
+         "Shadow gate: fraction of logged request chunks the candidate "
+         "re-scores (deterministic per-ordinal coin)."),
+    Knob("OTPU_ONLINE_SHADOW_DISAGREE", "float", 0.25, "online",
+         "Shadow gate: max fraction of shadow-scored rows whose "
+         "predicted class disagrees with the serving model."),
+    Knob("OTPU_ONLINE_CKPT_STEPS", "int", 8, "online",
+         "Trainer steps per epoch-boundary checkpoint (a SIGKILL'd "
+         "trainer resumes from the last one without re-reading the "
+         "consumed log prefix)."),
     # ------------------------------------------------------------- obs/
     Knob("OTPU_OBS", "flag", "1", "obs",
          "Observability master switch; 0 = spans no-op, the telemetry "

@@ -67,6 +67,9 @@ def _run(argv, timeout=420):
       # obs A/B (ISSUE 7): the same-run spans+registry-on vs OTPU_OBS=0
       # step arm, and the embedded registry snapshot
       "obs_overhead_pct", "pure_step_ms_obs", "obs",
+      # flake-proofing: each <2% gate earns ONE structured re-measure;
+      # both readings ride the record so a banked retry is auditable
+      "obs_ab_retried", "prof_ab_retried",
       # goodput & memory attribution (ISSUE 12): the five-way wall
       # decomposition, the device-memory ledger, and the same-run
       # OTPU_PROF on/off step A/B
@@ -130,6 +133,27 @@ def _run(argv, timeout=420):
       # goodput & memory attribution (ISSUE 12): the parent fit's
       # decomposition + per-replica device-bytes via the fleet digest
       "goodput", "ledger"}),
+    # guarded continuous learning (ISSUE 14): the train-while-serve
+    # drill's five arms — continuous beats frozen on the shifted holdout,
+    # an injected-drift candidate is rejected typed BEFORE any replica
+    # flips, an SLO-tripping candidate auto-rolls back with zero failed
+    # requests, a crashed trainer resumes from its checkpoint bitwise,
+    # and OTPU_ONLINE=0 restores the frozen serving path
+    (["bench.py", "--config", "online"],
+     "online_guarded_loop",
+     {"auc_frozen", "auc_continuous", "auc_gain", "online_steps",
+      "online_examples", "label_join_counts", "trainer_examples_per_s",
+      "promotion_outcome", "promotion_version",
+      "promotion_failed_requests", "promotion_traffic_requests",
+      "promotion_current", "drift_outcome", "drift_error",
+      "drift_quarantined", "drift_current_untouched",
+      "drift_no_replica_flip", "slo_rollback_outcome",
+      "slo_rollback_failed_requests", "slo_rollback_traffic_requests",
+      "slo_quarantined", "slo_current_untouched", "trainer_crash_typed",
+      "trainer_resumed_from_step", "resume_parity_bitwise",
+      "unguarded_ships_bad", "kill_switch_parity",
+      "kill_switch_log_empty", "kill_switch_cycle",
+      "quarantined_versions", "baseline_value", "baseline_note"}),
     (["bench.py", "--config", "overload"],
      "overload_admission_p99_bound_factor",
      {"p99_ms_admitted", "p99_ms_raw", "p99_bound_factor", "sheds",
@@ -190,8 +214,14 @@ def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
         # post-window probe must not cost the measured line (bench.py's
         # probe_error convention) — but a silently-missing arm must.
         if d.get("obs_overhead_pct") is not None:
-            assert d["obs_overhead_pct"] < 2.0, d["obs_overhead_pct"]
+            assert d["obs_overhead_pct"] < 2.0, (
+                d["obs_overhead_pct"], "first measurement:",
+                d.get("obs_overhead_pct_first"))
             assert d["pure_step_ms_obs"] and d["pure_step_ms_obs"] > 0
+            if d.get("obs_ab_retried"):
+                # a retried gate must log WHY it retried
+                assert d["obs_overhead_pct_first"] is not None
+                assert d["obs_overhead_pct_first"] >= 2.0
         else:
             assert d.get("probe_error"), \
                 "obs A/B arm missing without a probe_error explanation"
@@ -219,8 +249,13 @@ def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
             assert rel <= 0.01, (led["cache_entry_bytes"],
                                  d["cache_bytes"])
         if d.get("prof_overhead_pct") is not None:
-            assert d["prof_overhead_pct"] < 2.0, d["prof_overhead_pct"]
+            assert d["prof_overhead_pct"] < 2.0, (
+                d["prof_overhead_pct"], "first measurement:",
+                d.get("prof_overhead_pct_first"))
             assert d["pure_step_ms_prof"] and d["pure_step_ms_prof"] > 0
+            if d.get("prof_ab_retried"):
+                assert d["prof_overhead_pct_first"] is not None
+                assert d["prof_overhead_pct_first"] >= 2.0
         else:
             assert d.get("probe_error"), \
                 "prof A/B arm missing without a probe_error explanation"
@@ -291,6 +326,47 @@ def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
         assert len(led["replicas"]) == d["replicas"]
         assert any("serve_executables" in dev
                    for dev in led["replicas"].values()), led["replicas"]
+    if "promotion_outcome" in extra_keys:
+        # the continuous-learning claims (ISSUE 14 acceptance), semantics
+        # not just schema. (1) learning: the continuously-trained
+        # candidate beats the frozen serving model on the same-run
+        # shifted holdout, and its guarded promotion completed under
+        # live traffic with zero failed requests;
+        assert d["auc_continuous"] > d["auc_frozen"], (
+            d["auc_continuous"], d["auc_frozen"])
+        assert d["online_steps"] >= 1
+        assert d["label_join_counts"]["joined"] >= 1
+        assert d["promotion_outcome"] == "completed"
+        assert d["promotion_failed_requests"] == 0
+        assert d["promotion_traffic_requests"] >= 1
+        assert d["promotion_current"] == d["promotion_version"]
+        # (2) drift gate: the injected-drift candidate was rejected
+        # TYPED and quarantined before any replica flipped — CURRENT
+        # and every replica's served version untouched;
+        assert d["drift_outcome"] == "rejected_drift"
+        assert "DriftDetectedError" in d["drift_error"]
+        assert d["drift_quarantined"] is True
+        assert d["drift_current_untouched"] is True
+        assert d["drift_no_replica_flip"] is True
+        # (3) canary/SLO gate: the bad-but-plausible candidate tripped
+        # the burn-rate engine mid-roll and auto-rolled back with zero
+        # failed requests, landing in quarantine;
+        assert d["slo_rollback_outcome"] == "rolled_back"
+        assert d["slo_rollback_failed_requests"] == 0
+        assert d["slo_quarantined"] is True
+        assert d["slo_current_untouched"] is True
+        # (4) crash/resume: the injected trainer death was typed and the
+        # resumed trainer converged bitwise to the uninterrupted run;
+        assert d["trainer_crash_typed"] is True
+        assert d["trainer_resumed_from_step"] >= 1
+        assert d["resume_parity_bitwise"] is True
+        # (5) the drills mean something: the unguarded loop DOES ship
+        # the bad candidate, and OTPU_ONLINE=0 is bitwise-frozen serving
+        assert d["unguarded_ships_bad"] is True
+        assert d["kill_switch_parity"] is True
+        assert d["kill_switch_log_empty"] is True
+        assert d["kill_switch_cycle"] == "disabled"
+        assert len(d["quarantined_versions"]) >= 2
     if "p99_bound_factor" in extra_keys:
         # the overload claims (ISSUE 8 acceptance): under the injected
         # overload trace the admission-controlled arm keeps p99 >= 3x

@@ -628,7 +628,9 @@ def test_fault_matrix_tool_outcomes(session):
     rows = run_matrix(rows=2048, session=session)
     by = {r["cell"]: r for r in rows}
     assert set(by) == {"clean", "source_io", "source_fatal", "straggler",
-                       "spill_corrupt", "wedge", "aot_build"}
+                       "spill_corrupt", "wedge", "aot_build", "overload",
+                       "mem_pressure", "drift", "label_skew",
+                       "trainer_crash"}
     assert by["clean"]["outcome"] == "ok"
     assert by["source_io"]["outcome"] == "recovered"
     assert by["source_io"]["retries"] == 2
@@ -637,6 +639,11 @@ def test_fault_matrix_tool_outcomes(session):
     assert by["spill_corrupt"]["outcome"] == "raised:SpillCorruptionError"
     assert by["wedge"]["outcome"] == "raised:DispatchWedgedError"
     assert by["aot_build"]["outcome"] == "recovered"
+    assert by["overload"]["outcome"] == "raised:OverloadShedError"
+    assert by["mem_pressure"]["outcome"] == "recovered"
+    assert by["drift"]["outcome"] == "raised:DriftDetectedError"
+    assert by["label_skew"]["outcome"] == "recovered"
+    assert by["trainer_crash"]["outcome"] == "raised:TrainerCrashInjected"
     assert not any(r["outcome"].startswith("UNEXPECTED") for r in rows)
 
 

@@ -16,6 +16,16 @@ quick "is the whole resilience surface wired?" probe:
                   the watchdog budget
   aot_build       transient serving AOT build failure -> recovered with
                   one retry through ExecutableCache
+  overload        injected service delay under a full admission slot ->
+                  typed OverloadShedError (never a queue)
+  mem_pressure    synthetic memory-pressure fraction -> brownout level
+                  raised, typed degradation not an OOM
+  drift           injected feature shift on a tapped stream -> the online
+                  drift gate raises DriftDetectedError naming columns
+  label_skew      seeded label flips -> deterministic mask (the same rows
+                  flip in-process and in a subprocess bench arm)
+  trainer_crash   Nth incremental-trainer device step dies ->
+                  TrainerCrashInjected (the checkpoint-resume drill hook)
 
 Importable: ``run_matrix(rows=..., session=...)`` returns the row dicts
 (the not-slow smoke test in tests/test_resilience.py calls it directly).
@@ -153,6 +163,91 @@ def run_matrix(rows: int = 16384, session=None) -> list:
             raise AssertionError(f"unexpected build product {built!r}")
 
     cell("aot_build", "aot_build:fails=1", aot_fit)
+
+    # ---- online / overload injectors (lightweight wiring probes: the
+    # full gate drills live in bench.py --config online and tests/) ----
+    from orange3_spark_tpu.online.drift import DriftDetectedError
+    from orange3_spark_tpu.online.trainer import TrainerCrashInjected
+    from orange3_spark_tpu.resilience.faults import active_fault_spec
+    from orange3_spark_tpu.resilience.overload import (
+        AdmissionController, OverloadShedError, request_deadline,
+    )
+
+    def overload_drill():
+        import threading
+
+        from orange3_spark_tpu.resilience.overload import (
+            maybe_injected_service_delay,
+        )
+
+        adm = AdmissionController(max_inflight=1)
+        entered = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with adm.slot():
+                entered.set()
+                maybe_injected_service_delay()   # the injected service time
+                release.wait(5)
+
+        th = threading.Thread(target=holder)
+        th.start()
+        entered.wait(5)
+        try:
+            with request_deadline(0.001), adm.slot():
+                pass
+        finally:
+            release.set()
+            th.join(5)
+
+    cell("overload", "overload:delay_ms=30", overload_drill,
+         expect=OverloadShedError)
+
+    def mem_pressure_drill():
+        from orange3_spark_tpu.resilience.overload import brownout_level
+
+        level = brownout_level()
+        if level < 1:
+            raise AssertionError(
+                f"brownout level {level} under injected pressure")
+
+    cell("mem_pressure", "mem_pressure:frac=0.97", mem_pressure_drill)
+
+    def drift_drill():
+        from orange3_spark_tpu.online.drift import (
+            DriftDetector, feature_stats,
+        )
+
+        det = DriftDetector(feature_stats(X), z_threshold=6.0)
+        shift = active_fault_spec().take_drift_shift(0)
+        det.check_features(X[:chunk_rows] + np.float32(shift))
+
+    cell("drift", "drift:shift=8", drift_drill,
+         expect=DriftDetectedError)
+
+    def label_skew_drill():
+        import zlib
+
+        mask = active_fault_spec().take_label_flip(0, 512)
+        mask2 = [
+            zlib.crc32(f"0:0:{r}".encode()) / 0xFFFFFFFF < 0.5
+            for r in range(512)
+        ]
+        frac = sum(mask) / len(mask)
+        if mask != mask2 or not 0.3 < frac < 0.7:
+            raise AssertionError(
+                f"label flip mask not the seeded coin (frac {frac})")
+
+    cell("label_skew", "label_skew:flip=0.5,seed=0", label_skew_drill)
+
+    def trainer_crash_drill():
+        # the take-hook drives the REAL trainer's per-step check; here it
+        # is probed directly so the matrix stays sub-second
+        if active_fault_spec().take_trainer_crash():
+            raise TrainerCrashInjected("injected trainer crash at step 1")
+
+    cell("trainer_crash", "trainer_crash:at=1", trainer_crash_drill,
+         expect=TrainerCrashInjected)
     return rows_out
 
 

@@ -1,0 +1,134 @@
+"""One-shot continuous-learning status probe — ``top`` for the online loop.
+
+Runs a miniature train-while-serve drill in-process (tiny CTR fit, tapped
+traffic through a ServingContext, the incremental trainer over the real
+OTPURQL1 log, one storeside publish cycle through the drift/shadow
+gates) and renders the loop's status the way an operator would read it
+off a live deployment: trainer goodput, label-join accounting, log lag,
+store/quarantine state, last promotion outcome.
+
+The table goes to stderr; ONE JSON line goes to stdout (the
+capture-watcher banking convention, like tools/fault_matrix.py).
+Importable: ``run_status(session=...)`` returns the status dict (the
+not-slow smoke test in tests/test_online.py calls it directly).
+
+Usage:
+    python tools/online_top.py [--rows 1024]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_status(rows: int = 1024, session=None) -> dict:
+    import numpy as np
+
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+    from orange3_spark_tpu.online import OnlineLoop
+    from orange3_spark_tpu.serve import BucketLadder, ServingContext
+
+    session = session or TpuSession.builder_get_or_create()
+    rng = np.random.default_rng(0)
+    n_dense = n_cat = 2
+    chunk = 128
+    X = np.concatenate([
+        rng.standard_normal((rows, n_dense)).astype(np.float32),
+        rng.integers(0, 50, (rows, n_cat)).astype(np.float32),
+    ], axis=1)
+    y = (X[:, 0] > 0).astype(np.float32)
+    model = StreamingHashedLinearEstimator(
+        n_dims=1 << 8, n_dense=n_dense, n_cat=n_cat, epochs=1,
+        step_size=0.05, chunk_rows=chunk,
+    ).fit_stream(array_chunk_source(X, y, chunk_rows=chunk),
+                 session=session)
+    root = tempfile.mkdtemp(prefix="otpu_online_top_")
+    try:
+        loop = OnlineLoop(
+            model, os.path.join(root, "store"),
+            os.path.join(root, "req.log"), session=session,
+            reference_X=X,
+            holdout_source=array_chunk_source(X, y, chunk_rows=chunk),
+            min_examples=chunk,
+            trainer_kw={"chunk_rows": chunk, "join_window": 32,
+                        "ckpt_steps": 2},
+            shadow_kw={"disagree_threshold": 0.95})
+        with ServingContext(BucketLadder(min_bucket=32,
+                                         max_bucket=chunk)), loop:
+            for i in range(0, rows, chunk):
+                model.predict(X[i:i + chunk])
+                rid = loop.tap.last_request_id()
+                if rid is not None:
+                    loop.tap.tap_label(rid, y[i:i + chunk])
+            deadline = time.monotonic() + 120
+            while (time.monotonic() < deadline
+                   and loop.trainer.status()["steps"] < rows // chunk
+                   and not loop.trainer.status()["died"]):
+                time.sleep(0.05)
+            loop.publish_cycle()
+            status = loop.status()
+        return status
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _render(status: dict) -> None:
+    tr = status["trainer"]
+    st = status["store"]
+    print("online loop — one-shot status", file=sys.stderr)
+    print(f"  trainer   steps {tr['steps']}  examples {tr['examples']}  "
+          f"ex/s {tr['examples_per_s']}  last_loss "
+          f"{tr['last_loss'] if tr['last_loss'] is None else round(tr['last_loss'], 4)}",
+          file=sys.stderr)
+    print(f"            lag {tr['lag_bytes']} B  buffered "
+          f"{tr['buffered_rows']} rows  resumed_from "
+          f"{tr['resumed_from_step']}  alive {tr['alive']}",
+          file=sys.stderr)
+    jc = tr["join_counts"]
+    print(f"  joiner    joined {jc['joined']}  late {jc['late']}  "
+          f"orphan {jc['orphan']}", file=sys.stderr)
+    print(f"  log       {status['log_bytes']} B on disk", file=sys.stderr)
+    print(f"  store     CURRENT {st['current']}  versions "
+          f"{len(st['versions'])}  quarantined {st['quarantined']}",
+          file=sys.stderr)
+    print(f"  cycles    {status['cycles']}  last outcome "
+          f"{status['last_outcome']}", file=sys.stderr)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1024)
+    args = ap.parse_args()
+    sys.path.insert(0, REPO)
+    status = run_status(rows=args.rows)
+    _render(status)
+    tr = status["trainer"]
+    ok = (tr["steps"] > 0 and not tr["died"]
+          and status["last_outcome"] is not None)
+    print(json.dumps({
+        "metric": "online_top",
+        "value": tr["steps"],
+        "unit": "trainer_steps",
+        "vs_baseline": None,
+        "last_outcome": status["last_outcome"],
+        "join_counts": tr["join_counts"],
+        "quarantined": status["store"]["quarantined"],
+        "ok": ok,
+    }))
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
