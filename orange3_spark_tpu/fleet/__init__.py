@@ -81,6 +81,7 @@ class FleetFrontend:
         self.manager = None
         self.router = None
         self.root = root
+        self._inproc = None
         if not fleet_enabled() or n_replicas == 0:
             return                      # single-process mode
         if root is None:
@@ -91,6 +92,8 @@ class FleetFrontend:
         )
         from orange3_spark_tpu.fleet.router import FleetRouter
         from orange3_spark_tpu.fleet.supervisor import ReplicaManager
+
+        from orange3_spark_tpu.utils import knobs
 
         current = read_current(root)
         if current is None:
@@ -108,6 +111,18 @@ class FleetFrontend:
                 f"published version {current} under {root!r} carries no "
                 "n_cols; republish with publish_version(model, root, "
                 "n_cols=...)")
+        inproc = knobs.get_int("OTPU_FLEET_INPROC")
+        if inproc > 0:
+            # one process, N device-pinned lanes behind the SAME router
+            # (fleet/inproc.py) — no subprocesses, no serialization
+            from orange3_spark_tpu.fleet.inproc import InprocFleet
+
+            self._inproc = InprocFleet(
+                root, lanes=inproc, ladder_max=ladder_max)
+            self.router = FleetRouter(
+                self._inproc.endpoints(), hedging=hedging)
+            self.router.refresh()
+            return
         self.manager = ReplicaManager(
             root, n_replicas=n_replicas, env=env, ladder_max=ladder_max)
         if start:
@@ -126,7 +141,9 @@ class FleetFrontend:
 
     @property
     def mode(self) -> str:
-        return "fleet" if self.router is not None else "local"
+        if self.router is None:
+            return "local"
+        return "inproc" if self._inproc is not None else "fleet"
 
     def predict(self, X):
         if self.router is None:
@@ -141,6 +158,9 @@ class FleetFrontend:
         if self.manager is not None:
             self.manager.stop_all()
             self.manager = None
+        if self._inproc is not None:
+            self._inproc.close()
+            self._inproc = None
 
     def __enter__(self) -> "FleetFrontend":
         return self

@@ -34,26 +34,34 @@ cross-process trace-coverage ratio the fleet bench pins at 1.0.
 
 from __future__ import annotations
 
+import collections
 import concurrent.futures
+import math
 import statistics
 import threading
 import time
 
 import numpy as np
 
+from orange3_spark_tpu.fleet import fastwire
 from orange3_spark_tpu.fleet.rpc import (
     TRACE_HEADER,
     FleetClient,
     NoReplicaAvailableError,
     ReplicaDrainingError,
+    ReplicaOverloadedError,
     ReplicaUnavailableError,
 )
 from orange3_spark_tpu.obs.context import new_trace_id
 from orange3_spark_tpu.obs.registry import REGISTRY
-from orange3_spark_tpu.resilience.overload import CircuitBreaker
+from orange3_spark_tpu.resilience.overload import (
+    CircuitBreaker,
+    OverloadShedError,
+)
 from orange3_spark_tpu.utils import knobs
 
-__all__ = ["FleetRouter", "HedgeSchedule", "ReplicaEndpoint"]
+__all__ = ["FleetCoalescer", "FleetRouter", "HedgeSchedule",
+           "ReplicaEndpoint"]
 
 _M_REQS = REGISTRY.counter(
     "otpu_fleet_requests_total", "predicts entering the fleet router")
@@ -72,6 +80,17 @@ _M_INFLIGHT = REGISTRY.gauge(
 _M_PROPAGATED = REGISTRY.counter(
     "otpu_fleet_trace_propagated_total",
     "responses whose replica echoed the router-minted trace id")
+_M_CO_MEMBERS = REGISTRY.counter(
+    "otpu_fleet_coalesce_members_total",
+    "caller predicts that rode a coalesced wire dispatch")
+_M_CO_DISPATCHES = REGISTRY.counter(
+    "otpu_fleet_coalesce_dispatches_total",
+    "wire dispatches the coalescer issued (members/dispatches is the "
+    "cross-caller merge factor)")
+_M_CO_SHEDS = REGISTRY.counter(
+    "otpu_fleet_coalesce_sheds_total",
+    "coalesced members shed typed because their deadline expired while "
+    "queued (siblings still dispatch)")
 
 
 class HedgeSchedule:
@@ -189,6 +208,7 @@ class FleetRouter:
             thread_name_prefix="otpu-fleet-router")
         self._poller: threading.Thread | None = None
         self._stop = threading.Event()
+        self.coalescer = FleetCoalescer(self)
 
     # ------------------------------------------------------------- health
     def refresh(self, timeout_s: float = 0.5) -> dict[int, bool]:
@@ -244,6 +264,10 @@ class FleetRouter:
             self._poller.join(timeout=2.0)
             self._poller = None
         self._pool.shutdown(wait=False)
+        for ep in self.endpoints:
+            close = getattr(ep.client, "close", None)
+            if close is not None:       # fakes without a pool are fine
+                close()
 
     def __enter__(self) -> "FleetRouter":
         return self
@@ -272,7 +296,12 @@ class FleetRouter:
     # ------------------------------------------------------------- calling
     def _call(self, ep: ReplicaEndpoint, X, trace_id: str,
               timeout_s: float | None, conn_slot: list | None = None,
-              cancel_event: threading.Event | None = None):
+              cancel_event: threading.Event | None = None,
+              weight: int = 1, member_traces: list | None = None):
+        # member_traces is forwarded only when a coalesced dispatch set
+        # it, so fake clients with the pre-coalescer predict() signature
+        # keep working untouched
+        kw = {"member_traces": member_traces} if member_traces else {}
         with self._lock:
             ep.inflight += 1
             _M_INFLIGHT.set(ep.inflight, replica=ep.name)
@@ -280,7 +309,7 @@ class FleetRouter:
         try:
             out, headers = ep.client.predict(
                 X, trace_id=trace_id, timeout_s=timeout_s,
-                conn_slot=conn_slot)
+                conn_slot=conn_slot, **kw)
         except ReplicaDrainingError:
             # graceful refusal: not a breaker failure — the replica is
             # healthy, it just wants no NEW work; stop routing to it
@@ -313,12 +342,15 @@ class FleetRouter:
                 ep.version = headers["X-OTPU-Version"]
         if headers.get(TRACE_HEADER) == trace_id:
             # the replica's serving path carried OUR id end-to-end — the
-            # cross-process propagation the fleet bench pins at 1.0
-            _M_PROPAGATED.inc()
+            # cross-process propagation the fleet bench pins at 1.0.
+            # A coalesced dispatch counts once per MEMBER (weight): N
+            # callers entered the router, one wire echo covers them all
+            _M_PROPAGATED.inc(weight)
         return np.asarray(out)
 
     def _hedged_call(self, primary: ReplicaEndpoint, X, trace_id: str,
-                     timeout_s: float | None, excluded: set):
+                     timeout_s: float | None, excluded: set,
+                     weight: int = 1, member_traces: list | None = None):
         """Primary + (after the hedge delay) one hedge to a different
         replica; first success wins, the loser's connection is closed.
         Raises only when BOTH copies failed (primary's error surfaces;
@@ -332,7 +364,24 @@ class FleetRouter:
             slots[ep.replica_id] = slot
             cancels[ep.replica_id] = cancel = threading.Event()
             return self._call(ep, X, trace_id, timeout_s, conn_slot=slot,
-                              cancel_event=cancel)
+                              cancel_event=cancel, weight=weight,
+                              member_traces=member_traces)
+
+        def cancel_others(winner_fut):
+            # mark the loser cancelled FIRST so its _call classifies the
+            # forced close as _HedgeCancelled (never a breaker failure),
+            # then close its socket
+            for lf, lep in futs.items():
+                if lf is not winner_fut and not lf.done():
+                    ev = cancels.get(lep.replica_id)
+                    if ev is not None:
+                        ev.set()
+                    for conn in slots.get(lep.replica_id, ()):
+                        try:
+                            conn.close()
+                        except Exception:  # noqa: BLE001
+                            pass
+                    lf.cancel()
 
         futs = {self._pool.submit(run, primary): primary}
         done, _ = concurrent.futures.wait(
@@ -357,20 +406,14 @@ class FleetRouter:
                         ReplicaDrainingError) as e:
                     errors[ep.replica_id] = e
                     continue
-                # winner: cancel the loser — mark it cancelled FIRST so
-                # its _call classifies the forced close as _HedgeCancelled
-                # (never a breaker failure), then close its socket
-                for lf, lep in futs.items():
-                    if lf is not fut and not lf.done():
-                        ev = cancels.get(lep.replica_id)
-                        if ev is not None:
-                            ev.set()
-                        for conn in slots.get(lep.replica_id, ()):
-                            try:
-                                conn.close()
-                            except Exception:  # noqa: BLE001
-                                pass
-                        lf.cancel()
+                except ReplicaOverloadedError:
+                    # the replica shed OUR nearly-expired request typed:
+                    # waiting out the sibling copy (or retrying) would
+                    # only finish after the caller gave up — cancel the
+                    # sibling and surface the shed
+                    cancel_others(fut)
+                    raise
+                cancel_others(fut)
                 if hedge is not None and ep is hedge:
                     _M_HEDGE_WINS.inc()
                 return out
@@ -399,7 +442,7 @@ class FleetRouter:
         from orange3_spark_tpu.obs.fleetobs import fleetobs_enabled
 
         if not fleetobs_enabled():
-            return self._route(X, trace_id, deadline_s, use_hedge)
+            return self._submit(X, trace_id, deadline_s, use_hedge)
         from orange3_spark_tpu.obs import trace as _trace
         from orange3_spark_tpu.obs.context import propagated_scope
 
@@ -408,15 +451,23 @@ class FleetRouter:
         try:
             with propagated_scope(trace_id, "fleet"):
                 with _trace.span("serve", kind="fleet"):
-                    out = self._route(X, trace_id, deadline_s, use_hedge)
+                    out = self._submit(X, trace_id, deadline_s, use_hedge)
             ok = True
             return out
         finally:
             if self.slo is not None:
                 self.slo.record(ok, time.perf_counter() - t0)
 
+    def _submit(self, X, trace_id: str, deadline_s: float | None,
+                use_hedge: bool) -> np.ndarray:
+        if self.coalescer.enabled():
+            return self.coalescer.submit(X, trace_id, deadline_s,
+                                         use_hedge)
+        return self._route(X, trace_id, deadline_s, use_hedge)
+
     def _route(self, X, trace_id: str, deadline_s: float | None,
-               use_hedge: bool) -> np.ndarray:
+               use_hedge: bool, weight: int = 1,
+               member_traces: list | None = None) -> np.ndarray:
         excluded: set = set()
         last_err: Exception | None = None
         for _attempt in range(max(2 * len(self.endpoints), 2)):
@@ -426,8 +477,16 @@ class FleetRouter:
             try:
                 if use_hedge and len(self.endpoints) > 1:
                     return self._hedged_call(ep, X, trace_id, deadline_s,
-                                             excluded)
-                return self._call(ep, X, trace_id, deadline_s)
+                                             excluded, weight=weight,
+                                             member_traces=member_traces)
+                return self._call(ep, X, trace_id, deadline_s,
+                                  weight=weight,
+                                  member_traces=member_traces)
+            except ReplicaOverloadedError:
+                # typed shed under the caller's own propagated deadline:
+                # failing over would produce an answer after the caller
+                # gave up — surface it, no retry, no breaker
+                raise
             except ReplicaDrainingError as e:
                 _M_FAILOVERS.inc(1, reason="draining")
                 excluded.add(ep.replica_id)
@@ -439,3 +498,228 @@ class FleetRouter:
         if last_err is not None:
             raise last_err
         raise NoReplicaAvailableError(self.states(), trace_id=trace_id)
+
+
+# ------------------------------------------------------- cross-caller merge
+def _merge_key(X: np.ndarray):
+    """Members merge only when a row-concatenation is meaningful: 2-D,
+    same column count, same dtype. Anything else dispatches alone."""
+    if X.ndim != 2:
+        return None
+    return (X.shape[1], str(X.dtype))
+
+
+class _Member:
+    """One caller's predict riding a coalesced dispatch: a tiny future
+    (event + result/error slot) the leader scatters back into."""
+
+    __slots__ = ("X", "n", "trace_id", "deadline_s", "enqueued",
+                 "event", "result", "error")
+
+    def __init__(self, X: np.ndarray, trace_id: str,
+                 deadline_s: float | None):
+        self.X = X
+        self.n = int(X.shape[0]) if X.ndim >= 1 else 1
+        self.trace_id = trace_id
+        self.deadline_s = deadline_s
+        self.enqueued = time.monotonic()
+        self.event = threading.Event()
+        self.result = None
+        self.error: Exception | None = None
+
+    def remaining_s(self, now: float) -> float | None:
+        if self.deadline_s is None or not math.isfinite(self.deadline_s):
+            return None
+        return self.deadline_s - (now - self.enqueued)
+
+    def finish(self, result) -> None:
+        self.result = result
+        self.event.set()
+
+    def fail(self, error: Exception) -> None:
+        self.error = error
+        self.event.set()
+
+    def await_result(self):
+        """Bounded wait — a lost dispatch surfaces typed, never hangs.
+        The bound is a backstop well past any legitimate wire outcome
+        (failover may burn several per-attempt timeouts), not a
+        precision deadline (the dispatch path enforces those)."""
+        budget = (self.deadline_s
+                  if self.deadline_s and math.isfinite(self.deadline_s)
+                  else knobs.get_float("OTPU_FLEET_TIMEOUT_S") * 2) + 30.0
+        if not self.event.wait(budget):
+            raise ReplicaUnavailableError(
+                "coalesced dispatch never delivered within the bounded "
+                "wait", reason="coalesce_timeout", trace_id=self.trace_id)
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class FleetCoalescer:
+    """Cross-caller coalescing in front of replica selection — the PR-2
+    MicroBatcher contract one level up, on the router↔replica wire:
+    concurrent same-shape predicts from DIFFERENT callers merge into one
+    wire dispatch, and results scatter back per caller.
+
+    Leader/follower, no dedicated worker thread: a submitting caller
+    becomes a *leader* while fewer leaders than replicas are active, and
+    drains the pending queue — merging compatible members (2-D, same
+    columns/dtype) up to ``OTPU_FLEET_COALESCE_ROWS`` (the ladder-clamp:
+    the default matches the serving ladder's max bucket), optionally
+    lingering ``OTPU_FLEET_COALESCE_WAIT_MS`` to accumulate more — until
+    the queue is empty. Everyone else waits on a bounded future.
+
+    Per-member semantics are preserved: a member whose deadline expired
+    while queued is shed typed (``OverloadShedError``) while its
+    siblings dispatch; a failed dispatch delivers the SAME typed error
+    to every member (never a hang); hedging/breaker/failover operate on
+    the merged dispatch. A solo member dispatches with its own trace id
+    (the old wire exactly); a merged dispatch mints a wire id, counts
+    propagation once per member, and the members' ids ride flow events
+    (router-side ``s``/``t`` here, replica-side ``f`` via the
+    ``X-OTPU-Member-Traces`` header into the device dispatch)."""
+
+    def __init__(self, router: "FleetRouter"):
+        self._router = router
+        self._lock = threading.Lock()
+        self._pending: collections.deque[_Member] = collections.deque()
+        self._leaders = 0
+        # monotonically growing — FleetDigest reads them for merge factor
+        self.members = 0
+        self.dispatches = 0
+        self.sheds = 0
+
+    @staticmethod
+    def enabled() -> bool:
+        return (fastwire.fastwire_enabled()
+                and knobs.get_bool("OTPU_FLEET_COALESCE"))
+
+    def _cap(self) -> int:
+        # one leader per replica: merged dispatches can still saturate
+        # the pool, and a single caller stream serializes (max merge)
+        return max(1, len(self._router.endpoints))
+
+    def stats(self) -> dict:
+        with self._lock:
+            members, dispatches = self.members, self.dispatches
+            sheds, queued = self.sheds, len(self._pending)
+        return {"members": members, "dispatches": dispatches,
+                "sheds": sheds, "queued": queued,
+                "merge_factor": round(members / dispatches, 2)
+                if dispatches else 0.0}
+
+    # ------------------------------------------------------------ submit
+    def submit(self, X, trace_id: str, deadline_s: float | None,
+               use_hedge: bool):
+        m = _Member(np.asarray(X), trace_id, deadline_s)
+        with self._lock:
+            self._pending.append(m)
+            lead = self._leaders < self._cap()
+            if lead:
+                self._leaders += 1
+        if lead:
+            self._drain(use_hedge)
+        return m.await_result()
+
+    def _drain(self, use_hedge: bool) -> None:
+        wait_s = knobs.get_float("OTPU_FLEET_COALESCE_WAIT_MS") / 1e3
+        max_rows = max(1, knobs.get_int("OTPU_FLEET_COALESCE_ROWS"))
+        while True:
+            if wait_s > 0:
+                time.sleep(wait_s)      # bounded linger to gather members
+            with self._lock:
+                if not self._pending:
+                    # decrement ATOMICALLY with the empty check: submit
+                    # appends under this lock, so a racing caller either
+                    # sees our pending grab (we loop) or leaders-1 (it
+                    # leads itself) — nobody's member is left unowned
+                    self._leaders -= 1
+                    return
+                group = self._take_group_locked(max_rows)
+            self._dispatch(group, use_hedge)
+
+    def _take_group_locked(self, max_rows: int) -> list[_Member]:
+        first = self._pending.popleft()
+        key = _merge_key(first.X)
+        if key is None:
+            return [first]
+        group, rows, rest = [first], first.n, []
+        while self._pending:
+            m = self._pending.popleft()
+            if _merge_key(m.X) == key and rows + m.n <= max_rows:
+                group.append(m)
+                rows += m.n
+            else:
+                rest.append(m)
+        self._pending.extendleft(reversed(rest))
+        return group
+
+    # ---------------------------------------------------------- dispatch
+    def _dispatch(self, group: list[_Member], use_hedge: bool) -> None:
+        now = time.monotonic()
+        live: list[_Member] = []
+        for m in group:
+            rem = m.remaining_s(now)
+            if rem is not None and rem <= 0:
+                # this member's whole budget burned in the queue: shed
+                # typed per member — dispatching work whose caller
+                # already gave up is the waste deadlines exist to stop
+                with self._lock:
+                    self.sheds += 1
+                _M_CO_SHEDS.inc()
+                m.fail(OverloadShedError(
+                    reason="deadline", queue_depth=len(group),
+                    inflight=0, est_wait_s=0.0,
+                    deadline_s=m.deadline_s, trace_id=m.trace_id))
+                continue
+            live.append(m)
+        if not live:
+            return
+        with self._lock:
+            self.members += len(live)
+            self.dispatches += 1
+        _M_CO_MEMBERS.inc(len(live))
+        _M_CO_DISPATCHES.inc()
+        if len(live) == 1:
+            # solo: the member's own id IS the wire id — byte-identical
+            # to the uncoalesced wire (no extra header, no flow events)
+            m = live[0]
+            try:
+                m.finish(self._router._route(
+                    m.X, m.trace_id, m.remaining_s(now), use_hedge))
+            except Exception as e:  # noqa: BLE001 — delivered, not hung
+                m.fail(e)
+            return
+        from orange3_spark_tpu.obs.trace import flow
+
+        wire_id = new_trace_id("fleet")
+        deadlines = [r for r in (m.remaining_s(now) for m in live)
+                     if r is not None]
+        deadline = min(deadlines) if deadlines else None
+        for m in live:
+            flow("s", m.trace_id)
+        X = np.concatenate([m.X for m in live], axis=0)
+        for m in live:
+            flow("t", m.trace_id)
+        try:
+            out = self._router._route(
+                X, wire_id, deadline, use_hedge, weight=len(live),
+                member_traces=[m.trace_id for m in live])
+        except Exception as e:  # noqa: BLE001 — same typed error to all
+            for m in live:
+                m.fail(e)
+            return
+        out = np.asarray(out)
+        if out.ndim == 0 or out.shape[0] != X.shape[0]:
+            err = ReplicaUnavailableError(
+                f"coalesced response shape {out.shape} does not scatter "
+                f"over {X.shape[0]} merged rows", reason="scatter")
+            for m in live:
+                m.fail(err)
+            return
+        off = 0
+        for m in live:
+            m.finish(out[off:off + m.n])
+            off += m.n

@@ -279,6 +279,10 @@ class ReplicaManager:
             trace.instant("replica_kill", replica=replica_id,
                           pid=h.proc.pid)
             kill_process_group(h.proc, drain_s=5.0)
+            # a hard-killed replica leaves its UDS socket file behind;
+            # unlink it so clients fall back to TCP (and the stale-retry
+            # rung) instead of connecting a dead socket until restart
+            self._unlink_uds(h.port)
 
     def drain_stop(self, replica_id: int, *,
                    extra_wait_s: float = 5.0) -> int | None:
@@ -321,8 +325,20 @@ class ReplicaManager:
         if self._monitor is not None:
             self._monitor.join(timeout=5.0)
             self._monitor = None
-        return {h.replica_id: self.drain_stop(h.replica_id)
-                for h in self.handles}
+        codes = {h.replica_id: self.drain_stop(h.replica_id)
+                 for h in self.handles}
+        for h in self.handles:          # no orphan sockets under run dir
+            self._unlink_uds(h.port)
+        return codes
+
+    @staticmethod
+    def _unlink_uds(port: int) -> None:
+        from orange3_spark_tpu.fleet import fastwire
+
+        try:
+            fastwire.unlink_uds_socket(port)
+        except OSError:
+            pass
 
     def __enter__(self) -> "ReplicaManager":
         return self.start()

@@ -657,6 +657,10 @@ class FleetDigest:
     ewma_p95_ms: float | None
     slo: list[dict]
     stale_replicas: int
+    # data-plane fast path (fleet/fastwire.py): router-side connection
+    # pool totals (reuse %), coalescer merge stats and SHM byte counts —
+    # None when no router is attached or the fast wire never ran
+    wire: dict | None = None
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -1052,7 +1056,48 @@ class FleetCollector:
             at_wall=time.time(), scrape_s=self.scrape_s, replicas=loads,
             ewma_p95_ms=ewma_p95_ms,
             slo=(self.slo.last_verdicts if self.slo is not None else []),
-            stale_replicas=len(stale))
+            stale_replicas=len(stale),
+            wire=self._wire_stats())
+
+    def _wire_stats(self) -> dict | None:
+        """Aggregate the fast-wire signals off the attached router:
+        conn-pool reuse across its clients, coalescer merge factor, SHM
+        bytes moved (best-effort — absent pieces just drop out)."""
+        if self.router is None:
+            return None
+        wire: dict = {}
+        try:
+            opened = reused = stale_retries = 0
+            for ep in self.router.endpoints:
+                pool = getattr(ep.client, "pool", None)
+                if pool is None:
+                    continue
+                s = pool.stats()
+                opened += s["opened"]
+                reused += s["reused"]
+                stale_retries += s["stale_retries"]
+            total = opened + reused
+            wire["conn"] = {
+                "opened": opened, "reused": reused,
+                "stale_retries": stale_retries,
+                "reuse_pct": round(100.0 * reused / total, 2)
+                             if total else 0.0,
+            }
+        except Exception:  # noqa: BLE001 - best-effort signals
+            pass
+        try:
+            co = getattr(self.router, "coalescer", None)
+            if co is not None:
+                wire["coalesce"] = co.stats()
+        except Exception:  # noqa: BLE001 - best-effort signals
+            pass
+        try:
+            from orange3_spark_tpu.fleet import fastwire
+
+            wire["shm"] = fastwire.shm_stats()
+        except Exception:  # noqa: BLE001 - best-effort signals
+            pass
+        return wire or None
 
     # ------------------------------------------------------- trace assembly
     def assemble_trace(self, trace_id: str,

@@ -191,6 +191,11 @@ def profile_capture_body(path: str) -> tuple[int, dict]:
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "otpu-obs/1"
+    # HTTP/1.1 so fleet proxies reuse their keep-alive connection to us:
+    # every response goes through _send, which sets Content-Length — the
+    # invariant that makes connection reuse safe (audited in
+    # tests/test_fastwire.py)
+    protocol_version = "HTTP/1.1"
 
     def log_message(self, *args):  # serving stdout is not an access log
         pass
@@ -270,6 +275,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler contract
         try:
+            # drain the request body before responding: unread bytes on
+            # a keep-alive connection are parsed as the next request
+            n = int(self.headers.get("Content-Length") or 0)
+            if n:
+                self.rfile.read(n)
             route = self.path.split("?")[0]
             if route == "/debug/profile":
                 # on-demand deep capture (obs/prof.py): loopback-only

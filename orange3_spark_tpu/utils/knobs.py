@@ -167,6 +167,48 @@ _ALL = [
     Knob("OTPU_ROLLOUT_TIMEOUT_S", "float", 60.0, "fleet",
          "Per-replica budget for one rollout step (reload + warm + "
          "readiness re-poll) before the rollout aborts and rolls back."),
+    Knob("OTPU_FLEET_FASTWIRE", "flag", "1", "fleet",
+         "Fleet data-plane fast-path kill-switch; 0 = the PR-13 wire "
+         "bitwise (one fresh TCP connection + npy body per request, no "
+         "pooling, no SHM, no cross-caller coalescing)."),
+    Knob("OTPU_FLEET_POOL_CONNS", "int", 8, "fleet",
+         "Idle keep-alive connections a FleetClient pool retains per "
+         "replica (excess connections close on release)."),
+    Knob("OTPU_FLEET_SHM", "flag", "1", "fleet",
+         "Shared-memory zero-copy tensor wire for loopback replicas; "
+         "0 = arrays always ride the npy HTTP body (any SHM failure "
+         "also falls back there, typed, per request)."),
+    Knob("OTPU_FLEET_SHM_MIN_BYTES", "int", 1 << 22, "fleet",
+         "Payload floor for the SHM wire: arrays smaller than this ride "
+         "the npy body even with OTPU_FLEET_SHM=1 — below ~4 MiB the "
+         "segment create/map/unlink syscalls cost more than the socket "
+         "copies they avoid (0 = always use SHM, the parity-test "
+         "setting)."),
+    Knob("OTPU_FLEET_UDS", "flag", "0", "fleet",
+         "Unix-domain-socket RPC transport for loopback replicas; the "
+         "replica binds a 0600 socket under the fleet run dir next to "
+         "its TCP port and the client prefers it when the socket file "
+         "exists."),
+    Knob("OTPU_FLEET_RUN_DIR", "str", "", "fleet",
+         "Directory holding per-fleet runtime state (UDS socket files); "
+         "empty = otpu-fleet-<uid> under the system temp dir, created "
+         "0700."),
+    Knob("OTPU_FLEET_COALESCE", "flag", "1", "fleet",
+         "Router-side cross-caller coalescing: concurrent same-shape "
+         "predicts from different callers merge into one wire dispatch "
+         "before replica selection; 0 = every caller dispatches alone."),
+    Knob("OTPU_FLEET_COALESCE_WAIT_MS", "float", 0.0, "fleet",
+         "Extra bounded wait a coalescer leader lingers to accumulate "
+         "more members before dispatching (0 = merge only what is "
+         "already queued)."),
+    Knob("OTPU_FLEET_COALESCE_ROWS", "int", 4096, "fleet",
+         "Row cap on one coalesced wire dispatch (ladder-clamped merge "
+         "size: matches the default serving-ladder max bucket)."),
+    Knob("OTPU_FLEET_INPROC", "int", 0, "fleet",
+         "In-process multi-device replica mode: N > 0 serves through N "
+         "device-pinned lanes in THIS process (no sockets, no "
+         "serialization) behind the same router/breaker/hedge paths; "
+         "0 = subprocess replicas."),
     # ----------------------------------------------------------- online/
     Knob("OTPU_ONLINE", "flag", "1", "online",
          "Continuous train-while-serve kill-switch; 0 = the serving tap, "

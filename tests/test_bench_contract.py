@@ -132,7 +132,17 @@ def _run(argv, timeout=420):
       "fleet_bundle_replicas", "fleetobs_kill_switch_parity",
       # goodput & memory attribution (ISSUE 12): the parent fit's
       # decomposition + per-replica device-bytes via the fleet digest
-      "goodput", "ledger"}),
+      "goodput", "ledger",
+      # data-plane fast path (ISSUE 17): same-run wire A/B (fresh-TCP
+      # vs keep-alive vs SHM fast path), cross-caller coalescing under
+      # a concurrent same-model burst with full outcome accounting,
+      # and the OTPU_FLEET_FASTWIRE=0 bitwise parity pin
+      "wire_fresh_p50_ms", "wire_keepalive_p50_ms", "wire_fastpath_p50_ms",
+      "wire_keepalive_speedup", "wire_fastpath_speedup",
+      "coalesce_merge_factor", "coalesce_members", "coalesce_dispatches",
+      "coalesce_sheds", "wire_requests", "wire_ok", "wire_typed_failures",
+      "wire_lost", "wire_wrong", "wire_hung", "wire_conn_reuse_pct",
+      "wire_conn_stale_retries", "fastwire_kill_switch_parity"}),
     # guarded continuous learning (ISSUE 14): the train-while-serve
     # drill's five arms — continuous beats frozen on the shifted holdout,
     # an injected-drift candidate is rejected typed BEFORE any replica
@@ -326,6 +336,22 @@ def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
         assert len(led["replicas"]) == d["replicas"]
         assert any("serve_executables" in dev
                    for dev in led["replicas"].values()), led["replicas"]
+        # data-plane fast path (ISSUE 17 acceptance), semantics not just
+        # schema: keep-alive + SHM + coalescing hold small-predict p50
+        # to <= 1/3 of the fresh-TCP wire on the same run; the coalescer
+        # merged >= 2 members per wire dispatch under the concurrent
+        # burst with nothing lost or hung; OTPU_FLEET_FASTWIRE=0 served
+        # bitwise on the legacy one-connection-per-request wire
+        assert d["wire_fastpath_speedup"] >= 3.0, (
+            d["wire_fresh_p50_ms"], d["wire_fastpath_p50_ms"])
+        assert d["coalesce_merge_factor"] >= 2.0, d["coalesce_merge_factor"]
+        assert d["coalesce_dispatches"] >= 1
+        assert d["wire_lost"] == 0 and d["wire_hung"] == 0
+        assert d["wire_wrong"] == 0
+        assert (d["wire_ok"] + d["wire_typed_failures"]
+                == d["wire_requests"])
+        assert d["wire_conn_reuse_pct"] > 50.0, d["wire_conn_reuse_pct"]
+        assert d["fastwire_kill_switch_parity"] is True
     if "promotion_outcome" in extra_keys:
         # the continuous-learning claims (ISSUE 14 acceptance), semantics
         # not just schema. (1) learning: the continuously-trained

@@ -1,0 +1,461 @@
+"""Fleet data-plane fast path drills: the SHM zero-copy wire, the
+keep-alive connection pool, deadline propagation, the UDS transport,
+cross-caller coalescing under mixed deadlines, and the in-process lane
+mode — every rung of the failure ladder typed, never a hang, and
+``OTPU_FLEET_FASTWIRE=0`` restoring the legacy wire byte-for-byte."""
+
+from __future__ import annotations
+
+import http.client
+import importlib.util
+import json
+import os
+import socket
+import stat
+import threading
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.fleet import fastwire
+from orange3_spark_tpu.fleet.rpc import (
+    FleetClient,
+    ReplicaDrainingError,
+    ReplicaOverloadedError,
+    ReplicaServer,
+    ReplicaUnavailableError,
+)
+from orange3_spark_tpu.fleet.router import FleetRouter, ReplicaEndpoint
+from orange3_spark_tpu.resilience.overload import OverloadShedError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------- helpers
+class StubRuntime:
+    """The minimal runtime surface ReplicaServer documents: predict plus
+    the drain/health/version attributes — no ladder, no model dir."""
+
+    def __init__(self, fn=None, name="stub"):
+        self.name = name
+        self.version = "v-test"
+        self.draining = False
+        self.in_flight = 0
+        self.serving_context = None
+        self._fn = fn or (lambda X: np.asarray(X) * 2.0)
+
+    def predict(self, X):
+        return self._fn(np.asarray(X))
+
+    def health(self):
+        return {"ok": True}, True
+
+    def initiate_drain(self, reason=""):
+        self.draining = True
+
+    def reload(self, version):
+        return version
+
+
+def _fastwire_env(monkeypatch, **extra):
+    base = {"OTPU_FLEET_FASTWIRE": "1", "OTPU_FLEET_SHM": "0",
+            "OTPU_FLEET_UDS": "0", "OTPU_FLEET_COALESCE": "0"}
+    base.update(extra)
+    for k, v in base.items():
+        monkeypatch.setenv(k, v)
+
+
+def _fit_hashed(session):
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+
+    rng = np.random.default_rng(3)
+    X = np.concatenate([
+        rng.standard_normal((2048, 4)).astype(np.float32),
+        rng.integers(0, 500, (2048, 4)).astype(np.float32),
+    ], axis=1)
+    y = (rng.random(2048) < 0.3).astype(np.float32)
+    model = StreamingHashedLinearEstimator(
+        n_dims=1 << 10, n_dense=4, n_cat=4, epochs=1, step_size=0.05,
+        chunk_rows=1024,
+    ).fit_stream(array_chunk_source(X, y, chunk_rows=1024),
+                 session=session)
+    return model, X
+
+
+# ------------------------------------------------------------- SHM codec
+def test_shm_codec_roundtrip_bitwise_and_typed_failures():
+    """dump/load round-trips bitwise across dtypes and across the
+    sampled-CRC size boundary; a corrupt CRC and a vanished segment both
+    surface as ShmWireError (the typed 422/fallback rung), never as a
+    wrong array or an untyped crash."""
+    rng = np.random.default_rng(7)
+    arrays = [
+        rng.standard_normal((4, 3)).astype(np.float32),
+        rng.standard_normal((600_000,)).astype(np.float32),  # > full-CRC cap
+        rng.integers(-5, 5, (7, 2)).astype(np.int64),
+        np.zeros((1,), np.float64),
+    ]
+    for a in arrays:
+        body, seg = fastwire.dump_shm(a)
+        try:
+            out = fastwire.load_shm(body)
+            assert out.dtype == a.dtype and out.shape == a.shape
+            np.testing.assert_array_equal(out, a)
+        finally:
+            seg.cleanup()
+
+    a = rng.standard_normal((32, 4)).astype(np.float32)
+    body, seg = fastwire.dump_shm(a)
+    try:
+        desc = json.loads(body)
+        desc["crc32"] ^= 1
+        with pytest.raises(fastwire.ShmWireError):
+            fastwire.load_shm(json.dumps(desc).encode())
+        gone = dict(desc, name="otpu-nonexistent-xyz", crc32=0)
+        with pytest.raises(fastwire.ShmWireError):
+            fastwire.load_shm(json.dumps(gone).encode())
+    finally:
+        seg.cleanup()
+
+
+def test_shm_leak_guard_after_aborted_dispatch(monkeypatch):
+    """A predict whose dispatch dies before any response (connection
+    refused) must not strand its request segment: the client's finally
+    rung unlinks it, and the name sweep shows nothing new."""
+    _fastwire_env(monkeypatch, OTPU_FLEET_SHM="1",
+                  OTPU_FLEET_SHM_MIN_BYTES="0")
+    with socket.socket() as s:          # a port with nothing listening
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    before = set(fastwire.orphan_segments())
+    client = FleetClient("127.0.0.1", port, name="dead")
+    with pytest.raises(ReplicaUnavailableError):
+        client.predict(np.ones((16, 4), np.float32))
+    client.close()
+    leaked = set(fastwire.orphan_segments()) - before
+    assert not leaked, f"aborted dispatch leaked SHM segments: {leaked}"
+
+
+# ------------------------------------------------------ wire parity (SHM)
+def test_wire_parity_shm_vs_npy_across_models(session, iris, monkeypatch):
+    """The acceptance pin: for hashed, kmeans and logreg predicts the
+    SHM wire returns the SAME BYTES as the npy wire — and the SHM arm
+    demonstrably rode shared memory (the byte counter moved)."""
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.models.kmeans import KMeans
+    from orange3_spark_tpu.models.logistic_regression import (
+        LogisticRegression,
+    )
+
+    hashed, Xh = _fit_hashed(session)
+    Xi, _Yi, _ = iris.to_numpy()
+    Xi = np.asarray(Xi, np.float32)
+    km = KMeans(k=2, seed=1).fit(TpuTable.from_arrays(Xi, session=session))
+    lr = LogisticRegression(max_iter=100, reg_param=0.1).fit(iris)
+
+    def _table_fn(model):
+        return lambda A: model.predict(
+            TpuTable.from_arrays(np.asarray(A, np.float32),
+                                 session=session))
+
+    cases = [
+        ("hashed", hashed.predict, Xh[:64]),
+        ("kmeans", _table_fn(km), Xi[:32]),
+        ("logreg", _table_fn(lr), Xi[:32]),
+    ]
+    for name, fn, X in cases:
+        server = ReplicaServer(StubRuntime(fn, name=name)).start_background()
+        client = FleetClient("127.0.0.1", server.port, name=name)
+        try:
+            _fastwire_env(monkeypatch, OTPU_FLEET_SHM="0")
+            via_npy, h_npy = client.predict(X)
+            _fastwire_env(monkeypatch, OTPU_FLEET_SHM="1",
+                          OTPU_FLEET_SHM_MIN_BYTES="0")
+            bytes0 = fastwire.shm_stats()["bytes_total"]
+            via_shm, h_shm = client.predict(X)
+            assert fastwire.shm_stats()["bytes_total"] > bytes0, (
+                f"{name}: SHM arm never touched shared memory")
+            assert via_shm.dtype == via_npy.dtype
+            np.testing.assert_array_equal(via_shm, via_npy)
+            assert h_shm["X-OTPU-Version"] == h_npy["X-OTPU-Version"]
+        finally:
+            client.close()
+            server.shutdown()
+
+
+# ------------------------------------------------- keep-alive / pool rungs
+def test_keepalive_pool_reuse_and_control_plane(monkeypatch):
+    """One client, many requests: the pool reuses a persistent
+    connection (reuse counter moves, opened stays ~1), the /debug/* and
+    /drain control routes answer with Content-Length on the SAME
+    connection (keep-alive correctness), and a drained replica refuses
+    predicts typed."""
+    _fastwire_env(monkeypatch)
+    rt = StubRuntime()
+    server = ReplicaServer(rt).start_background()
+    client = FleetClient("127.0.0.1", server.port, name="ka")
+    try:
+        X = np.ones((8, 4), np.float32)
+        for _ in range(6):
+            out, _h = client.predict(X)
+        np.testing.assert_array_equal(out, X * 2.0)
+        st = client.pool.stats()
+        assert st["reused"] >= 5, st
+        assert st["opened"] <= 2, st
+
+        # one raw persistent connection, several control routes: every
+        # response must carry Content-Length or the next request on the
+        # connection would hang in read() forever
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=10)
+        try:
+            for route in ("/healthz", "/debug/stacks", "/debug/spans",
+                          "/metrics", "/healthz"):
+                conn.request("GET", route)
+                resp = conn.getresponse()
+                assert resp.getheader("Content-Length") is not None, route
+                resp.read()
+                assert resp.status == 200, route
+        finally:
+            conn.close()
+
+        status, body = client.post_json("/drain")
+        assert status == 200 and body["draining"] is True
+        with pytest.raises(ReplicaDrainingError):
+            client.predict(X)
+    finally:
+        client.close()
+        server.shutdown()
+
+
+def test_content_length_audit_rpc_and_obs_handlers():
+    """Source-level keep-alive audit: both HTTP/1.1 handlers (fleet rpc
+    and the obs server) set Content-Length in their single send path —
+    an unframed response under keep-alive wedges the client."""
+    for rel in ("orange3_spark_tpu/fleet/rpc.py",
+                "orange3_spark_tpu/obs/server.py"):
+        src = open(os.path.join(REPO, rel)).read()
+        assert 'protocol_version = "HTTP/1.1"' in src, rel
+        assert '"Content-Length"' in src, rel
+
+
+def test_legacy_wire_under_kill_switch(monkeypatch):
+    """OTPU_FLEET_FASTWIRE=0: no pooling (opened counter untouched), no
+    deadline header, same answers — the PR-13 wire bitwise."""
+    monkeypatch.setenv("OTPU_FLEET_FASTWIRE", "0")
+    rt = StubRuntime()
+    server = ReplicaServer(rt).start_background()
+    client = FleetClient("127.0.0.1", server.port, name="legacy")
+    try:
+        X = np.ones((4, 2), np.float32)
+        out, _h = client.predict(X, timeout_s=0.0)   # no header → served
+        np.testing.assert_array_equal(out, X * 2.0)
+        st = client.pool.stats()
+        assert st["opened"] == 0 and st["reused"] == 0, st
+    finally:
+        client.close()
+        server.shutdown()
+
+
+# --------------------------------------------------- deadline propagation
+def test_deadline_header_sheds_expired_typed(monkeypatch):
+    """An already-expired caller deadline rides X-OTPU-Deadline-Ms and
+    the replica sheds BEFORE touching the device — typed
+    ReplicaOverloadedError(reason='deadline'), not a wasted predict. A
+    live deadline serves normally."""
+    _fastwire_env(monkeypatch)
+    calls = []
+    rt = StubRuntime(fn=lambda X: calls.append(1) or np.asarray(X))
+    server = ReplicaServer(rt).start_background()
+    client = FleetClient("127.0.0.1", server.port, name="dl")
+    try:
+        X = np.ones((4, 2), np.float32)
+        with pytest.raises(ReplicaOverloadedError) as ei:
+            client.predict(X, timeout_s=0.0)
+        assert ei.value.reason == "deadline"
+        assert not calls, "expired request still reached the model"
+        out, _h = client.predict(X, timeout_s=30.0)
+        np.testing.assert_array_equal(out, X)
+        assert len(calls) == 1
+    finally:
+        client.close()
+        server.shutdown()
+
+
+# ------------------------------------------------------------- coalescing
+class EchoClient:
+    """FleetClient-shaped echo: first column back, accepts the merged
+    dispatch's member_traces header kwarg, counts rows per call."""
+
+    def __init__(self, name):
+        self.name = name
+        self.version = "v0001"
+        self.calls = []
+
+    def predict(self, X, *, trace_id=None, timeout_s=None, conn_slot=None,
+                member_traces=None):
+        X = np.asarray(X)
+        self.calls.append(int(X.shape[0]))
+        return X[:, 0], {"X-OTPU-Version": self.version,
+                         "X-OTPU-Trace-Id": trace_id}
+
+    def ready(self, *, timeout_s=None):
+        return True, {"ready": True, "version": self.version}
+
+
+def test_coalescer_merges_and_sheds_expired_member(monkeypatch):
+    """Three concurrent callers, one replica, a 40ms linger: the two
+    live members merge into ONE wire dispatch and scatter back their own
+    rows; the member whose whole budget burned in the queue is shed
+    typed (OverloadShedError) while its siblings complete — nothing
+    lost, nothing hung."""
+    _fastwire_env(monkeypatch, OTPU_FLEET_COALESCE="1",
+                  OTPU_FLEET_COALESCE_WAIT_MS="40")
+    ep = ReplicaEndpoint(0, "127.0.0.1", 0, client=EchoClient("replica-0"))
+    ep.ready = True
+    router = FleetRouter([ep], hedging=False)
+    try:
+        XA = np.full((4, 3), 1.0, np.float32)
+        XB = np.full((5, 3), 2.0, np.float32)
+        XC = np.full((6, 3), 3.0, np.float32)
+        results: dict = {}
+        barrier = threading.Barrier(3)
+
+        def call(key, X, deadline_s):
+            barrier.wait()
+            try:
+                results[key] = router.predict(X, deadline_s=deadline_s)
+            except Exception as e:  # noqa: BLE001 — recorded, asserted below
+                results[key] = e
+
+        threads = [
+            threading.Thread(target=call, args=("A", XA, None)),
+            threading.Thread(target=call, args=("B", XB, 0.001)),
+            threading.Thread(target=call, args=("C", XC, None)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(results) == 3, "a coalesced member hung"
+        np.testing.assert_array_equal(results["A"],
+                                      np.full(4, 1.0, np.float32))
+        np.testing.assert_array_equal(results["C"],
+                                      np.full(6, 3.0, np.float32))
+        assert isinstance(results["B"], OverloadShedError)
+        assert results["B"].reason == "deadline"
+        st = router.coalescer.stats()
+        assert st["sheds"] == 1 and st["members"] == 2, st
+        assert st["dispatches"] == 1 and st["merge_factor"] == 2.0, st
+        # the one wire dispatch carried BOTH live members' rows
+        assert ep.client.calls == [4 + 6], ep.client.calls
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------- UDS transport
+def test_uds_socket_perms_and_end_to_end(monkeypatch, tmp_path):
+    """OTPU_FLEET_UDS=1: the replica binds a companion AF_UNIX listener
+    whose socket file lives under the 0700 run dir with 0600 perms, the
+    client transports over it, and shutdown unlinks the file."""
+    run = str(tmp_path / "run")
+    _fastwire_env(monkeypatch, OTPU_FLEET_UDS="1")
+    monkeypatch.setenv("OTPU_FLEET_RUN_DIR", run)
+    server = ReplicaServer(StubRuntime()).start_background()
+    client = FleetClient("127.0.0.1", server.port, name="uds")
+    try:
+        path = fastwire.uds_socket_path(server.port, create_dir=False)
+        assert os.path.exists(path), "UDS socket file missing"
+        assert path.startswith(run + os.sep)
+        assert stat.S_IMODE(os.stat(path).st_mode) == 0o600
+        assert stat.S_IMODE(os.stat(run).st_mode) == 0o700
+        assert client._transport() == "uds"
+        X = np.ones((8, 4), np.float32)
+        for _ in range(3):
+            out, _h = client.predict(X)
+        np.testing.assert_array_equal(out, X * 2.0)
+        st = client.pool.stats()
+        assert st["reused"] >= 2, st
+    finally:
+        client.close()
+        server.shutdown()
+    assert not os.path.exists(path), "shutdown left the socket file"
+
+
+# ----------------------------------------------- pool vs SIGKILL + restart
+def test_pool_survives_replica_sigkill_and_restart(tmp_path, session,
+                                                   monkeypatch):
+    """The stale-socket rung end-to-end: a warmed pooled connection
+    points at a replica that gets SIGKILLed — every predict while it is
+    down fails TYPED (never an untyped socket error), and once the
+    supervisor restarts it the SAME client serves again over a fresh
+    pooled connection."""
+    from orange3_spark_tpu.fleet import rollout as ro
+    from orange3_spark_tpu.fleet.supervisor import ReplicaManager
+
+    _fastwire_env(monkeypatch)
+    model, X = _fit_hashed(session)
+    root = str(tmp_path / "models")
+    ro.publish_version(model, root, n_cols=8)
+    mgr = ReplicaManager(root, n_replicas=1, ladder_max=256,
+                         env={"JAX_PLATFORMS": "cpu"})
+    mgr.start()
+    try:
+        assert mgr.wait_ready(timeout_s=90)
+        client = mgr.client(0)
+        expect, _h = client.predict(X[:32])      # warm the pool
+        assert client.pool.stats()["opened"] >= 1
+        mgr.kill(0)
+        import time as _time
+
+        deadline = _time.monotonic() + 60
+        recovered = None
+        while _time.monotonic() < deadline:
+            try:
+                recovered, _h = client.predict(X[:32], timeout_s=5.0)
+                break
+            except (ReplicaUnavailableError, ReplicaDrainingError):
+                _time.sleep(0.2)       # typed while down — keep probing
+        assert recovered is not None, "replica never came back"
+        np.testing.assert_array_equal(recovered, expect)
+    finally:
+        mgr.stop_all()
+
+
+# -------------------------------------------------------- wire A/B smoke
+def test_wire_ab_smoke(session):
+    spec = importlib.util.spec_from_file_location(
+        "wire_ab", os.path.join(REPO, "tools", "wire_ab.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rec = mod.run_ab(session=session, rows=32, iters=2, warmup=1)
+    assert rec["metric"] == "wire_ab" and rec["parity"] is True
+    for key in ("fresh_p50_ms", "keepalive_p50_ms", "shm_p50_ms",
+                "keepalive_speedup", "shm_speedup", "conn_reuse_pct"):
+        assert rec[key] > 0 or key.endswith("speedup"), (key, rec)
+
+
+# -------------------------------------------------------- in-process lanes
+def test_inproc_lane_mode_no_subprocesses(session, tmp_path, monkeypatch):
+    """OTPU_FLEET_INPROC=N: the frontend runs N in-process lanes through
+    the SAME router/coalescer code path — no subprocesses, bitwise the
+    single-process answer, typed drain semantics."""
+    from orange3_spark_tpu.fleet import FleetFrontend
+
+    _fastwire_env(monkeypatch)
+    monkeypatch.setenv("OTPU_FLEET_INPROC", "2")
+    model, X = _fit_hashed(session)
+    fe = FleetFrontend(model, root=str(tmp_path / "models"), n_cols=8,
+                       hedging=False)
+    try:
+        assert fe.mode == "inproc"
+        assert fe.manager is None, "inproc mode spawned subprocesses"
+        assert len(fe.router.endpoints) == 2
+        out = fe.predict(X[:48])
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(model.predict(X[:48])))
+    finally:
+        fe.close()

@@ -614,8 +614,13 @@ def test_every_httpserver_bind_site_is_loopback_only():
     """Grep every HTTPServer construction in the source tree: the bind
     address must be the 127.0.0.1 literal — a new fleet/obs endpoint
     cannot accidentally listen beyond the host (exposure is a reverse
-    proxy's job, never a data-plane library's)."""
+    proxy's job, never a data-plane library's). AF_UNIX listeners are
+    the one sanctioned alternative: a bind whose window names
+    ``uds_socket_path(`` is a socket FILE under the fleet run dir
+    (0600, dir 0700 — asserted below), unreachable from the network by
+    construction."""
     sites = []
+    uds_sites = 0
     roots = [os.path.join(REPO, "orange3_spark_tpu"),
              os.path.join(REPO, "tools")]
     for root in roots:
@@ -632,6 +637,9 @@ def test_every_httpserver_bind_site_is_loopback_only():
                     window = text[m.end():m.end() + 120]
                     if window.lstrip().startswith(")"):
                         continue          # bare reference, not a bind
+                    if "uds_socket_path(" in window:
+                        uds_sites += 1    # AF_UNIX: file-perm scoped
+                        continue
                     sites.append((os.path.relpath(path, REPO),
                                   '"127.0.0.1"' in window, window))
     assert len(sites) >= 2, "HTTPServer grep found nothing — pattern rot?"
@@ -639,6 +647,15 @@ def test_every_httpserver_bind_site_is_loopback_only():
     assert not bad, (
         f"HTTPServer bind sites without the 127.0.0.1 literal: {bad} — "
         "fleet/obs listeners are loopback-only by contract")
+    # the UDS escape hatch must exist AND keep its permission contract:
+    # socket chmod 0600, run dir chmod 0700 (fleet/fastwire.py)
+    assert uds_sites >= 1, "UDS bind sites vanished — fastwire rot?"
+    with open(os.path.join(REPO, "orange3_spark_tpu", "fleet",
+                           "fastwire.py"), encoding="utf-8") as f:
+        fw = f.read()
+    assert "0o600" in fw and "0o700" in fw, (
+        "fastwire.py lost its 0600-socket/0700-run-dir chmods — the "
+        "permission contract the UDS lint exemption rests on")
 
 
 # ---------------------------------------------------- fleet_top smoke

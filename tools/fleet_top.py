@@ -61,6 +61,24 @@ def _render(digest: dict, slo: list, out=sys.stderr) -> None:
     print(f"[fleet-top] router ewma_p95_ms="
           f"{'-' if p95 is None else p95} "
           f"stale_replicas={digest['stale_replicas']}", file=out)
+    wire = digest.get("wire") or {}
+    if wire:
+        # the data-plane fast path at a glance (fleet/fastwire.py):
+        # connection reuse %, coalescer merge factor, SHM bytes moved
+        conn = wire.get("conn") or {}
+        co = wire.get("coalesce") or {}
+        shm = wire.get("shm") or {}
+        print(f"[fleet-top] wire conn_reuse="
+              f"{conn.get('reuse_pct', 0.0):.1f}% "
+              f"(opened={conn.get('opened', 0)} "
+              f"reused={conn.get('reused', 0)} "
+              f"stale_retries={conn.get('stale_retries', 0)}) "
+              f"merge_factor={co.get('merge_factor', 0.0):.2f} "
+              f"(members={co.get('members', 0)} "
+              f"dispatches={co.get('dispatches', 0)} "
+              f"sheds={co.get('sheds', 0)}) "
+              f"shm_mb={shm.get('bytes_total', 0.0) / 1e6:.2f} "
+              f"shm_fallbacks={shm.get('fallbacks', 0)}", file=out)
     for v in slo:
         fast = v["rules"]["fast"]
         print(f"[fleet-top] slo {v['slo']:<14} ({v['kind']}) "

@@ -1,0 +1,154 @@
+"""One-shot wire-mode A/B — the fleet data plane's three wires, raced.
+
+Serves a tiny CTR model from an in-process replica on loopback, then
+drives the SAME predict through each wire mode, interleaved round-robin
+(so OS-level drift hits every arm equally), and reports per-arm p50:
+
+* **fresh**      ``OTPU_FLEET_FASTWIRE=0`` — the PR-13 wire: one TCP
+  connect + npy body per request (the kill-switch baseline);
+* **keepalive**  fast path with SHM off — pooled persistent connection,
+  npy body;
+* **shm**        pooled connection + shared-memory zero-copy body (the
+  HTTP payload shrinks to a JSON segment descriptor).
+
+Knobs are read per call, so the arms flip by environment variable
+between requests — no restarts, same replica, same model, same rows.
+
+Importable: ``run_ab(...)`` returns the parsed record (tier-1 smoke in
+tests/test_fastwire.py). CLI prints it as JSON on stdout.
+
+Usage:
+    python tools/wire_ab.py [--rows 256] [--iters 40]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+from contextlib import contextmanager
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ARMS = (
+    ("fresh", {"OTPU_FLEET_FASTWIRE": "0"}),
+    ("keepalive", {"OTPU_FLEET_FASTWIRE": "1", "OTPU_FLEET_SHM": "0"}),
+    ("shm", {"OTPU_FLEET_FASTWIRE": "1", "OTPU_FLEET_SHM": "1"}),
+)
+
+
+@contextmanager
+def _env(overrides: dict):
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def run_ab(session=None, *, rows: int = 256, cols: int = 8,
+           iters: int = 40, warmup: int = 5) -> dict:
+    """Serve one replica, race the three wire modes over it, return
+    ``{"metric": "wire_ab", ...}`` with per-arm p50s and speedups."""
+    import numpy as np
+
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.fleet.replica import ReplicaRuntime
+    from orange3_spark_tpu.fleet.rollout import publish_version
+    from orange3_spark_tpu.fleet.rpc import FleetClient
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+    from orange3_spark_tpu.serve import BucketLadder
+
+    session = session or TpuSession.builder_get_or_create()
+    rng = np.random.default_rng(11)
+    Xf = np.concatenate([
+        rng.standard_normal((2048, cols // 2)).astype(np.float32),
+        rng.integers(0, 500, (2048, cols - cols // 2)).astype(np.float32),
+    ], axis=1)
+    y = (rng.random(2048) < 0.3).astype(np.float32)
+    model = StreamingHashedLinearEstimator(
+        n_dims=1 << 10, n_dense=cols // 2, n_cat=cols - cols // 2,
+        epochs=1, step_size=0.05, chunk_rows=1024,
+    ).fit_stream(array_chunk_source(Xf, y, chunk_rows=1024),
+                 session=session)
+    X = Xf[:rows]
+    tmp_root = tempfile.mkdtemp(prefix="otpu-wire-ab-")
+    runtime = None
+    client = None
+    try:
+        publish_version(model, tmp_root, n_cols=cols)
+        runtime = ReplicaRuntime(
+            tmp_root, name="wire-ab", session=session,
+            ladder=BucketLadder(min_bucket=64, max_bucket=1 << 10))
+        runtime.activate()
+        server = runtime.serve_background()
+        client = FleetClient("127.0.0.1", server.port, name="wire-ab")
+        expect = None
+        for name, env in ARMS:       # warm every arm (and check parity)
+            with _env(env):
+                for _ in range(max(warmup, 1)):
+                    out, _h = client.predict(X)
+                if expect is None:
+                    expect = out
+                parity = bool((out == expect).all())
+                if not parity:
+                    raise AssertionError(
+                        f"wire arm {name} changed the prediction bytes")
+        lat: dict[str, list] = {name: [] for name, _ in ARMS}
+        for _ in range(max(iters, 1)):
+            for name, env in ARMS:   # interleaved: drift hits all arms
+                with _env(env):
+                    t0 = time.perf_counter()
+                    client.predict(X)
+                    lat[name].append((time.perf_counter() - t0) * 1e3)
+        p50 = {n: round(statistics.median(v), 4) for n, v in lat.items()}
+        pool = client.pool.stats()
+        return {
+            "metric": "wire_ab",
+            "value": round(p50["fresh"] / max(p50["shm"], 1e-9), 3),
+            "unit": "x_fresh_over_shm",
+            "vs_baseline": None,
+            "rows": rows,
+            "iters": iters,
+            "fresh_p50_ms": p50["fresh"],
+            "keepalive_p50_ms": p50["keepalive"],
+            "shm_p50_ms": p50["shm"],
+            "keepalive_speedup": round(
+                p50["fresh"] / max(p50["keepalive"], 1e-9), 3),
+            "shm_speedup": round(p50["fresh"] / max(p50["shm"], 1e-9), 3),
+            "conn_reuse_pct": pool["reuse_pct"],
+            "parity": True,
+        }
+    finally:
+        if client is not None:
+            client.close()
+        if runtime is not None:
+            runtime.close()
+        import shutil
+
+        shutil.rmtree(tmp_root, ignore_errors=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=40)
+    args = ap.parse_args()
+    sys.path.insert(0, REPO)
+    print(json.dumps(run_ab(rows=args.rows, iters=args.iters)))
+
+
+if __name__ == "__main__":
+    main()
