@@ -11,6 +11,11 @@ All call sites go through ``put_sharded`` which is gated on
 ``jax.process_count()``: single-process keeps the plain ``device_put`` fast
 path, multi-process switches to the global-assembly path with IDENTICAL call
 signatures — the estimator/table code never knows which world it is in.
+
+``io.streaming.sharded_csv_chunk_source`` builds the per-process blocks
+(slice + zero-weight lockstep padding) so they arrive here pre-validated;
+hand-rolled blocks that violate the equal-rows contract raise the typed
+:class:`RaggedHostBlockError` below instead of an opaque jax shape error.
 """
 
 from __future__ import annotations
@@ -18,8 +23,35 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-__all__ = ["put_sharded", "process_row_slice", "shard_paths",
-           "shard_row_groups"]
+__all__ = ["RaggedHostBlockError", "put_sharded", "process_row_slice",
+           "lockstep_rows", "shard_paths", "shard_row_groups"]
+
+
+class RaggedHostBlockError(ValueError):
+    """A per-process row block cannot tile the sharded row axis.
+
+    Raised by :func:`put_sharded` BEFORE handing the block to
+    ``jax.make_array_from_process_local_data`` (whose own failure mode is an
+    opaque shape-assembly error). The usual cause is a ragged LAST block —
+    the file's row count doesn't divide evenly across processes/devices.
+    The fix is the weight-mask pad convention from ``put_sharded``'s
+    docstring: pad every process's block to the common row target
+    (``lockstep_rows``) with dead rows carrying sample weight ``w=0``,
+    which the weighted estimators ignore exactly.
+    """
+
+
+def _row_shard_count(sharding) -> int:
+    """Global shard count along dim 0 of ``sharding`` (1 if unsharded)."""
+    spec = getattr(sharding, "spec", None)
+    mesh = getattr(sharding, "mesh", None)
+    if spec is None or mesh is None or not len(spec) or spec[0] is None:
+        return 1
+    axes = (spec[0],) if isinstance(spec[0], str) else tuple(spec[0])
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    return n
 
 
 def put_sharded(local: np.ndarray, sharding, *, force_global: bool = False):
@@ -29,13 +61,32 @@ def put_sharded(local: np.ndarray, sharding, *, force_global: bool = False):
     array is PROCESS-LOCAL rows; every process contributes its block and the
     returned array's shape is the GLOBAL concatenation along the sharded
     row axis. Every process must contribute the same local row count (pad
-    with the table's weight-mask semantics first).
+    with the table's weight-mask semantics first: dead rows with ``w=0``,
+    padded up to ``lockstep_rows``).
+
+    A block whose row count cannot tile this process's local shards of the
+    row axis raises :class:`RaggedHostBlockError` (typed, pre-validated)
+    rather than surfacing as an opaque assembly error.
 
     force_global exercises the multi-process assembly path in single-process
     tests (with one process, local block == global array).
     """
-    if jax.process_count() == 1 and not force_global:
+    pc = jax.process_count()
+    if pc == 1 and not force_global:
         return jax.device_put(local, sharding)
+    shards0 = _row_shard_count(sharding)
+    local_shards0 = max(1, shards0 // pc)
+    n = int(np.shape(local)[0]) if np.ndim(local) else 0
+    if n == 0 or n % local_shards0:
+        raise RaggedHostBlockError(
+            f"ragged host block: process {jax.process_index()}/{pc} "
+            f"contributed {n} local rows, which cannot tile its "
+            f"{local_shards0} local shard(s) of the row axis "
+            f"({shards0} global shards over {pc} processes). Every process "
+            "must contribute the same local row count — pad the last block "
+            "to the common per-host target (lockstep_rows) with the "
+            "table's weight-mask semantics (dead rows, w=0) before "
+            "put_sharded.")
     return jax.make_array_from_process_local_data(sharding, local)
 
 
@@ -48,6 +99,16 @@ def process_row_slice(n_total: int) -> slice:
     base, rem = divmod(n_total, pc)
     start = pi * base + min(pi, rem)
     return slice(start, start + base + (1 if pi < rem else 0))
+
+
+def lockstep_rows(n_total: int) -> int:
+    """Rows EVERY process must emit per epoch for ``n_total`` shared rows:
+    the largest ``process_row_slice`` block. Processes holding a smaller
+    slice pad the difference with dead ``w=0`` rows (the weight-mask pad
+    convention) so all gang members run identical chunk schedules — the
+    lockstep contract the global collectives require."""
+    base, rem = divmod(n_total, jax.process_count())
+    return base + (1 if rem else 0)
 
 
 def shard_paths(paths) -> list[str]:

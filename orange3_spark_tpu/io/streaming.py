@@ -105,9 +105,163 @@ def csv_raw_chunk_source(
     return open_stream
 
 
+def sharded_csv_chunk_source(
+    path, class_col: str = "", *, shard_total_rows: int | None = None,
+    chunk_rows: int = 1 << 20, delimiter: str = ",", header: bool = True,
+    n_threads: int = 0,
+) -> Callable[[], Iterator[Chunk]]:
+    """Per-host CSV ingest for multi-process fits (docs/multihost.md).
+
+    Single shared file: every process streams only its contiguous
+    ``io.multihost.process_row_slice(shard_total_rows)`` row block (the
+    parse STOPS at the block's end, so rows past it are never decoded),
+    then re-chunks the block into an emission schedule that is IDENTICAL
+    on every gang member: ``ceil(lockstep_rows/chunk_rows)`` chunks, all
+    of ``chunk_rows`` rows but the last. A process holding fewer rows than
+    the common per-host target tops up with dead rows (features 0, label
+    0, weight 0 — the weight-mask pad convention ``put_sharded`` names),
+    so all processes run the same chunk schedule and the global
+    collectives stay in lockstep.
+
+    ``path`` may also be a LIST of paths: file-per-executor splitting via
+    ``io.multihost.shard_paths`` (round-robin; ``shard_total_rows`` is
+    ignored). In that mode the caller owns row-count balance across
+    processes — ragged totals raise typed at ``put_sharded``.
+
+    Under ``OTPU_MULTIHOST=0`` (the kill-switch) the single-path form IS
+    ``csv_chunk_source`` — the pre-multihost stream, bitwise. With the
+    switch on in a single process over a file holding exactly
+    ``shard_total_rows`` rows, the emitted chunks are the parser's own
+    buffers unchanged (same values, zero extra copies).
+
+    Yields ``(X, y, w)`` triples (``array_chunk_source``'s form): ``w`` is
+    ``None`` on pure-data chunks and a 0-mask tail on padded ones."""
+    from orange3_spark_tpu.io.multihost import (lockstep_rows,
+                                                process_row_slice,
+                                                shard_paths)
+    from orange3_spark_tpu.utils import knobs
+
+    if isinstance(path, (list, tuple)):
+        multi = knobs.get_bool("OTPU_MULTIHOST")
+        paths = (shard_paths(path) if multi
+                 else sorted(str(p) for p in path))
+
+        def open_paths() -> Iterator[Chunk]:
+            for p in paths:
+                yield from csv_chunk_source(
+                    p, class_col, chunk_rows=chunk_rows,
+                    delimiter=delimiter, header=header,
+                    n_threads=n_threads)()
+
+        return open_paths
+
+    if not knobs.get_bool("OTPU_MULTIHOST"):
+        return csv_chunk_source(path, class_col, chunk_rows=chunk_rows,
+                                delimiter=delimiter, header=header,
+                                n_threads=n_threads)
+    if shard_total_rows is None:
+        raise ValueError(
+            "sharded_csv_chunk_source over a single shared file needs "
+            "shard_total_rows (the file's exact row count) to assign "
+            "process row blocks")
+    n_total = int(shard_total_rows)
+    has_y = bool(class_col)
+    inner = csv_chunk_source(path, class_col, chunk_rows=chunk_rows,
+                             delimiter=delimiter, header=header,
+                             n_threads=n_threads)
+
+    def open_stream() -> Iterator[Chunk]:
+        sl = process_row_slice(n_total)
+        target = lockstep_rows(n_total)
+        if target == 0:
+            return
+        k = -(-target // chunk_rows)
+        sizes = [chunk_rows] * (k - 1) + [target - chunk_rows * (k - 1)]
+        pend: list[tuple] = []      # sliced (X, y, w) pieces pending emit
+        pend_n = 0
+
+        def take(s: int) -> Chunk:
+            nonlocal pend_n
+            pieces, got = [], 0
+            while got < s:
+                X, y, w = pend[0]
+                need = s - got
+                if len(X) <= need:
+                    pend.pop(0)
+                    pieces.append((X, y, w))
+                    got += len(X)
+                else:
+                    pieces.append((X[:need],
+                                   None if y is None else y[:need],
+                                   None if w is None else w[:need]))
+                    pend[0] = (X[need:],
+                               None if y is None else y[need:],
+                               None if w is None else w[need:])
+                    got = s
+            pend_n -= s
+            if len(pieces) == 1:
+                return pieces[0]
+            Xo = np.concatenate([p[0] for p in pieces])
+            yo = (np.concatenate([p[1] for p in pieces]) if has_y
+                  else None)
+            if all(p[2] is None for p in pieces):
+                wo = None
+            else:
+                wo = np.concatenate([
+                    np.ones(len(p[0]), np.float32) if p[2] is None else p[2]
+                    for p in pieces])
+            return Xo, yo, wo
+
+        pos = have = si = 0
+        n_feat = None
+        it = inner()
+        try:
+            for c in it:
+                X, y = c[0], c[1]
+                base, n = pos, len(X)
+                pos += n
+                if n_feat is None:
+                    n_feat = X.shape[1]
+                lo, hi = max(sl.start, base), min(sl.stop, base + n)
+                if hi > lo:
+                    pend.append((X[lo - base:hi - base],
+                                 None if y is None else y[lo - base:hi - base],
+                                 None))
+                    pend_n += hi - lo
+                    have += hi - lo
+                    while si < len(sizes) and pend_n >= sizes[si]:
+                        yield take(sizes[si])
+                        si += 1
+                if pos >= sl.stop:
+                    break       # our block is done — stop parsing
+        finally:
+            it.close()
+        if have < sl.stop - sl.start:
+            raise ValueError(
+                f"sharded_csv_chunk_source: {path!r} exhausted at row "
+                f"{pos} — shard_total_rows={n_total} overstates the file, "
+                f"process {sl} holds only {have} rows")
+        dead = target - have
+        if dead:
+            if n_feat is None:
+                raise ValueError(
+                    f"sharded_csv_chunk_source: {path!r} holds no data "
+                    "rows — cannot shape the lockstep dead-row padding")
+            pend.append((np.zeros((dead, n_feat), np.float32),
+                         np.zeros((dead,), np.float32) if has_y else None,
+                         np.zeros((dead,), np.float32)))
+            pend_n += dead
+        while si < len(sizes) and pend_n >= sizes[si]:
+            yield take(sizes[si])
+            si += 1
+
+    return open_stream
+
+
 def parquet_chunk_source(
     path: str, class_col: str = "", *, chunk_rows: int = 1 << 20,
     columns: tuple | None = None, row_groups: tuple | None = None,
+    shard: bool = False,
 ) -> Callable[[], Iterator[Chunk]]:
     """Re-iterable chunk source over a parquet file, read ROW-GROUP-AT-A-
     TIME — the out-of-core ingest regime was CSV-only through round 4
@@ -121,10 +275,20 @@ def parquet_chunk_source(
     split out; returns a zero-arg callable (epochs restart the stream).
     ``row_groups`` restricts the stream to those group indices — pass
     ``io.multihost.shard_row_groups(path)`` for single-file multihost
-    ingest (Spark's parquet input splits)."""
+    ingest (Spark's parquet input splits), or just ``shard=True`` to have
+    the source pick this process's contiguous group range itself (inert
+    under ``OTPU_MULTIHOST=0`` or an explicit ``row_groups``; row-group
+    splitting has no lockstep padding, so the caller owns group balance
+    across processes — ragged totals raise typed at ``put_sharded``)."""
     import pyarrow.parquet as pq
 
     def open_stream() -> Iterator[Chunk]:
+        groups = row_groups
+        if shard and groups is None:
+            from orange3_spark_tpu.io.multihost import shard_row_groups
+            from orange3_spark_tpu.utils import knobs
+            if knobs.get_bool("OTPU_MULTIHOST"):
+                groups = shard_row_groups(path)
         pf = pq.ParquetFile(path)
         try:
             names = list(columns) if columns else [
@@ -137,8 +301,8 @@ def parquet_chunk_source(
                 ci = names.index(class_col)
             for batch in pf.iter_batches(batch_size=chunk_rows,
                                          columns=names,
-                                         row_groups=list(row_groups)
-                                         if row_groups is not None
+                                         row_groups=list(groups)
+                                         if groups is not None
                                          else None):
                 cols = [
                     batch.column(j).to_numpy(zero_copy_only=False)
@@ -155,24 +319,32 @@ def parquet_chunk_source(
 
 def parquet_raw_chunk_source(
     path: str, *, chunk_rows: int = 1 << 20, columns: tuple | None = None,
-    row_groups: tuple | None = None,
+    row_groups: tuple | None = None, shard: bool = False,
 ) -> Callable[[], Iterator[np.ndarray]]:
     """Parquet twin of ``csv_raw_chunk_source``: RAW [n, ncols] f32 chunks
     with no host-side label split, for estimators' ``label_in_chunk`` mode
     (the label column is sliced inside the jit). Row-group-at-a-time like
     ``parquet_chunk_source``, so the 1B-row streaming/spill path works
     from parquet exactly as from CSV; ``row_groups`` +
-    ``io.multihost.shard_row_groups`` give single-file multihost ingest."""
+    ``io.multihost.shard_row_groups`` (or ``shard=True`` to auto-pick this
+    process's range, inert under ``OTPU_MULTIHOST=0``) give single-file
+    multihost ingest."""
     import pyarrow.parquet as pq
 
     def open_stream() -> Iterator[np.ndarray]:
+        groups = row_groups
+        if shard and groups is None:
+            from orange3_spark_tpu.io.multihost import shard_row_groups
+            from orange3_spark_tpu.utils import knobs
+            if knobs.get_bool("OTPU_MULTIHOST"):
+                groups = shard_row_groups(path)
         pf = pq.ParquetFile(path)
         try:
             for batch in pf.iter_batches(batch_size=chunk_rows,
                                          columns=list(columns)
                                          if columns else None,
-                                         row_groups=list(row_groups)
-                                         if row_groups is not None
+                                         row_groups=list(groups)
+                                         if groups is not None
                                          else None):
                 yield np.column_stack([
                     batch.column(j).to_numpy(zero_copy_only=False)

@@ -3,5 +3,17 @@ from orange3_spark_tpu.parallel.collectives import (
     distributed_gramian,
     tree_aggregate,
 )
+from orange3_spark_tpu.parallel.partitioner import (
+    BasePartitioner,
+    DataParallelPartitioner,
+    SPMDPartitioner,
+)
 
-__all__ = ["data_parallel_sum", "distributed_gramian", "tree_aggregate"]
+__all__ = [
+    "data_parallel_sum",
+    "distributed_gramian",
+    "tree_aggregate",
+    "BasePartitioner",
+    "DataParallelPartitioner",
+    "SPMDPartitioner",
+]

@@ -1,0 +1,340 @@
+"""Fleet-style multi-process training coordinator (docs/multihost.md).
+
+``MultihostLauncher`` is to TRAINING what ``fleet/supervisor.py`` is to
+serving: it spawns N training processes as one GANG rendezvousing through
+``jax.distributed.initialize``, watches them, and owns the cross-host
+resilience ladder —
+
+  * a process that dies (crash, SIGKILL, OOM) is detected TYPED within the
+    poll interval: the collective the survivors are blocked in can never
+    complete, so the launcher kills the remainder of the gang instead of
+    letting it hang (the PR-6 watchdog pattern, applied across processes);
+  * the whole gang restarts after a seeded exponential backoff
+    (``resilience/retry.py RetryPolicy`` — the supervisor's schedule), up
+    to ``OTPU_MULTIHOST_RESTARTS`` times;
+  * before each restart the per-rank epoch-boundary checkpoints are
+    ALIGNED to the newest step every rank holds (a kill can land between
+    two ranks' saves) so the resumed gang re-enters lockstep at one common
+    step — each worker's shard source then fast-forwards through the
+    replayed prefix exactly like ``resilient_source`` replays a lost
+    chunk;
+  * a gang still running past ``OTPU_MULTIHOST_WALL_S`` is a WEDGE, not
+    a slow fit: it is killed and counted as a lost host.
+
+Budget exhausted -> :class:`HostLostError` (typed, carrying the rank, exit
+code and log tail) — never a hang.
+
+``cross_process_collectives_supported()`` is the ONE probe for "can this
+jaxlib actually run a cross-process CPU computation" — tests and the bench
+all route through it (its reason string is the canonical skip message,
+naming the jaxlib version).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+from orange3_spark_tpu.obs.registry import REGISTRY
+from orange3_spark_tpu.resilience.retry import RetryPolicy
+from orange3_spark_tpu.utils import knobs
+from orange3_spark_tpu.utils.procs import kill_process_group
+
+__all__ = ["HostLostError", "GangResult", "MultihostLauncher",
+           "cross_process_collectives_supported"]
+
+_M_GANGS = REGISTRY.counter(
+    "otpu_multihost_gang_starts_total",
+    "Training-gang launches (initial attempts plus restarts).")
+_M_LOST = REGISTRY.counter(
+    "otpu_multihost_hosts_lost_total",
+    "Training processes lost mid-gang (crash/SIGKILL/wall-budget wedge).")
+_M_RESTARTS = REGISTRY.counter(
+    "otpu_multihost_gang_restarts_total",
+    "Gang restarts taken after a lost host (resume from aligned "
+    "epoch-boundary checkpoints).")
+
+
+class HostLostError(RuntimeError):
+    """A training host died (or wedged) and the restart budget is spent.
+
+    Typed — the launcher never lets a dead rank surface as a hang: the
+    surviving ranks' collectives are killed with it. Carries the first
+    failed ``rank`` (-1 for a wall-budget wedge with no dead process),
+    its exit code, the restarts already taken, and the rank's log tail."""
+
+    def __init__(self, rank: int, returncode, restarts: int, tail: str = ""):
+        self.rank, self.returncode, self.restarts = rank, returncode, restarts
+        self.tail = tail
+        what = (f"wedged past the OTPU_MULTIHOST_WALL_S budget"
+                if rank < 0 else
+                f"rank {rank} exited {returncode}")
+        super().__init__(
+            f"multihost gang lost: {what} after {restarts} gang "
+            f"restart(s); log tail:\n{tail}")
+
+
+@dataclasses.dataclass
+class GangResult:
+    """One successful gang run (possibly after restarts)."""
+    n_processes: int
+    gang_starts: int
+    gang_restarts: int
+    hosts_lost: int
+    wall_s: float
+    coord_addr: str
+    log_paths: list
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _tail(path: str, n_bytes: int = 2000) -> str:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            f.seek(max(0, f.tell() - n_bytes))
+            return f.read().decode(errors="replace")
+    except OSError:
+        return ""
+
+
+class MultihostLauncher:
+    """Spawn and supervise one N-process training gang.
+
+    ``argv_for_rank(rank, n_processes, coord_addr) -> list[str]`` builds
+    each rank's command line (usually ``python -m
+    orange3_spark_tpu.parallel.mh_worker ...``). Ranks log to per-rank
+    files under ``log_dir`` (pipes would deadlock a chatty gang)."""
+
+    def __init__(self, argv_for_rank, n_processes: int | None = None, *,
+                 env: dict | None = None, log_dir: str | None = None,
+                 max_gang_restarts: int | None = None,
+                 wall_s: float | None = None,
+                 coord_port: int | None = None,
+                 align_ckpt_dir: str | None = None,
+                 poll_s: float = 0.05, seed: int = 0):
+        self.argv_for_rank = argv_for_rank
+        self.n = int(n_processes
+                     or (knobs.get_int("OTPU_MULTIHOST_PROCS") or 2))
+        self.env = dict(env) if env is not None else dict(os.environ)
+        self.log_dir = log_dir or tempfile.mkdtemp(prefix="otpu-mh-")
+        os.makedirs(self.log_dir, exist_ok=True)
+        self.max_gang_restarts = (knobs.get_int("OTPU_MULTIHOST_RESTARTS")
+                                  if max_gang_restarts is None
+                                  else int(max_gang_restarts))
+        self.wall_s = (knobs.get_float("OTPU_MULTIHOST_WALL_S")
+                       if wall_s is None else float(wall_s))
+        self.coord_port = (knobs.get_int("OTPU_MULTIHOST_COORD_PORT")
+                           if coord_port is None else int(coord_port))
+        self.align_ckpt_dir = align_ckpt_dir
+        self.poll_s = poll_s
+        # the supervisor's seeded backoff schedule, one ladder per gang
+        self._policy = RetryPolicy.from_env(seed=seed)
+
+    # ------------------------------------------------------------ restarts
+    @staticmethod
+    def align_checkpoints(ckpt_dir: str, n_processes: int) -> int:
+        """Coordinated-resume rule: every rank must re-enter the gang at
+        ONE common step (a kill can land after rank 0's epoch save but
+        before rank 1's — mismatched resume points diverge the lockstep
+        collectives). The common step is the newest one ALL ranks can
+        reach: the minimum saved step. A rank holding a different step
+        gets a COPY of a common-step donor snapshot — legal because the
+        data-parallel optimizer state is replicated, so any rank's
+        snapshot at step S is every rank's state at step S. If no rank
+        holds a usable snapshot (common == 0) all checkpoints are
+        dropped and the gang restarts from scratch. Returns the common
+        step."""
+        steps = {}
+        for rank in range(n_processes):
+            path = os.path.join(ckpt_dir, f"rank{rank}.ckpt")
+            try:
+                with open(path, "rb") as f:
+                    steps[path] = int(pickle.load(f)["step"])
+            except (OSError, KeyError, ValueError, EOFError,
+                    pickle.UnpicklingError):
+                steps[path] = 0
+        common = min(steps.values()) if steps else 0
+        if common == 0:
+            for path in steps:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            return 0
+        donor = next(p for p, s in steps.items() if s == common)
+        for path, step in steps.items():
+            if step != common:
+                shutil.copyfile(donor, path)
+        return common
+
+    # ----------------------------------------------------------------- run
+    def run(self) -> GangResult:
+        t0 = time.perf_counter()
+        restarts = lost = 0
+        log_paths = [os.path.join(self.log_dir, f"rank{r}.log")
+                     for r in range(self.n)]
+        while True:
+            _M_GANGS.inc()
+            port = self.coord_port or _free_port()
+            coord = f"127.0.0.1:{port}"
+            procs, logs = [], []
+            try:
+                for r in range(self.n):
+                    f = open(log_paths[r], "ab")
+                    logs.append(f)
+                    procs.append(subprocess.Popen(
+                        self.argv_for_rank(r, self.n, coord),
+                        stdout=f, stderr=subprocess.STDOUT,
+                        env=self.env, start_new_session=True))
+                failed_rank, failed_rc = self._watch(procs)
+            finally:
+                for p in procs:
+                    if p.poll() is None:
+                        kill_process_group(p, grace_s=0.0, drain_s=2.0)
+                for f in logs:
+                    f.close()
+            if failed_rank is None:
+                return GangResult(
+                    n_processes=self.n,
+                    gang_starts=restarts + 1,
+                    gang_restarts=restarts,
+                    hosts_lost=lost,
+                    wall_s=round(time.perf_counter() - t0, 3),
+                    coord_addr=coord,
+                    log_paths=log_paths)
+            lost += 1
+            _M_LOST.inc()
+            tail = _tail(log_paths[max(failed_rank, 0)])
+            if restarts >= self.max_gang_restarts:
+                raise HostLostError(failed_rank, failed_rc, restarts, tail)
+            _M_RESTARTS.inc()
+            if self.align_ckpt_dir:
+                self.align_checkpoints(self.align_ckpt_dir, self.n)
+            time.sleep(self._policy.delay(restarts))
+            restarts += 1
+
+    def _watch(self, procs) -> tuple:
+        """Poll the gang. Returns ``(None, None)`` when every rank exited
+        0; otherwise the first failed rank and its exit code (``(-1,
+        None)`` for a wall-budget wedge)."""
+        deadline = time.monotonic() + self.wall_s
+        while True:
+            codes = [p.poll() for p in procs]
+            for r, rc in enumerate(codes):
+                if rc is not None and rc != 0:
+                    return r, rc
+            if all(rc == 0 for rc in codes):
+                return None, None
+            if time.monotonic() >= deadline:
+                return -1, None
+            time.sleep(self.poll_s)
+
+
+# ===================================================== capability probe
+
+_PROBE_SRC = r"""
+import os, sys
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+rank, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=n,
+                           process_id=rank)
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+devs = np.asarray(jax.devices())
+mesh = Mesh(devs, ("data",))
+sh = NamedSharding(mesh, PartitionSpec("data"))
+local = np.arange(len(jax.local_devices()), dtype=np.float32) + 1.0
+g = jax.make_array_from_process_local_data(sh, local)
+out = float(jax.jit(lambda a: a.sum())(g))
+print("OTPU_PROBE xproc sum", out, flush=True)
+"""
+
+#: the definitive can't-ever-work signature (vs a transient sandbox error)
+_DEFINITIVE = "aren't implemented on the CPU backend"
+
+
+def _probe_cache_path() -> str:
+    import jaxlib
+    ver = getattr(jaxlib, "__version__", "unknown")
+    key = hashlib.sha1(_PROBE_SRC.encode()).hexdigest()[:8]
+    return os.path.join(
+        tempfile.gettempdir(),
+        f"otpu_xproc_{os.getuid()}_{ver}_{key}.json")
+
+
+def cross_process_collectives_supported(*, force_refresh: bool = False):
+    """-> ``(ok, reason)``: can this jaxlib run a REAL cross-process CPU
+    computation? Probes once with a 2-process gang (``jax.distributed``
+    bring-up + global assembly + one jitted all-device sum) and caches
+    the verdict per jaxlib version in the tempdir (own-uid files only —
+    the conftest XLA-flag probe's trust protocol). A negative verdict is
+    cached only on the definitive "not implemented on this backend"
+    signature so a transient sandbox failure re-probes next run.
+
+    ``reason`` names the jaxlib version — it is THE skip message for
+    every true-multi-process test, and the bench's fallback-mode note."""
+    import jaxlib
+    ver = getattr(jaxlib, "__version__", "unknown")
+    cache = _probe_cache_path()
+    if not force_refresh:
+        try:
+            if os.stat(cache).st_uid == os.getuid():
+                with open(cache) as f:
+                    d = json.load(f)
+                return bool(d["ok"]), str(d.get("reason", ""))
+        except (OSError, ValueError, KeyError):
+            pass
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p)
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _PROBE_SRC, str(i), "2", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env=env, start_new_session=True) for i in range(2)]
+    outs, timed_out = [], False
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out.decode(errors="replace"))
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            kill_process_group(p, grace_s=0.0, drain_s=2.0)
+            outs.append("<probe timeout>")
+    ok = (not timed_out) and all(p.returncode == 0 for p in procs)
+    if ok:
+        reason = ""
+    else:
+        tail = "\n".join(o.strip()[-400:] for o in outs)
+        reason = (f"jaxlib {ver} cannot run cross-process CPU "
+                  f"collectives: {tail}")
+    definitive = ok or any(_DEFINITIVE in o for o in outs)
+    if definitive:
+        tmp = cache + f".tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"ok": ok, "reason": reason}, f)
+            os.replace(tmp, cache)
+        except OSError:
+            pass
+    return ok, reason

@@ -209,6 +209,26 @@ _ALL = [
          "device-pinned lanes in THIS process (no sockets, no "
          "serialization) behind the same router/breaker/hedge paths; "
          "0 = subprocess replicas."),
+    # -------------------------------------------------------- parallel/
+    Knob("OTPU_MULTIHOST", "flag", "1", "parallel",
+         "Multi-process data/model-parallel training kill-switch; 0 = "
+         "partitioners and sharded sources are inert facades over the "
+         "current single-process path (bitwise)."),
+    Knob("OTPU_MULTIHOST_PROCS", "int", 0, "parallel",
+         "Training processes a MultihostLauncher gang spawns (and the "
+         "bench's simulated-host count in fallback mode); 0 = auto "
+         "(2 for the launcher, 4 for bench --config multihost)."),
+    Knob("OTPU_MULTIHOST_COORD_PORT", "int", 0, "parallel",
+         "jax.distributed coordinator port the gang rendezvouses on; "
+         "0 = pick a free ephemeral port per gang launch."),
+    Knob("OTPU_MULTIHOST_RESTARTS", "int", 2, "parallel",
+         "Gang restarts the launcher attempts after a lost host before "
+         "raising HostLostError (each restart resumes every rank from "
+         "the aligned epoch-boundary checkpoint)."),
+    Knob("OTPU_MULTIHOST_WALL_S", "float", 600.0, "parallel",
+         "Wall budget per gang attempt; a gang still running past it is "
+         "treated as wedged and counts as a lost host (typed, not a "
+         "hang — the watchdog pattern)."),
     # ----------------------------------------------------------- online/
     Knob("OTPU_ONLINE", "flag", "1", "online",
          "Continuous train-while-serve kill-switch; 0 = the serving tap, "

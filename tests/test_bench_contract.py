@@ -164,6 +164,21 @@ def _run(argv, timeout=420):
       "unguarded_ships_bad", "kill_switch_parity",
       "kill_switch_log_empty", "kill_switch_cycle",
       "quarantined_versions", "baseline_value", "baseline_note"}),
+    # multihost A/B (ISSUE 18): 1-process vs N-process (or the documented
+    # single-process-mesh fallback) data-parallel streaming fit — weak-
+    # scaling aggregate device-replay rate, the OTPU_MULTIHOST=0 bitwise
+    # kill-switch pin, and the SIGKILL-one-host drill (typed detection,
+    # gang restart, 0 lost work, bitwise resumed theta)
+    (["bench.py", "--config", "multihost"],
+     "multihost_agg_replay_rows_per_sec",
+     {"multihost_mode", "multihost_note", "multihost_hosts_n",
+      "chunk_rows_per_host", "steps_per_epoch",
+      "replay_rows_per_s_1p", "replay_rows_per_s_np", "multihost_scaling",
+      "theta_max_abs_diff", "multihost_parity_bitwise",
+      "kill_switch_parity", "goodput", "ledger", "multihost_hosts",
+      "drill_procs", "drill_hosts_lost", "drill_gang_restarts",
+      "drill_resume_parity_bitwise", "drill_resumed_from_step",
+      "drill_lost_work_steps"}),
     (["bench.py", "--config", "overload"],
      "overload_admission_p99_bound_factor",
      {"p99_ms_admitted", "p99_ms_raw", "p99_bound_factor", "sheds",
@@ -412,3 +427,29 @@ def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
         # ISSUE 9: the first shed of the admitted arm auto-wrote a black
         # box (sheds >= 1 is asserted above, so a bundle must exist)
         assert d["flight_bundles_written"] >= 1
+    if "multihost_scaling" in extra_keys:
+        # the multihost claims (ISSUE 18 acceptance): the same-run A/B
+        # must show >= 1.6x aggregate device-replay throughput for the
+        # N-host arm, theta parity <= 1e-6 between arms, the
+        # OTPU_MULTIHOST=0 kill-switch bitwise-identical to the stock
+        # path, and the lost-host drill must recover with 0 lost work
+        # and a bitwise-resumed theta
+        assert d["multihost_mode"] in ("multiprocess", "single_process_mesh")
+        if d["multihost_mode"] == "single_process_mesh":
+            # the fallback must say WHY (naming the jaxlib), not be silent
+            assert len(d["multihost_note"]) > 40, d["multihost_note"]
+        assert d["multihost_hosts_n"] >= 2
+        assert d["multihost_scaling"] >= 1.6, d["multihost_scaling"]
+        assert d["theta_max_abs_diff"] <= 1e-6, d["theta_max_abs_diff"]
+        assert d["multihost_parity_bitwise"] is True
+        assert d["kill_switch_parity"] is True
+        # per-host goodput/ledger attribution folded through the digest
+        assert d["multihost_hosts"], "per-host attribution missing"
+        for h in d["multihost_hosts"].values():
+            assert "goodput" in h and "device_memory" in h
+        # the drill: >= 1 host lost TYPED, gang restarted, resume at the
+        # exact snapshot (0 lost steps) converging bitwise
+        assert d["drill_hosts_lost"] >= 1
+        assert d["drill_gang_restarts"] >= 1
+        assert d["drill_resume_parity_bitwise"] is True
+        assert d["drill_lost_work_steps"] == 0

@@ -45,6 +45,7 @@ RATIO_KEYS = frozenset({
     "scaling_factor",
     "p99_bound_factor",
     "trace_coverage",
+    "multihost_scaling",
 })
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
