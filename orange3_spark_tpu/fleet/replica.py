@@ -64,6 +64,10 @@ class ReplicaRuntime:
                 f"no CURRENT version published under {model_root!r} "
                 "(fleet.rollout.publish_version writes it)")
         meta = ro.read_version_meta(model_root, self.version)
+        # workflow bundles record the DAG identity they serve (rollout.
+        # publish_workflow_version); /readyz reports it so the router can
+        # route/observe per DAG. None for plain per-model versions.
+        self.dag = meta.get("dag")
         self._n_cols = n_cols if n_cols is not None else meta.get("n_cols")
         if not self._n_cols:
             # fail FAST and say how to fix it: without the serving chunk
@@ -171,7 +175,15 @@ class ReplicaRuntime:
             standby = self._standby
             if (type(standby) is type(new_model)
                     and getattr(standby, "params", None)
-                    == getattr(new_model, "params", None)):
+                    == getattr(new_model, "params", None)
+                    # workflow bundles: in-place reload only when the DAG
+                    # shape matches AND every stage's state rides
+                    # state_pytree; otherwise replace the object (a fresh
+                    # identity keys fresh executables, same as an
+                    # architecture change)
+                    and getattr(standby, "_bundle_sig", None)
+                    == getattr(new_model, "_bundle_sig", None)
+                    and getattr(new_model, "_hot_reloadable", True)):
                 # same architecture: the hot-reload path — state loads in
                 # place and load_state_pytree moves the serving
                 # fingerprint, so _warm compiles fresh executables for
@@ -187,6 +199,7 @@ class ReplicaRuntime:
             # version (correct either way — both are warmed and whole)
             self._model, self._standby = standby, self._model
             old, self.version = self.version, version
+            self.dag = ro.read_version_meta(self.model_root, version).get("dag")
             log.info("fleet: %s flipped %s -> %s", self.name, old, version)
             return self.version
 

@@ -50,6 +50,7 @@ __all__ = [
     "list_quarantined",
     "load_version_model",
     "publish_version",
+    "publish_workflow_version",
     "quarantine",
     "read_current",
     "read_quarantine_meta",
@@ -138,6 +139,30 @@ def publish_version(model, root: str, *, version: str | None = None,
         _atomic_write(os.path.join(root, CURRENT_FILE), version + "\n")
     log.info("fleet: published %s -> %s", type(model).__name__, final)
     return version
+
+
+def publish_workflow_version(workflow, root: str, *,
+                             version: str | None = None,
+                             extra_meta: dict | None = None) -> str:
+    """Publish a :class:`~orange3_spark_tpu.serve.workflow.ServedWorkflow`
+    as ONE versioned unit: the pickle carries every stage's fitted state
+    plus the graph spec, so a :meth:`Rollout.roll` of the version flips /
+    canaries / rolls back the whole DAG atomically — a workflow can never
+    serve stage A of v2 against stage B of v1. ``n_cols`` comes from the
+    workflow's own boundary width; VERSION.json additionally records the
+    DAG identity so replicas and the router can report which workflow a
+    version serves."""
+    meta = {
+        "workflow": True,
+        "dag": workflow.dag_name,
+        "n_stages": workflow.n_stages,
+        "stage_classes": [type(op["payload"]).__name__
+                          if op["payload"] is not None else op["op"]
+                          for op in workflow._ops],
+        **(extra_meta or {}),
+    }
+    return publish_version(workflow, root, version=version,
+                           n_cols=workflow.n_cols, extra_meta=meta)
 
 
 def read_current(root: str) -> str | None:

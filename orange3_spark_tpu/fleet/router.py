@@ -161,6 +161,7 @@ class ReplicaEndpoint:
         self.draining = False
         self.admitted = True           # rollout's per-replica gate
         self.version: str | None = None
+        self.dag: str | None = None    # workflow bundle identity (/readyz)
 
     def state(self) -> str:
         if not self.admitted:
@@ -220,6 +221,8 @@ class FleetRouter:
             ep.draining = bool(body.get("draining"))
             if body.get("version"):
                 ep.version = body["version"]
+            if "dag" in body:
+                ep.dag = body["dag"]
             out[ep.replica_id] = ok
         return out
 

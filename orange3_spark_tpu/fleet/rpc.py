@@ -230,6 +230,9 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
                 body, ready = ready_body(runtime.serving_context)
                 body["version"] = runtime.version
                 body["replica"] = runtime.name
+                # workflow bundles: which DAG this version serves (None
+                # for plain per-model versions) — the router mirrors it
+                body["dag"] = getattr(runtime, "dag", None)
                 self._send_json(200 if ready else 503, body)
             elif route == "/healthz":
                 body, healthy = runtime.health()

@@ -18,10 +18,12 @@ from orange3_spark_tpu.serve.cache import ExecutableCache
 from orange3_spark_tpu.serve.context import (
     ServingContext, active_serving_context,
 )
+from orange3_spark_tpu.serve.workflow import ServedWorkflow
 
 __all__ = [
     "BucketLadder",
     "ExecutableCache",
+    "ServedWorkflow",
     "ServingContext",
     "active_serving_context",
 ]

@@ -143,6 +143,19 @@ def pad_rows_np(arr: np.ndarray | None, n_pad: int) -> np.ndarray | None:
     return out
 
 
+def boundary_mask_np(n: int, n_pad: int) -> np.ndarray:
+    """The W validity mask for a request padded once at a DAG boundary:
+    1.0 on the ``n`` live rows, 0.0 on the ``n_pad - n`` pad rows. This
+    is THE mask a fused workflow request rides through every interior
+    stage (serve/workflow.py) — built host-side for the same reason
+    ``pad_rows_np`` is."""
+    if n > n_pad:
+        raise ValueError(f"batch has {n} rows, bucket holds {n_pad}")
+    W = np.zeros((n_pad,), np.float32)
+    W[:n] = 1.0
+    return W
+
+
 def table_to_host(table) -> tuple[np.ndarray, np.ndarray | None, np.ndarray]:
     """(X, Y, W) as PADDED host arrays (no row stripping — the pad rows
     already carry W=0 and the bucket pad extends that convention)."""

@@ -169,7 +169,16 @@ def route(kind: str, raw_fn: Callable, model, *args, **kwargs):
     if (ctx is None or _reentrant() or kwargs or len(args) != 1
             or not isinstance(args[0], TpuTable)):
         return raw_fn(model, *args, **kwargs)
+    # workflow pre-dispatch hook (serve/workflow.py): under the
+    # OTPU_WORKFLOW_SERVE kill-switch a ServedWorkflow request runs its
+    # raw stagewise walk HERE — each stage then re-enters route() and
+    # serves individually, bitwise the per-model path. Checked after the
+    # guard so fused builds (reentrant) never consult it.
+    passthrough = getattr(model, "_serve_passthrough", None)
+    if passthrough is not None and passthrough(kind):
+        return raw_fn(model, *args, **kwargs)
     table = args[0]
+    dag = getattr(model, "_dag_name", None)
     # serving progress feeds the liveness heartbeat (obs/server.py
     # /healthz): without this, a direct-dispatch (non-micro-batched)
     # serving process under steady traffic would read as stale. The
@@ -182,7 +191,8 @@ def route(kind: str, raw_fn: Callable, model, *args, **kwargs):
         # point; the serve span (and everything under it, including a
         # micro-batched flush on another thread via flow events) carries it
         with _request_scope():
-            with span("serve", kind=kind, rows=table.n_rows):
+            with span("serve", kind=kind, rows=table.n_rows,
+                      **({"dag": dag} if dag else {})):
                 if kind == "transform":
                     return ctx.served_transform(model, table, raw_fn)
                 return ctx.served_predict(model, table, raw_fn)
@@ -539,8 +549,10 @@ class ServingContext:
             return None
         # array-serving models route THEMSELVES here (route() only sees
         # table calls), so this is their per-request trace-id entry point
+        dag = getattr(model, "_dag_name", None)
         with _request_scope():
-            with span("serve", kind="array", rows=n):
+            with span("serve", kind="array", rows=n,
+                      **({"dag": dag} if dag else {})):
                 return self._served_array_inner(model, Xall, n)
 
     def _served_array_inner(self, model, Xall: np.ndarray, n: int):
@@ -818,6 +830,9 @@ class ServingContext:
                 if kind == "array":
                     sess = session or TpuSession.active()
                     nc = n_cols
+                    if nc is None:
+                        # workflows carry their boundary width themselves
+                        nc = getattr(model, "n_cols", None)
                     if nc is None:
                         raise ValueError(
                             "array warmup needs n_cols= (the model's "

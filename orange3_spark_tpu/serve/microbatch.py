@@ -321,8 +321,12 @@ class MicroBatcher:
     def _flush(self, batch: list, rows: int) -> None:
         record_serve(mb_requests=len(batch), mb_batches=1)
         traces = [r.trace_id for r in batch if r.trace_id is not None]
+        # same-DAG requests group by fingerprint, so the whole flush
+        # belongs to one workflow when the model is a ServedWorkflow
+        dag = getattr(getattr(batch[0].rec, "model", None), "_dag_name", None)
         with span("mb_flush", requests=len(batch), rows=rows,
-                  **({"traces": traces} if traces else {})):
+                  **({"traces": traces} if traces else {}),
+                  **({"dag": dag} if dag else {})):
             # flow steps: each member request's arrow passes through this
             # merged flush on the worker thread
             for t in traces:

@@ -1,0 +1,298 @@
+"""ServedWorkflow — a whole inference DAG served as ONE model.
+
+The per-model serving path (serve/context.py) earned bucketing, an AOT
+executable cache, micro-batching, breakers and fleet rollouts — but a
+canvas request (preprocess transforms → model predict → postprocess)
+still walked that path per STAGE: K bucket pads, K device dispatches,
+K host↔device round trips. This module closes the gap the way
+workflow/staging.py closed it for fits: wrap the stageable region of an
+already-run graph as a single :class:`Model`, so the EXISTING serving
+machinery fuses it for free —
+
+* ``route()`` sees one transform/predict call; ``_ensure_table_exec``
+  traces the workflow's raw stagewise walk under ``_raw_calls`` and
+  AOT-compiles it into ONE executable per ladder rung. Requests pad once
+  at the DAG boundary, pad rows ride the framework's W=0 validity-mask
+  convention through every fused stage, and interior stage outputs never
+  touch the host.
+* the executable key folds :meth:`_serve_state_token`, which folds every
+  child model's token — a nested ``load_state_pytree`` hot-reload moves
+  the whole DAG's fingerprint (fresh executables; the old version keeps
+  serving from its still-cached ones).
+* the MicroBatcher and the fleet coalescer group by that same
+  fingerprint, so same-DAG requests merge into one fused dispatch.
+* the workflow pickles whole (program + every stage's fitted state), so
+  ``fleet.rollout.publish_workflow_version`` publishes + canaries +
+  rolls back the bundle atomically as one versioned unit.
+
+Kill-switch ``OTPU_WORKFLOW_SERVE=0`` (utils/knobs.py): every request
+runs the same stagewise walk OUTSIDE the fused build, so each stage
+re-enters ``route()`` individually — bitwise the per-model serving path.
+``OTPU_WORKFLOW_MAX_STAGES`` bounds how large a DAG may fuse.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.models.base import Model, Params
+from orange3_spark_tpu.obs.registry import REGISTRY
+from orange3_spark_tpu.utils import knobs
+
+__all__ = ["ServedWorkflow"]
+
+_M_REQUESTS = REGISTRY.counter(
+    "otpu_workflow_requests_total",
+    "workflow requests admitted to the fused DAG serving path")
+_M_STAGEWISE = REGISTRY.counter(
+    "otpu_workflow_stagewise_total",
+    "workflow requests served stage-by-stage (kill-switch or oversized DAG)")
+_M_STAGES = REGISTRY.gauge(
+    "otpu_workflow_stages", "stages fused into a served workflow DAG")
+
+
+class ServedWorkflow(Model):
+    """One canvas DAG, served through the per-model machinery as a unit.
+
+    Holds the PICKLABLE program ``workflow.staging.build_serve_program``
+    returns: a topo-ordered op list (each op a ``{"nid", "op", "payload",
+    "feeds"}`` record executed by ``staging.apply_payload``), the single
+    boundary input key, and the boundary/sink domains. No closures, no
+    session reference — the object round-trips through the fleet's
+    checkpoint pickle unchanged.
+
+    Construct via :meth:`from_graph` (an already-run ``WorkflowGraph``)
+    or :meth:`from_stages` (an explicit fitted-stage chain).
+    """
+
+    def __init__(self, program: dict, *, name: str | None = None):
+        self.params = Params()
+        self._ops = list(program["ops"])
+        if not self._ops:
+            raise ValueError("a served workflow needs at least one stage")
+        self._input_key = tuple(program["input_key"])
+        self._sink_key = tuple(program["sink_key"])
+        self.in_domain = program["in_domain"]
+        self.out_domain = program["out_domain"]
+        self.frontier = list(program.get("frontier") or ())
+        self.graph_json = program.get("graph_json")
+        self.dag_name = name or f"dag{self._sink_key[0]}"
+        _M_STAGES.set(len(self._ops), dag=self.dag_name)
+
+    # ------------------------------------------------------- constructors
+    @classmethod
+    def from_graph(cls, graph, sink: int, sink_port: str = "data", *,
+                   name: str | None = None) -> "ServedWorkflow":
+        from orange3_spark_tpu.workflow.staging import build_serve_program
+
+        return cls(build_serve_program(graph, sink, sink_port), name=name)
+
+    @classmethod
+    def from_stages(cls, stages, template: TpuTable, *,
+                    name: str | None = None) -> "ServedWorkflow":
+        """Linear chain of already-FITTED transformers/models, validated
+        eagerly on ``template`` (which also supplies the domains)."""
+        from orange3_spark_tpu.serve.context import _raw_calls
+        from orange3_spark_tpu.workflow.staging import apply_payload
+
+        stages = list(stages)
+        if not stages:
+            raise ValueError("from_stages needs at least one fitted stage")
+        ops, t = [], template
+        with _raw_calls():
+            for i, stage in enumerate(stages):
+                op = "model" if isinstance(stage, Model) else "transformer"
+                src = (0, "data") if i == 0 else (i, "data")
+                ops.append({"nid": i + 1, "op": op, "payload": stage,
+                            "feeds": [("data", src)]})
+                t = apply_payload(op, stage, {"data": t})
+        return cls({
+            "ops": ops,
+            "input_key": (0, "data"),
+            "sink_key": (len(stages), "data"),
+            "in_domain": template.domain,
+            "out_domain": t.domain,
+            "frontier": [],
+            "graph_json": None,
+        }, name=name)
+
+    # ----------------------------------------------------------- identity
+    @property
+    def n_stages(self) -> int:
+        return len(self._ops)
+
+    @property
+    def n_cols(self) -> int:
+        """The boundary chunk width (array-serving / fleet n_cols)."""
+        return len(self.in_domain.attributes)
+
+    @property
+    def _dag_name(self) -> str:
+        # the attr route()/microbatch read for per-DAG span labels
+        return self.dag_name
+
+    @property
+    def _hot_reloadable(self) -> bool:
+        """True when every stage's state travels through state_pytree
+        (all payloads are Models or stateless) — the fleet's in-place
+        reload precondition. A bundle with a fitted non-Model transformer
+        must reload by object replacement instead: load_state_pytree
+        could not move that stage's state."""
+        return all(op["payload"] is None or isinstance(op["payload"], Model)
+                   for op in self._ops)
+
+    @property
+    def _bundle_sig(self) -> tuple:
+        """Structural signature of the bundle — fleet reload compares it
+        to pick hot-reload (same DAG shape: state loads in place) vs
+        object replacement (shape changed: fresh identity, fresh keys)."""
+        return tuple((op["nid"], op["op"], type(op["payload"]).__name__)
+                     for op in self._ops)
+
+    def _serve_passthrough(self, kind: str) -> bool:
+        """route()'s pre-dispatch hook: True = serve this request stage-
+        by-stage (kill-switch, or the DAG outgrew the fusion ceiling).
+        The one per-request tick point for the otpu_workflow_* counters."""
+        max_stages = knobs.get_int("OTPU_WORKFLOW_MAX_STAGES") or 0
+        if (not knobs.get_bool("OTPU_WORKFLOW_SERVE")
+                or (max_stages and len(self._ops) > max_stages)):
+            _M_STAGEWISE.inc(1, dag=self.dag_name)
+            return True
+        _M_REQUESTS.inc(1, dag=self.dag_name)
+        return False
+
+    # ----------------------------------------------------- stagewise walk
+    def _walk(self, table: TpuTable, *, stop_before_sink: bool = False):
+        """Run the program on ``table``; returns the tables dict keyed
+        (nid, "data"). Inside a fused build this traces every stage into
+        one program (the wrapped stage methods short-circuit raw under
+        ``_raw_calls``); under the kill-switch each stage's call re-enters
+        ``route()`` and serves individually — the bitwise pre-workflow
+        path."""
+        from orange3_spark_tpu.workflow.staging import apply_payload
+
+        tables = {self._input_key: table}
+        ops = self._ops[:-1] if stop_before_sink else self._ops
+        for op in ops:
+            ins = {port: tables[tuple(src)] for port, src in op["feeds"]}
+            tables[(op["nid"], "data")] = apply_payload(
+                op["op"], op["payload"], ins)
+        return tables
+
+    def _sink_input(self, tables) -> TpuTable:
+        op = self._ops[-1]
+        ins = {port: tables[tuple(src)] for port, src in op["feeds"]}
+        if "data" not in ins:
+            raise NotImplementedError(
+                f"workflow sink op {op['op']!r} has no 'data' input to "
+                "predict on")
+        return ins["data"]
+
+    # ------------------------------------------------------- Model surface
+    def transform(self, table: TpuTable) -> TpuTable:
+        return self._walk(table)[(self._sink_key[0], "data")]
+
+    def predict(self, x):
+        if isinstance(x, TpuTable):
+            return self._final_predict(x)
+        from orange3_spark_tpu.serve.context import (
+            _reentrant, active_serving_context,
+        )
+
+        X = np.asarray(x, np.float32)
+        ctx = active_serving_context()
+        if (ctx is not None and not _reentrant()
+                and not self._serve_passthrough("array")):
+            out = ctx.served_array(self, X)
+            if out is not None:
+                return out
+        t = self._boundary_table(X)
+        return np.asarray(self._final_predict(t))
+
+    def _final_predict(self, table: TpuTable):
+        op = self._ops[-1]
+        pred = getattr(op["payload"], "predict", None)
+        if op["op"] not in ("apply", "model") or pred is None:
+            raise NotImplementedError(
+                f"workflow sink ({op['op']}) is not a predicting model")
+        pre = self._sink_input(self._walk(table, stop_before_sink=True))
+        return pred(pre)
+
+    def _device_predict(self, table: TpuTable):
+        """The fused-predict hook serve/context traces: pre-sink walk +
+        the sink model's own device hook, all in one program. A sink
+        without the hook raises — the build fails typed, the breaker
+        opens, and requests fall back to the raw stagewise path."""
+        op = self._ops[-1]
+        hook = getattr(type(op["payload"]), "_device_predict", None)
+        if op["op"] not in ("apply", "model") or hook is None:
+            raise NotImplementedError(
+                f"workflow sink ({op['op']}) has no _device_predict hook")
+        pre = self._sink_input(self._walk(table, stop_before_sink=True))
+        return hook(op["payload"], pre)
+
+    # ---------------------------------------------------------- array wire
+    def _boundary_table(self, X: np.ndarray) -> TpuTable:
+        """Lift one raw request chunk to a boundary table (live rows
+        only, W=1 — padding, where it applies, happens downstream at the
+        DAG boundary with W=0 pad rows)."""
+        import jax.numpy as jnp
+
+        from orange3_spark_tpu.core.session import TpuSession
+
+        X = jnp.asarray(X, jnp.float32)
+        n = X.shape[0]
+        return TpuTable(self.in_domain, X, None,
+                        jnp.ones((n,), jnp.float32), None, n,
+                        TpuSession.active())
+
+    def _serve_array_state(self) -> dict:
+        # stage state rides as jit constants via the fused trace (the
+        # table-path convention) — nothing travels as arguments
+        return {}
+
+    def _serve_array_fn(self, state, Xp):
+        """Device fn for the bucketed array executable (the fleet wire's
+        entry): lift the padded chunk to the boundary table and run the
+        fused DAG predict. The wire ships live rows only and the caller
+        strips ``[:n]``, so the W=1 pad rows are sound here exactly as
+        on the per-model array path (row-wise programs never read them)."""
+        del state
+        return self._device_predict(self._boundary_table(Xp))
+
+    # -------------------------------------------------------- state bundle
+    def _stage_models(self) -> dict[str, Model]:
+        return {f"node{op['nid']}": op["payload"] for op in self._ops
+                if isinstance(op["payload"], Model)}
+
+    @property
+    def state_pytree(self) -> dict:
+        return {key: m.state_pytree
+                for key, m in self._stage_models().items()}
+
+    def load_state_pytree(self, state: dict) -> None:
+        """Hot-reload stage state in place — a PARTIAL dict reloads just
+        those stages (the one-interior-stage rollout case). Any reload
+        moves this workflow's own serving token too: the fused
+        executables baked the child state in, so the DAG fingerprint
+        must re-key even though the child's token also moved."""
+        models = self._stage_models()
+        unknown = set(state) - set(models)
+        if unknown:
+            raise ValueError(
+                f"workflow bundle has state for unknown stages "
+                f"{sorted(unknown)} (have {sorted(models)})")
+        for key, sub in state.items():
+            models[key].load_state_pytree(sub)
+        self._touch_serving_state()
+
+    def _serve_state_token(self):
+        return (getattr(self, "_serve_state_version", 0),
+                tuple(m._serve_state_token()
+                      for m in self._stage_models().values()))
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        chain = " -> ".join(type(op["payload"]).__name__ if op["payload"]
+                            is not None else op["op"] for op in self._ops)
+        return f"ServedWorkflow({self.dag_name}: {chain})"

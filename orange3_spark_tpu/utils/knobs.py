@@ -132,6 +132,14 @@ _ALL = [
     # ----------------------------------------------------------- serve/
     Knob("OTPU_SERVE_REQUESTS", "int", 120, "serve",
          "bench.py serving-trace request count."),
+    Knob("OTPU_WORKFLOW_SERVE", "flag", "1", "serve",
+         "Whole-workflow fused serving kill-switch; 0 = a ServedWorkflow "
+         "request walks its stages through the per-model serving path "
+         "(K dispatches), bitwise the pre-workflow behavior."),
+    Knob("OTPU_WORKFLOW_MAX_STAGES", "int", 64, "serve",
+         "Stage-count ceiling for fusing a workflow DAG into one AOT "
+         "executable; a DAG past it serves stage-by-stage (an XLA "
+         "program over hundreds of stages compiles pathologically)."),
     # ----------------------------------------------------------- fleet/
     Knob("OTPU_FLEET", "flag", "1", "fleet",
          "Serving-fleet kill-switch; 0 = FleetFrontend serves on the "

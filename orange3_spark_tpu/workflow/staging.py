@@ -271,12 +271,17 @@ def _table_ports(widget) -> set[str]:
     return {i.name for i in widget.inputs if i.type is TpuTable}
 
 
-def _node_stage_fn(graph: WorkflowGraph, nid: int, outputs):
-    """
+def _node_payload(graph: WorkflowGraph, nid: int, outputs):
+    """Classify one run node into a PICKLABLE staged op.
 
-    Returns (fn, reason): ``fn`` maps {in_port: TpuTable} -> TpuTable (the
-    node's 'data' output) when the node is device-pure; otherwise fn is None
-    and ``reason`` says why the node is a frontier.
+    Returns ((op, payload), None) when the node is device-pure — ``op``
+    names how ``apply_payload`` executes it and ``payload`` is the fitted
+    object it closes over (None for ops carrying none) — otherwise
+    (None, reason) naming why the node is a frontier. This is
+    ``_node_stage_fn``'s classification factored into data so a served
+    workflow (serve/workflow.py) can store its program as a list of
+    (op, payload) records: a ServedWorkflow pickles into the fleet's
+    versioned workflow bundle, which closures cannot.
     """
     node = graph.nodes[nid]
     w = node.widget
@@ -288,27 +293,43 @@ def _node_stage_fn(graph: WorkflowGraph, nid: int, outputs):
         if not model_edges:
             return None, "OWApplyModel without a model input"
         e = model_edges[0]
-        model = outputs[e.src][e.src_port]   # fitted object, closed over
-        return (lambda ins, m=model: m.transform(ins["data"])), None
+        # fitted object, closed over as the op payload
+        return ("apply", outputs[e.src][e.src_port]), None
     if w.name == "OWMergeColumns":
-        from orange3_spark_tpu.ops.relational import merge_columns
-
-        return (lambda ins: merge_columns(ins["left"], ins["right"])), None
+        return ("merge", None), None
     if "model" in outs and "data" in outs:
-        model = outs["model"]                # fitted estimator widget
-
-        def est_fn(ins, m=model):
-            try:
-                return m.transform(ins["data"])
-            except NotImplementedError:
-                return ins["data"]           # eager path passes data through
-
-        return est_fn, None
+        return ("model", outs["model"]), None    # fitted estimator widget
     if hasattr(w, "transformer") and "data" in outs:
-        return (lambda ins, t=w.transformer: t.transform(ins["data"])), None
+        return ("transformer", w.transformer), None
     if "data" not in outs:
         return None, f"{w.name}: emits no 'data' table"
     return None, f"{w.name}: host-side widget (leaves the device)"
+
+
+def apply_payload(op: str, payload, ins: dict) -> TpuTable:
+    """Execute one classified staged op on its input tables."""
+    if op == "merge":
+        from orange3_spark_tpu.ops.relational import merge_columns
+
+        return merge_columns(ins["left"], ins["right"])
+    if op == "model":
+        try:
+            return payload.transform(ins["data"])
+        except NotImplementedError:
+            return ins["data"]           # eager path passes data through
+    return payload.transform(ins["data"])    # "apply" | "transformer"
+
+
+def _node_stage_fn(graph: WorkflowGraph, nid: int, outputs):
+    """Returns (fn, reason): ``fn`` maps {in_port: TpuTable} -> TpuTable
+    (the node's 'data' output) when the node is device-pure; otherwise fn
+    is None and ``reason`` says why the node is a frontier.
+    """
+    classified, reason = _node_payload(graph, nid, outputs)
+    if classified is None:
+        return None, reason
+    op, payload = classified
+    return (lambda ins, o=op, p=payload: apply_payload(o, p, ins)), None
 
 
 def _refit_fn(widget):
@@ -459,29 +480,7 @@ def stage_graph(
     input_keys = sorted(inputs.keys())
     session = outputs[sink][sink_port].session
     topo = [n for n in graph.topo_order() if n in staged]
-    # Row-preservation check, asserted on the EAGER run's row counts:
-    # StagedGraph.__call__ relabels the output's logical n_rows as the
-    # min over this call's inputs, which is only sound if every staged
-    # widget preserves physical rows (dropping is done by zeroing W, not
-    # by shrinking). True of every catalog widget today; a future staged
-    # widget that physically drops rows must become a frontier instead
-    # of silently mislabeling padding as live rows (round-3 verdict
-    # weak #6).
-    for nid in topo:
-        in_rows = [
-            outputs[e.src][e.src_port].n_rows
-            for e in graph.edges
-            if e.dst == nid
-            and e.dst_port in _table_ports(graph.nodes[nid].widget)
-        ]
-        out_t = (outputs[nid] or {}).get("data")
-        if in_rows and out_t is not None and out_t.n_rows != min(in_rows):
-            raise ValueError(
-                f"staged widget {graph.nodes[nid].widget.name} (node "
-                f"{nid}) is not row-preserving: inputs have "
-                f"{in_rows} rows but its output has {out_t.n_rows}. "
-                "Staged execution requires mask-based row semantics."
-            )
+    _check_row_preserving(graph, topo, outputs)
     # edge list restricted to staged table flow, resolved ahead of trace time
     feeds: dict[int, list[tuple[str, tuple[int, str]]]] = {n: [] for n in topo}
     for e in graph.edges:
@@ -510,6 +509,123 @@ def stage_graph(
         (sink_table.metas, sink_table.n_rows), session, frontier,
         refit_fallbacks, donate_inputs=donate_inputs,
     )
+
+
+def _check_row_preserving(graph: WorkflowGraph, topo, outputs) -> None:
+    """Row-preservation check, asserted on the EAGER run's row counts:
+    staged/served execution relabels the output's logical n_rows from its
+    inputs, which is only sound if every staged widget preserves physical
+    rows (dropping is done by zeroing W, not by shrinking). True of every
+    catalog widget today; a future staged widget that physically drops
+    rows must become a frontier instead of silently mislabeling padding
+    as live rows (round-3 verdict weak #6)."""
+    for nid in topo:
+        in_rows = [
+            outputs[e.src][e.src_port].n_rows
+            for e in graph.edges
+            if e.dst == nid
+            and e.dst_port in _table_ports(graph.nodes[nid].widget)
+        ]
+        out_t = (outputs[nid] or {}).get("data")
+        if in_rows and out_t is not None and out_t.n_rows != min(in_rows):
+            raise ValueError(
+                f"staged widget {graph.nodes[nid].widget.name} (node "
+                f"{nid}) is not row-preserving: inputs have "
+                f"{in_rows} rows but its output has {out_t.n_rows}. "
+                "Staged execution requires mask-based row semantics."
+            )
+
+
+def build_serve_program(graph: WorkflowGraph, sink: int,
+                        sink_port: str = "data") -> dict:
+    """The SERVING program of an already-run graph: the stageable region
+    feeding ``sink``, topo-ordered, every node's fitted payload stored as
+    data — the picklable program a ``ServedWorkflow`` (serve/workflow.py)
+    wraps and the fleet publishes as one versioned workflow bundle.
+
+    Unlike ``stage_graph`` (whose fused fn takes every boundary table as
+    an argument), a SERVED workflow is request-shaped: exactly ONE
+    boundary input — the request table's entry point. A DAG whose staged
+    region has several boundary inputs raises with their locations (serve
+    the sub-DAGs separately, or merge upstream of the region).
+
+    Returns ``{"ops", "input_key", "sink_key", "in_domain", "out_domain",
+    "frontier", "graph_json"}`` where ``ops`` is the topo-ordered list of
+    ``{"nid", "op", "payload", "feeds"}`` records consumed by
+    ``apply_payload``.
+    """
+    outputs = graph.run()
+    classified, reason = _node_payload(graph, sink, outputs)
+    if classified is None:
+        raise ValueError(f"sink node {sink} is not stageable: {reason}")
+
+    payloads: dict[int, tuple] = {}
+    inputs: dict[tuple[int, str], TpuTable] = {}
+    frontier: list[dict] = []
+    visited: set[int] = set()
+
+    def visit(nid: int) -> bool:
+        """True if nid joined the staged region (stage_graph's walk,
+        collecting (op, payload) records instead of closures)."""
+        if nid in payloads:
+            return True
+        if nid in visited:
+            return nid in payloads
+        visited.add(nid)
+        cp, why = _node_payload(graph, nid, outputs)
+        if cp is None:
+            frontier.append(
+                {"node": nid, "widget": graph.nodes[nid].widget.name,
+                 "reason": why}
+            )
+            return False
+        payloads[nid] = cp
+        tports = _table_ports(graph.nodes[nid].widget)
+        for e in graph.edges:
+            if e.dst == nid and e.dst_port in tports:
+                src_node = graph.nodes[e.src]
+                src_has_table_inputs = bool(_table_ports(src_node.widget))
+                if src_has_table_inputs and visit(e.src):
+                    continue
+                if not src_has_table_inputs and not any(
+                    f["node"] == e.src for f in frontier
+                ):
+                    frontier.append(
+                        {"node": e.src, "widget": src_node.widget.name,
+                         "reason": "source (staged input)"}
+                    )
+                inputs[(e.src, e.src_port)] = outputs[e.src][e.src_port]
+        return True
+
+    visit(sink)
+    if len(inputs) != 1:
+        raise ValueError(
+            "a served workflow needs exactly ONE boundary input (the "
+            f"request table's entry point); this DAG's staged region has "
+            f"{len(inputs)}: {sorted(inputs)} — frontier: "
+            + "; ".join(f"node {f['node']} ({f['widget']}): {f['reason']}"
+                        for f in frontier)
+        )
+    topo = [n for n in graph.topo_order() if n in payloads]
+    _check_row_preserving(graph, topo, outputs)
+    feeds: dict[int, list[tuple[str, tuple[int, str]]]] = {n: [] for n in topo}
+    for e in graph.edges:
+        if (e.dst in payloads
+                and e.dst_port in _table_ports(graph.nodes[e.dst].widget)):
+            feeds[e.dst].append((e.dst_port, (e.src, e.src_port)))
+    input_key = next(iter(inputs))
+    sink_table = outputs[sink][sink_port]
+    return {
+        "ops": [{"nid": nid, "op": payloads[nid][0],
+                 "payload": payloads[nid][1], "feeds": feeds[nid]}
+                for nid in topo],
+        "input_key": input_key,
+        "sink_key": (sink, sink_port),
+        "in_domain": inputs[input_key].domain,
+        "out_domain": sink_table.domain,
+        "frontier": frontier,
+        "graph_json": graph.to_json(),
+    }
 
 
 def _reaches(graph: WorkflowGraph, start: int, target: int) -> bool:

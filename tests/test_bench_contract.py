@@ -77,6 +77,24 @@ def _run(argv, timeout=420):
     (["bench_suite.py", "--config", "5", "--rows-scale", "0.002"],
      "taxi_kmeans_pca_pipeline",
      {"staged_speedup", "workflow_fit_s"}),
+    # first-class taxi pipeline (ROADMAP item 5): the config-5 fit and
+    # transform arms promoted into bench.py, plus the streaming-fit arm
+    # and the whole-workflow fused-serving A/B (one bucketed AOT dispatch
+    # per request vs the OTPU_WORKFLOW_SERVE=0 stage-by-stage path),
+    # semantics-gated below on the fused speedup, the dispatch counts,
+    # and cross-arm parity
+    (["bench.py", "--config", "taxi_pipeline", "--rows", "30000"],
+     "taxi_kmeans_pca_pipeline",
+     {"workflow_fit_s", "workflow_fit_staged_s", "fit_staged_speedup",
+      "refit_fallbacks", "transform_eager_s", "transform_staged_s",
+      "staged_speedup", "staged_rows_per_sec_per_chip",
+      "streaming_fit_s", "streaming_fit_rows_per_s_per_chip",
+      "streaming_scaler_max_abs_diff", "baseline_value", "baseline_note",
+      "serve_requests", "request_rows", "workflow_n_stages",
+      "serve_fused_p50_ms", "serve_staged_p50_ms",
+      "workflow_fused_speedup", "workflow_ab_retried",
+      "workflow_fused_speedup_first", "dispatch_fused", "dispatch_staged",
+      "workflow_parity"}),
     # serving contract: the bucketed-AOT predict path's JSON line must
     # carry the latency percentiles and the compile-count pair the
     # acceptance criterion is judged on (ISSUE 2), schema-checked here so
@@ -112,7 +130,9 @@ def _run(argv, timeout=420):
     # OTPU_FLEET=0 single-process parity pin
     (["bench.py", "--config", "fleet"],
      "fleet_n_replica_scaling",
-     {"replicas", "scaling_factor", "throughput_single_rows_per_s_per_chip",
+     {"replicas", "scaling_factor", "scaling_retried",
+      "scaling_factor_first",
+      "throughput_single_rows_per_s_per_chip",
       "throughput_fleet_rows_per_s_per_chip", "p99_ms_unhedged",
       "p99_ms_hedged", "hedged_p99_ratio", "hedges_issued",
       "kill_requests", "kill_completed", "kill_typed_failures",
@@ -308,7 +328,13 @@ def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
         # the replacement; the rolling version swap fails zero requests
         # and the poisoned version auto-rolls back; the kill-switch arm
         # served bitwise-identically on the single-process path
-        assert d["scaling_factor"] >= 2.5, d["scaling_factor"]
+        assert d["scaling_factor"] >= 2.5, (
+            d["scaling_factor"], "first measurement:",
+            d.get("scaling_factor_first"))
+        if d.get("scaling_retried"):
+            # a retried gate must log WHY it retried
+            assert d["scaling_factor_first"] is not None
+            assert d["scaling_factor_first"] < 2.5
         assert d["hedged_p99_ratio"] <= 0.5, (
             d["p99_ms_hedged"], d["p99_ms_unhedged"])
         assert d["hedges_issued"] >= 1
@@ -367,6 +393,30 @@ def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
                 == d["wire_requests"])
         assert d["wire_conn_reuse_pct"] > 50.0, d["wire_conn_reuse_pct"]
         assert d["fastwire_kill_switch_parity"] is True
+    if "workflow_fused_speedup" in extra_keys:
+        # the whole-workflow serving claims (r8 acceptance), semantics
+        # not just schema: the fused DAG executable serves >= 2x faster
+        # than the stage-by-stage kill-switch path on the same warmed
+        # process; a fused request dispatches EXACTLY ONCE while the
+        # staged arm pays one dispatch per stage; both arms agree to
+        # float tolerance (XLA cross-stage fusion reorders float ops, so
+        # bitwise is reserved for same-code-path comparisons); and the
+        # staged fit/transform claims the bench_suite config carried
+        # still hold in the promoted config
+        assert d["workflow_fused_speedup"] >= 2.0, (
+            d["workflow_fused_speedup"], "first measurement:",
+            d.get("workflow_fused_speedup_first"))
+        if d.get("workflow_ab_retried"):
+            assert d["workflow_fused_speedup_first"] is not None
+            assert d["workflow_fused_speedup_first"] < 2.0
+        assert d["dispatch_fused"] == 1, d["dispatch_fused"]
+        assert d["dispatch_staged"] == d["workflow_n_stages"] == 3
+        assert d["workflow_parity"] is True
+        assert d["staged_speedup"] > 0 and d["fit_staged_speedup"] > 0
+        # the one-pass streaming moments agree with the in-memory fit
+        assert d["streaming_scaler_max_abs_diff"] <= 1e-3, (
+            d["streaming_scaler_max_abs_diff"])
+        assert d["streaming_fit_s"] > 0
     if "promotion_outcome" in extra_keys:
         # the continuous-learning claims (ISSUE 14 acceptance), semantics
         # not just schema. (1) learning: the continuously-trained

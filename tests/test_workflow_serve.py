@@ -1,0 +1,347 @@
+"""Whole-workflow fused serving — ServedWorkflow (serve/workflow.py).
+
+Pins the PR's contract: a canvas DAG serves as ONE bucketed AOT
+executable (1 device dispatch per request, interior outputs never on
+host), the kill-switch restores stage-by-stage serving bitwise, a nested
+hot-reload re-keys only that DAG's executables, and the fleet publishes
++ rolls the workflow bundle atomically as one versioned unit.
+
+Float-parity convention (see serve/workflow.py): fused vs staged output
+compares to ``atol=1e-5`` — XLA's cross-stage fusion reorders float ops,
+so the last ulp or two may move. BITWISE equality is asserted only
+between two runs of the SAME code path (kill-switch vs per-model raw).
+"""
+
+from __future__ import annotations
+
+import pickle
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.core.table import TpuTable
+from orange3_spark_tpu.serve import (
+    BucketLadder, ServedWorkflow, ServingContext,
+)
+from orange3_spark_tpu.models.kmeans import KMeans
+from orange3_spark_tpu.models.logistic_regression import LogisticRegression
+from orange3_spark_tpu.models.pca import PCA
+from orange3_spark_tpu.models.preprocess import StandardScaler
+from orange3_spark_tpu.utils.profiling import (
+    reset_serve_counters, serve_counters,
+)
+
+
+# --------------------------------------------------------------- helpers
+def _host(a):
+    return np.asarray(jax.device_get(a))
+
+
+def _subtable(table, n, session):
+    X = _host(table.X)[:n]
+    Y = _host(table.Y)[:n] if table.Y is not None else None
+    return TpuTable.from_numpy(table.domain, X, Y, session=session)
+
+
+def _dispatches():
+    c = serve_counters()
+    return c.get("bucket_hits", 0) + c.get("bucket_misses", 0)
+
+
+def _fit_stack(iris, *, km_seed=0):
+    """StandardScaler -> PCA -> KMeans, each fitted on its input."""
+    scaler = StandardScaler().fit(iris)
+    scaled = scaler.transform(iris)
+    pca = PCA(k=2).fit(scaled)
+    km = KMeans(k=3, seed=km_seed).fit(pca.transform(scaled))
+    return scaler, pca, km
+
+
+@pytest.fixture(scope="module")
+def stack(session, iris):
+    return _fit_stack(iris)
+
+
+@pytest.fixture(scope="module")
+def wf(stack, iris):
+    return ServedWorkflow.from_stages(list(stack), iris, name="wf-iris")
+
+
+@pytest.fixture(scope="module")
+def raw_ref(wf, stack, iris):
+    """The referee: the stagewise walk run entirely OUTSIDE serving."""
+    scaler, pca, km = stack
+    pre = pca.transform(scaler.transform(iris))
+    return {
+        "transform_X": _host(km.transform(pre).X),
+        "predict": np.asarray(km.predict(pre)),
+    }
+
+
+# ------------------------------------------------------------ raw parity
+def test_raw_walk_matches_manual_stagewise(wf, iris, raw_ref):
+    out = wf.transform(iris)
+    np.testing.assert_array_equal(_host(out.X), raw_ref["transform_X"])
+    np.testing.assert_array_equal(
+        np.asarray(wf.predict(iris)), raw_ref["predict"])
+
+
+def test_workflow_identity_surface(wf, iris):
+    assert wf.n_stages == 3
+    assert wf.n_cols == len(iris.domain.attributes)
+    assert wf._dag_name == "wf-iris"
+    assert wf._hot_reloadable
+    assert wf._bundle_sig == (
+        (1, "model", "StandardScalerModel"),
+        (2, "model", "PCAModel"),
+        (3, "model", "KMeansModel"),
+    )
+
+
+# ---------------------------------------------------------- fused parity
+@pytest.mark.parametrize("n", (9, 33, 150))
+def test_fused_predict_parity_and_single_dispatch(
+        session, iris, wf, raw_ref, n):
+    t = _subtable(iris, n, session)
+    with ServingContext(BucketLadder(min_bucket=16, max_bucket=4096)):
+        wf.predict(t)                     # build executables off the clock
+        reset_serve_counters()
+        served = np.asarray(wf.predict(t))
+        assert _dispatches() == 1, (
+            "a fused workflow request must dispatch ONCE, not per stage")
+    np.testing.assert_allclose(served[:n], raw_ref["predict"][:n], atol=1e-5)
+
+
+def test_fused_transform_parity(session, iris, wf, raw_ref):
+    n = 64
+    t = _subtable(iris, n, session)
+    with ServingContext(BucketLadder(min_bucket=16, max_bucket=4096)):
+        served = wf.transform(t)
+    assert served.n_rows == n
+    np.testing.assert_allclose(
+        _host(served.X)[:n], raw_ref["transform_X"][:n], atol=1e-5)
+
+
+def test_fused_array_wire_parity(session, iris, wf, raw_ref):
+    """The fleet wire's entry: a raw ndarray chunk routes through the
+    bucketed array executable of the whole DAG."""
+    n = 50
+    X = _host(iris.X)[:n]
+    with ServingContext(BucketLadder(min_bucket=16, max_bucket=4096)):
+        served = np.asarray(wf.predict(X))
+    np.testing.assert_allclose(served[:n], raw_ref["predict"][:n], atol=1e-5)
+
+
+# ------------------------------------------------------------ kill-switch
+def test_kill_switch_stagewise_bitwise_parity(
+        session, iris, wf, stack, monkeypatch):
+    """OTPU_WORKFLOW_SERVE=0 must serve each stage through the per-model
+    path — BITWISE the pre-workflow behavior (same code path, same
+    bits), with K dispatches instead of 1."""
+    scaler, pca, km = stack
+    t = _subtable(iris, 33, session)
+    with ServingContext(BucketLadder(min_bucket=16, max_bucket=4096)):
+        # the pre-workflow behavior: each stage served individually
+        per_model = np.asarray(km.predict(pca.transform(scaler.transform(t))))
+        monkeypatch.setenv("OTPU_WORKFLOW_SERVE", "0")
+        reset_serve_counters()
+        switched = np.asarray(wf.predict(t))
+        assert _dispatches() == wf.n_stages, (
+            "the kill-switch must restore one dispatch PER STAGE")
+    np.testing.assert_array_equal(switched, per_model)
+
+
+def test_oversized_dag_serves_stagewise(session, iris, wf, monkeypatch):
+    from orange3_spark_tpu.obs.registry import REGISTRY
+
+    monkeypatch.setenv("OTPU_WORKFLOW_MAX_STAGES", "2")   # DAG has 3
+    t = _subtable(iris, 17, session)
+    with ServingContext(BucketLadder(min_bucket=16, max_bucket=4096)):
+        reset_serve_counters()
+        wf.predict(t)
+        assert _dispatches() == wf.n_stages
+    snap = REGISTRY.snapshot()["otpu_workflow_stagewise_total"]
+    assert any(v["labels"].get("dag") == "wf-iris" and v["value"] >= 1
+               for v in snap["values"])
+
+
+# --------------------------------------------------- warmup & recompiles
+def test_warmup_precompiles_dag_ladder_repeat_traffic_zero_compiles(
+        session, iris, xla_compiles):
+    scaler, pca, km = _fit_stack(iris)
+    wf2 = ServedWorkflow.from_stages([scaler, pca, km], iris, name="wf-warm")
+    with ServingContext(BucketLadder(min_bucket=64, max_bucket=256)) as ctx:
+        report = ctx.warmup(wf2, template=iris)
+        assert report["compiled"] > 0
+        base = xla_compiles()
+        for n in (9, 40, 64, 100, 150):
+            t = _subtable(iris, n, session)
+            wf2.predict(t)
+            wf2.transform(t)
+        assert xla_compiles() == base, (
+            "warmed DAG ladder must serve repeat traffic with ZERO "
+            "recompiles")
+
+
+# ----------------------------------------------------- hot-reload keying
+def test_interior_stage_reload_rekeys_only_that_dag(
+        session, iris, xla_compiles):
+    """Reloading ONE interior stage via load_state_pytree moves the whole
+    DAG's fingerprint (fresh executables), while an untouched SIBLING
+    DAG's warmed executables keep serving with zero compiles."""
+    wf_a = ServedWorkflow.from_stages(
+        list(_fit_stack(iris, km_seed=0)), iris, name="wf-a")
+    wf_b = ServedWorkflow.from_stages(
+        list(_fit_stack(iris, km_seed=1)), iris, name="wf-b")
+    t = _subtable(iris, 33, session)
+    # the replacement interior state: a PCA fitted on a different slice
+    scaler_new, pca_new, _km = _fit_stack(_subtable(iris, 90, session))
+    tok0 = wf_a._serve_state_token()
+    with ServingContext(BucketLadder(min_bucket=16, max_bucket=4096)):
+        a0 = np.asarray(wf_a.predict(t))       # caches wf_a's executable
+        wf_b.predict(t)                        # caches wf_b's executable
+        base = xla_compiles()
+        wf_a.predict(t)
+        wf_b.predict(t)
+        assert xla_compiles() == base          # both warmed — steady state
+
+        wf_a.load_state_pytree({"node2": pca_new.state_pytree})
+        assert wf_a._serve_state_token() != tok0
+
+        wf_b.predict(t)                        # sibling DAG: untouched
+        assert xla_compiles() == base, (
+            "reloading wf-a's interior stage must not re-key wf-b")
+        a1 = np.asarray(wf_a.predict(t))       # reloaded DAG: fresh build
+        assert xla_compiles() > base, (
+            "interior-stage reload must move the DAG fingerprint")
+        assert not np.array_equal(a1, a0) or np.array_equal(
+            a0, np.asarray(wf_a.predict(t)))
+    # and the new executable really serves the NEW interior state
+    raw = np.asarray(wf_a.predict(t))
+    np.testing.assert_allclose(a1[:33], raw[:33], atol=1e-5)
+
+
+def test_load_state_pytree_rejects_unknown_stage(iris):
+    wf2 = ServedWorkflow.from_stages(
+        list(_fit_stack(iris)), iris, name="wf-rej")
+    with pytest.raises(ValueError, match="unknown stages"):
+        wf2.load_state_pytree({"node9": {}})
+
+
+# ------------------------------------------------------------ microbatch
+def test_microbatch_merges_same_dag_requests(session, iris, wf):
+    tables = [_subtable(iris, k, session) for k in (9, 17, 25)]
+    with ServingContext(BucketLadder(min_bucket=64, max_bucket=4096)):
+        refs = [np.asarray(wf.predict(t)) for t in tables]
+    reset_serve_counters()
+    with ServingContext(BucketLadder(min_bucket=64, max_bucket=4096),
+                        micro_batch=True, max_batch=4096, max_wait_ms=50.0):
+        with ThreadPoolExecutor(12) as ex:
+            outs = list(ex.map(
+                lambda t: np.asarray(wf.predict(t)), tables * 4))
+    for i, out in enumerate(outs):
+        np.testing.assert_allclose(out, refs[i % 3], atol=1e-5)
+    c = serve_counters()
+    assert c["mb_requests"] == 12
+    assert 1 <= c["mb_batches"] < c["mb_requests"], (
+        f"no same-DAG coalescing: {c['mb_batches']} batches "
+        f"for {c['mb_requests']} requests")
+
+
+# ----------------------------------------------------- bundle & pickling
+def test_workflow_pickles_whole(session, iris, wf, raw_ref):
+    clone = pickle.loads(pickle.dumps(wf))
+    assert clone._bundle_sig == wf._bundle_sig
+    assert clone.dag_name == wf.dag_name
+    np.testing.assert_array_equal(
+        _host(clone.transform(iris).X), raw_ref["transform_X"])
+
+
+def test_from_graph_and_program_guards(session, iris):
+    from orange3_spark_tpu.widgets.catalog import WIDGET_REGISTRY, OWTable
+    from orange3_spark_tpu.workflow.graph import WorkflowGraph
+    from orange3_spark_tpu.workflow.staging import build_serve_program
+
+    g = WorkflowGraph()
+    src = g.add(OWTable(iris))
+    sc = g.add(WIDGET_REGISTRY["OWStandardScaler"](with_mean=True))
+    km = g.add(WIDGET_REGISTRY["OWKMeans"](k=3, seed=0))
+    g.connect(src, "data", sc, "data")
+    g.connect(sc, "data", km, "data")
+    wfg = ServedWorkflow.from_graph(g, km, name="wf-graph")
+    assert wfg.n_stages == 2
+    ref = _host(g.output(km, "data").X)
+    np.testing.assert_array_equal(_host(wfg.transform(iris).X), ref)
+
+    # two boundary inputs cannot pad as one request — build must refuse
+    g2 = WorkflowGraph()
+    a = g2.add(OWTable(iris))
+    b = g2.add(OWTable(iris))
+    mg = g2.add(WIDGET_REGISTRY["OWMergeColumns"]())
+    g2.connect(a, "data", mg, "left")
+    g2.connect(b, "data", mg, "right")
+    with pytest.raises(ValueError, match="boundary input"):
+        build_serve_program(g2, mg)
+
+
+def test_fleet_workflow_bundle_publish_roll_readyz(
+        session, iris, tmp_path, stack):
+    """publish_workflow_version -> replica serves the bundle -> a reload
+    of a re-fitted bundle flips atomically -> /readyz reports the DAG."""
+    import json
+    import urllib.request
+
+    from orange3_spark_tpu.fleet import rollout as ro
+    from orange3_spark_tpu.fleet.replica import ReplicaRuntime
+
+    root = str(tmp_path / "wfroot")
+    wf1 = ServedWorkflow.from_stages(list(stack), iris, name="wf-fleet")
+    v1 = ro.publish_workflow_version(wf1, root)
+    meta = ro.read_version_meta(root, v1)
+    assert meta["workflow"] and meta["dag"] == "wf-fleet"
+    assert meta["n_stages"] == 3 and meta["n_cols"] == 4
+    assert meta["stage_classes"] == [
+        "StandardScalerModel", "PCAModel", "KMeansModel"]
+
+    rt = ReplicaRuntime(root, name="wf-replica", session=session,
+                        ladder=BucketLadder(min_bucket=64, max_bucket=64))
+    try:
+        rt.activate()
+        assert rt.dag == "wf-fleet"
+        X = _host(iris.X)[:20]
+        out1 = rt.predict(X)
+        assert out1.shape[0] == 20
+
+        wf2 = ServedWorkflow.from_stages(
+            list(_fit_stack(iris, km_seed=7)), iris, name="wf-fleet")
+        v2 = ro.publish_workflow_version(wf2, root)
+        assert rt.reload(v2) == v2 and rt.version == v2
+        out2 = rt.predict(X)
+        assert out2.shape[0] == 20
+
+        srv = rt.serve_background()
+        body = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/readyz", timeout=10).read())
+        assert body["dag"] == "wf-fleet" and body["version"] == v2
+    finally:
+        rt.close()
+
+
+# ------------------------------------------------------------- tool smoke
+def test_workflow_ab_tool_smoke(session):
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "workflow_ab.py")
+    spec = importlib.util.spec_from_file_location("workflow_ab", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rec = mod.run_ab(session=session, rows=32, iters=2, warmup=1)
+    assert rec["metric"] == "workflow_ab"
+    assert rec["parity"] is True
+    assert rec["dispatch_fused"] == 1
+    assert rec["dispatch_staged"] == rec["n_stages"] == 3
+    assert rec["workflow_fused_speedup"] > 0

@@ -46,6 +46,12 @@ RATIO_KEYS = frozenset({
     "p99_bound_factor",
     "trace_coverage",
     "multihost_scaling",
+    # r8: whole-workflow fused serving (taxi_pipeline config) — the
+    # fused-vs-stagewise serving p50 ratio and the staged fit/transform
+    # ratios promoted from bench_suite config 5
+    "workflow_fused_speedup",
+    "staged_speedup",
+    "fit_staged_speedup",
 })
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

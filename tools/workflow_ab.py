@@ -1,0 +1,151 @@
+"""One-shot workflow-serving A/B — fused DAG vs stage-by-stage, raced.
+
+Builds a fitted 3-stage iris pipeline (StandardScaler -> PCA -> KMeans),
+wraps it as a :class:`ServedWorkflow`, warms the bucket ladder, then
+drives the SAME predict through both serving modes, interleaved
+round-robin (so OS-level drift hits both arms equally):
+
+* **fused**   ``OTPU_WORKFLOW_SERVE=1`` — the whole DAG is ONE bucketed
+  AOT executable; a request pads once at the DAG boundary and dispatches
+  once;
+* **staged**  ``OTPU_WORKFLOW_SERVE=0`` — the kill-switch baseline: each
+  stage re-enters the per-model serving path individually (K pads, K
+  dispatches, K host round trips).
+
+The knob is read per request, so the arms flip by environment variable —
+same process, same models, same rows, same warmed executables. Device
+dispatches per request are pinned from the serve-counter deltas (fused
+must be 1, staged must be ``n_stages``), and cross-arm parity is checked
+to float tolerance (XLA's cross-stage fusion reorders float ops, so the
+fused arm differs from staged in the last ulp or two — never more).
+
+Importable: ``run_ab(...)`` returns the parsed record (tier-1 smoke in
+tests/test_workflow_serve.py). CLI prints it as JSON on stdout.
+
+Usage:
+    python tools/workflow_ab.py [--rows 256] [--iters 40]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+from contextlib import contextmanager
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ARMS = (
+    ("fused", {"OTPU_WORKFLOW_SERVE": "1"}),
+    ("staged", {"OTPU_WORKFLOW_SERVE": "0"}),
+)
+
+
+@contextmanager
+def _env(overrides: dict):
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _dispatches(counters: dict) -> int:
+    return counters.get("bucket_hits", 0) + counters.get("bucket_misses", 0)
+
+
+def run_ab(session=None, *, rows: int = 256, iters: int = 40,
+           warmup: int = 3) -> dict:
+    """Race fused vs stage-by-stage serving of one 3-stage DAG; return
+    ``{"metric": "workflow_ab", ...}`` with per-arm p50s, the speedup,
+    and the per-request dispatch counts."""
+    import numpy as np
+
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.core.table import TpuTable
+    from orange3_spark_tpu.datasets import load_iris
+    from orange3_spark_tpu.serve import (
+        BucketLadder, ServedWorkflow, ServingContext,
+    )
+    from orange3_spark_tpu.models.kmeans import KMeans
+    from orange3_spark_tpu.models.pca import PCA
+    from orange3_spark_tpu.models.preprocess import StandardScaler
+    from orange3_spark_tpu.utils.profiling import (
+        reset_serve_counters, serve_counters,
+    )
+
+    session = session or TpuSession.builder_get_or_create()
+    iris = load_iris(session)
+    scaler = StandardScaler().fit(iris)
+    scaled = scaler.transform(iris)
+    pca = PCA(k=2).fit(scaled)
+    km = KMeans(k=3, seed=0).fit(pca.transform(scaled))
+    wf = ServedWorkflow.from_stages([scaler, pca, km], iris, name="ab-wf")
+
+    rng = np.random.default_rng(7)
+    idx = rng.integers(0, iris.n_rows, rows)
+    X = np.asarray(iris.X)[idx].astype(np.float32)
+    Y = np.asarray(iris.Y)[idx].astype(np.float32)
+    t = TpuTable.from_numpy(iris.domain, X, Y, session=session)
+
+    with ServingContext(BucketLadder(min_bucket=64, max_bucket=1 << 12)):
+        expect = None
+        disp: dict[str, int] = {}
+        for name, env in ARMS:      # warm both arms (and check parity)
+            with _env(env):
+                for _ in range(max(warmup, 1)):
+                    out = np.asarray(wf.predict(t))
+                reset_serve_counters()
+                out = np.asarray(wf.predict(t))
+                disp[name] = _dispatches(serve_counters())
+                if expect is None:
+                    expect = out
+                elif not np.allclose(out, expect, atol=1e-5):
+                    raise AssertionError(
+                        f"workflow arm {name} diverged beyond float "
+                        "tolerance from the fused prediction")
+        lat: dict[str, list] = {name: [] for name, _ in ARMS}
+        for _ in range(max(iters, 1)):
+            for name, env in ARMS:  # interleaved: drift hits both arms
+                with _env(env):
+                    t0 = time.perf_counter()
+                    wf.predict(t)
+                    lat[name].append((time.perf_counter() - t0) * 1e3)
+    p50 = {n: round(statistics.median(v), 4) for n, v in lat.items()}
+    return {
+        "metric": "workflow_ab",
+        "value": round(p50["staged"] / max(p50["fused"], 1e-9), 3),
+        "unit": "x_staged_over_fused",
+        "vs_baseline": None,
+        "rows": rows,
+        "iters": iters,
+        "n_stages": wf.n_stages,
+        "fused_p50_ms": p50["fused"],
+        "staged_p50_ms": p50["staged"],
+        "workflow_fused_speedup": round(
+            p50["staged"] / max(p50["fused"], 1e-9), 3),
+        "dispatch_fused": disp["fused"],
+        "dispatch_staged": disp["staged"],
+        "parity": True,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=40)
+    args = ap.parse_args()
+    sys.path.insert(0, REPO)
+    print(json.dumps(run_ab(rows=args.rows, iters=args.iters)))
+
+
+if __name__ == "__main__":
+    main()
