@@ -2305,6 +2305,346 @@ def bench_fleet(*, requests: int = 64, service_ms: float = 30.0,
     }
 
 
+def bench_tenancy(*, service_ms: float = 20.0) -> dict:
+    """Control-plane A/B (fleet/control.py, serve/tenancy.py): the
+    multi-tenant fleet control plane's three claims.
+
+      fairness   the SAME 2-tenant skewed burst (heavy offers 8x the
+                 light tenant's load into a 2-slot admission controller)
+                 first-come-first-served vs weighted-fair: under
+                 OTPU_TENANCY=0 the light tenant's p99 is the heavy
+                 backlog's service time; with OTPU_TENANT_SPEC giving
+                 light weight 4 and capping heavy at 1 in-flight slot,
+                 the burster sheds TYPED (TenantQuotaShedError carrying
+                 tenant/usage/quota) while light p99 stays bounded —
+                 >= 3x tighter is the acceptance bar;
+      elasticity a real 1-replica fleet under closed-loop load: the
+                 Autoscaler consumes the collector's digest through its
+                 hysteresis bands, grows the fleet to >= 2 replicas via
+                 the crash-restart spawn path, then — load gone, past
+                 cooldown — drains back to min via drain-then-stop with
+                 ZERO failed trickle requests during scale-down;
+      parity     OTPU_TENANCY=0 + OTPU_AUTOSCALE=0 is the PR-19 fleet
+                 bitwise: a scoped caller's predict matches the
+                 unscoped answer bit-for-bit, no fair-share state is
+                 ever built, and the autoscaler refuses to step.
+
+    The injected ``overload:delay_ms`` makes per-dispatch service time
+    deterministic (the bench_overload convention), so both A/Bs measure
+    the CONTROL LOGIC, not the host's XLA latency du jour. Zero hung
+    and zero lost requests across every arm is part of the claim."""
+    import concurrent.futures
+    import shutil
+    import threading
+
+    import jax
+    import numpy as np
+
+    from orange3_spark_tpu.core.session import TpuSession
+    from orange3_spark_tpu.fleet.control import Autoscaler
+    from orange3_spark_tpu.fleet.rollout import publish_version
+    from orange3_spark_tpu.fleet.router import FleetRouter
+    from orange3_spark_tpu.fleet.rpc import (
+        NoReplicaAvailableError, ReplicaDrainingError,
+        ReplicaUnavailableError,
+    )
+    from orange3_spark_tpu.fleet.supervisor import ReplicaManager
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+    from orange3_spark_tpu.obs import fleetobs as fobs
+    from orange3_spark_tpu.resilience import OverloadShedError, inject_faults
+    from orange3_spark_tpu.serve import BucketLadder, ServingContext
+    from orange3_spark_tpu.serve.tenancy import (
+        TenantQuotaShedError, tenant_scope,
+    )
+
+    session = TpuSession.builder_get_or_create()
+    n_dense = n_cat = 4
+    rng = np.random.default_rng(7)
+    rows_fit = 1 << 13
+    X = np.concatenate([
+        rng.standard_normal((rows_fit, n_dense)).astype(np.float32),
+        rng.integers(0, 500, (rows_fit, n_cat)).astype(np.float32),
+    ], axis=1)
+    y = (rng.random(rows_fit) < 0.3).astype(np.float32)
+    _log("[tenancy] fitting the tiny CTR model ...")
+    model = StreamingHashedLinearEstimator(
+        n_dims=1 << 12, n_dense=n_dense, n_cat=n_cat, epochs=1,
+        step_size=0.05, chunk_rows=2048,
+    ).fit_stream(array_chunk_source(X, y, chunk_rows=2048), session=session)
+    ladder = BucketLadder(min_bucket=64, max_bucket=1 << 10)
+
+    # ---- fairness A/B: 2 tenants, heavy offers 8x light's load ----
+    n_light, n_heavy = 12, 96          # the 8x skew the claim is about
+    _ARM_KEYS = ("OTPU_RESILIENCE", "OTPU_ADMISSION_MAX_INFLIGHT",
+                 "OTPU_ADMISSION_MAX_QUEUE", "OTPU_TENANCY",
+                 "OTPU_TENANT_SPEC")
+
+    def run_arm(env: dict, label: str) -> dict:
+        saved = {k: os.environ.get(k) for k in _ARM_KEYS}
+        for k in _ARM_KEYS:
+            os.environ.pop(k, None)
+        os.environ.update(env)
+        light_lat, heavy_lat = [], []
+        outcomes: list = []
+        lock = threading.Lock()
+        try:
+            # micro_batch=False: dispatches (and their admission slots)
+            # run on the CALLER's thread, which carries the tenant scope
+            with ServingContext(ladder, micro_batch=False) as ctx:
+                ctx.warmup(model, n_cols=n_dense + n_cat,
+                           kinds=("array",), session=session)
+
+                def one(tenant: str, i: int):
+                    if tenant == "light":
+                        time.sleep(i * 0.03)   # light arrives spaced out
+                    t0 = time.perf_counter()
+                    try:
+                        with tenant_scope(tenant):
+                            out = model.predict(X[:64])
+                        assert out.shape[0] == 64
+                        kind = "ok"
+                    except TenantQuotaShedError:
+                        kind = "tenant_shed"
+                    except OverloadShedError:
+                        kind = "shed"
+                    except Exception:  # noqa: BLE001 - untyped = lost
+                        kind = "lost"
+                    ms = (time.perf_counter() - t0) * 1e3
+                    with lock:
+                        outcomes.append((tenant, kind))
+                        if kind == "ok":
+                            (light_lat if tenant == "light"
+                             else heavy_lat).append(ms)
+
+                _log(f"[tenancy] {label} arm: {n_heavy} heavy + "
+                     f"{n_light} light requests ...")
+                with inject_faults(f"overload:delay_ms={service_ms}"):
+                    # no `with` block: shutdown(wait=False) — a hung
+                    # future is REPORTED, never a bench deadlock (PR-8)
+                    ex = concurrent.futures.ThreadPoolExecutor(
+                        n_light + 12)
+                    try:
+                        futs = [ex.submit(one, "heavy", i)
+                                for i in range(n_heavy)]
+                        futs += [ex.submit(one, "light", i)
+                                 for i in range(n_light)]
+                        done, pending = concurrent.futures.wait(
+                            futs, timeout=120.0)
+                        hung = len(pending)
+                    finally:
+                        ex.shutdown(wait=False)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        return {"light_lat": light_lat, "heavy_lat": heavy_lat,
+                "outcomes": outcomes, "hung": hung}
+
+    def pctl(lat, q):
+        return round(float(np.percentile(np.asarray(lat), q)), 3)
+
+    UNFAIR = {"OTPU_RESILIENCE": "1", "OTPU_ADMISSION_MAX_INFLIGHT": "2",
+              "OTPU_ADMISSION_MAX_QUEUE": "256", "OTPU_TENANCY": "0"}
+    FAIR = dict(UNFAIR, OTPU_TENANCY="1",
+                OTPU_TENANT_SPEC="light:weight=4;"
+                                 "heavy:weight=1,max_inflight=1")
+
+    def fairness_ab():
+        u = run_arm(UNFAIR, "unfair (OTPU_TENANCY=0)")
+        f = run_arm(FAIR, "weighted-fair")
+        p99_u = pctl(u["light_lat"], 99) if u["light_lat"] else None
+        p99_f = pctl(f["light_lat"], 99) if f["light_lat"] else None
+        factor = (round(p99_u / p99_f, 2) if p99_u and p99_f else None)
+        return u, f, p99_u, p99_f, factor
+
+    unfair, fair, light_p99_u, light_p99_f, factor = fairness_ab()
+    # structured re-measure (the bench_fleet one-retry policy): one
+    # preemption stretch inside the fair arm's light stream can fake a
+    # sub-3x reading; a REAL fairness regression reproduces
+    fairness_retried = False
+    fairness_factor_first = None
+    if factor is None or factor < 3.0:
+        fairness_retried = True
+        fairness_factor_first = factor
+        _log(f"[tenancy] fairness {factor}x under the 3x gate -- "
+             "re-measuring both arms once")
+        unfair, fair, light_p99_u, light_p99_f, factor = fairness_ab()
+    heavy_typed_sheds = sum(1 for t, k in fair["outcomes"]
+                            if t == "heavy" and k == "tenant_shed")
+    all_outcomes = unfair["outcomes"] + fair["outcomes"]
+    lost = sum(1 for _t, k in all_outcomes if k == "lost")
+    hung = unfair["hung"] + fair["hung"]
+    completed = sum(1 for _t, k in all_outcomes if k == "ok")
+
+    # ---- elasticity drill: a real fleet breathes with offered load ----
+    _log("[tenancy] autoscale drill: 1-replica fleet under load ...")
+    root = os.path.join(os.environ.get("OTPU_BENCH_DIR", "/tmp/otpu_bench"),
+                        f"tenancy_models_{os.getpid()}")
+    shutil.rmtree(root, ignore_errors=True)
+    publish_version(model, root, n_cols=n_dense + n_cat)
+    base_env = {"JAX_PLATFORMS": "cpu",
+                "OTPU_ADMISSION_MAX_INFLIGHT": "1",
+                "OTPU_FAULT_SPEC": "overload:delay_ms=30"}
+    mgr = ReplicaManager(root, n_replicas=1, ladder_max=1 << 9,
+                         env=base_env)
+    mgr.start()
+    assert mgr.wait_ready(timeout_s=120), "autoscale replica never ready"
+    # coalescing OFF for the drill: its one-leader-per-replica cap would
+    # serialize the 8 loaders into one wire dispatch at a time and the
+    # replica would never see the backlog the autoscaler keys on
+    saved_coalesce = os.environ.get("OTPU_FLEET_COALESCE")
+    os.environ["OTPU_FLEET_COALESCE"] = "0"
+    router = FleetRouter(mgr.endpoints(), hedging=False)
+    router.refresh()
+    scaler = Autoscaler(mgr, router, min_replicas=1, max_replicas=3,
+                        up_x=2.0, down_x=0.5, cooldown_s=2.0)
+
+    def scrape_step():
+        # a fresh collector each step so NEW endpoints are scraped too —
+        # the long-lived supervisor loop rebinds the same way
+        col = fobs.FleetCollector(mgr.endpoints(), router=router)
+        return scaler.step(col.scrape_once())
+
+    stop = threading.Event()
+    load_failures: list = []
+
+    def loader(rows):
+        while not stop.is_set():
+            try:
+                router.predict(X[:rows])
+            except (ReplicaUnavailableError, ReplicaDrainingError,
+                    NoReplicaAvailableError, OverloadShedError):
+                pass                      # typed under churn is fine here
+            except Exception as e:  # noqa: BLE001 - untyped = a failure
+                load_failures.append(repr(e))
+
+    # distinct row counts per loader — a mixed-shape offered load, not
+    # eight copies of one request
+    threads = [threading.Thread(target=loader, args=(16 + 8 * i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    peak = 1
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        router.refresh()
+        scrape_step()
+        peak = max(peak, len(mgr.handles))
+        if peak >= 3 and mgr.wait_ready(timeout_s=1):
+            break
+        time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    load_hung = sum(1 for t in threads if t.is_alive())
+
+    # scale-down: load gone, trickle traffic must see ZERO failures
+    # while the autoscaler drains the extra replicas back to min
+    _log(f"[tenancy] scale-down drill from {len(mgr.handles)} "
+         "replicas ...")
+    trickle_ok, trickle_failures = 0, []
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        try:
+            out = router.predict(X[:64])
+            assert out.shape[0] == 64
+            trickle_ok += 1
+        except Exception as e:  # noqa: BLE001 - the claim is ZERO
+            trickle_failures.append(repr(e))
+        router.refresh()
+        scrape_step()
+        if len(mgr.handles) <= scaler.min_replicas:
+            break
+        time.sleep(0.3)
+    final_replicas = len(mgr.handles)
+    decisions = [d.to_dict() for d in scaler.decisions]
+    scaler_state = scaler.state()
+    router.close()
+    mgr.stop_all()
+    if saved_coalesce is None:
+        os.environ.pop("OTPU_FLEET_COALESCE", None)
+    else:
+        os.environ["OTPU_FLEET_COALESCE"] = saved_coalesce
+    shutil.rmtree(root, ignore_errors=True)
+    elasticity = round(peak / max(final_replicas, 1), 2)
+
+    # ---- kill-switch parity: both OFF is the PR-19 fleet bitwise ----
+    saved = {k: os.environ.get(k) for k in
+             ("OTPU_TENANCY", "OTPU_AUTOSCALE")}
+    os.environ["OTPU_TENANCY"] = "0"
+    os.environ["OTPU_AUTOSCALE"] = "0"
+    try:
+        with ServingContext(ladder, micro_batch=False) as ctx:
+            ctx.warmup(model, n_cols=n_dense + n_cat,
+                       kinds=("array",), session=session)
+            ref = np.asarray(model.predict(X[:256]))
+            with tenant_scope("ghost"):   # a scope must change NOTHING
+                scoped = np.asarray(model.predict(X[:256]))
+            fair_never_built = ctx.admission._fair_share is None
+        stepped = Autoscaler(mgr, router, min_replicas=1, max_replicas=3,
+                             up_x=2.0, down_x=0.5, cooldown_s=2.0).step(
+            {"replicas": {"replica-0": {"up": True, "stale": False,
+                                        "queue_depth": 99, "inflight": 9,
+                                        "shed_total": 9,
+                                        "brownout_level": 3}}})
+        parity = (bool(np.array_equal(ref, scoped)) and fair_never_built
+                  and stepped is None)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    return {
+        "metric": "tenancy_fairness_p99_bound_factor",
+        "value": factor if factor is not None else 0,
+        "unit": "x",
+        # a fairness A/B has no external baseline: the unfair arm IS
+        # the denominator, reported as fairness_p99_bound_factor
+        "vs_baseline": None,
+        "backend": jax.default_backend(),
+        "requests": len(all_outcomes),
+        "service_ms_injected": service_ms,
+        # ---- weighted-fair tenancy (the headline) ----
+        "fairness_p99_bound_factor": factor,
+        "fairness_retried": fairness_retried,
+        "fairness_p99_bound_factor_first": fairness_factor_first,
+        "light_p99_ms_unfair": light_p99_u,
+        "light_p99_ms_fair": light_p99_f,
+        "light_p50_ms_fair": (pctl(fair["light_lat"], 50)
+                              if fair["light_lat"] else None),
+        "heavy_typed_sheds": heavy_typed_sheds,
+        "heavy_completed_fair": sum(1 for t, k in fair["outcomes"]
+                                    if t == "heavy" and k == "ok"),
+        "light_completed_fair": sum(1 for t, k in fair["outcomes"]
+                                    if t == "light" and k == "ok"),
+        "completed": completed,
+        "hung": hung,
+        "lost": lost,
+        # ---- digest-driven elasticity ----
+        "autoscale_peak_replicas": peak,
+        "autoscale_final_replicas": final_replicas,
+        "autoscale_min_replicas": scaler.min_replicas,
+        "autoscale_max_replicas": scaler.max_replicas,
+        "autoscale_decisions": len(decisions),
+        "autoscale_decision_log": decisions,
+        "autoscale_state": scaler_state,
+        "autoscale_scaledown_failures": len(trickle_failures),
+        "autoscale_scaledown_trickle_ok": trickle_ok,
+        "autoscale_load_failures": len(load_failures),
+        "autoscale_load_hung": load_hung,
+        "elasticity_factor": elasticity,
+        # ---- kill-switch contract ----
+        "tenancy_kill_switch_parity": parity,
+    }
+
+
 def bench_online() -> dict:
     """Guarded continuous learning (online/ subsystem, ISSUE 14): the
     train-while-serve loop's five claims, drilled end-to-end over an
@@ -3119,8 +3459,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="criteo",
                     choices=["criteo", "dense_logreg", "serving", "fault",
-                             "overload", "fleet", "online", "multihost",
-                             "taxi_pipeline"])
+                             "overload", "fleet", "tenancy", "online",
+                             "multihost", "taxi_pipeline"])
     ap.add_argument("--rows", type=int, default=N_ROWS)
     ap.add_argument("--epochs", type=int, default=EPOCHS)
     # None = per-config default (criteo N_DIMS, serving's lighter 1<<18 —
@@ -3428,6 +3768,8 @@ def _main_locked(args, rows, cpu_rows, lk, t_budget0, force_cpu=False):
             return bench_overload()
         if args.config == "fleet":
             return bench_fleet()
+        if args.config == "tenancy":
+            return bench_tenancy()
         if args.config == "online":
             return bench_online()
         if args.config == "multihost":

@@ -165,17 +165,43 @@ def publish_workflow_version(workflow, root: str, *,
                            n_cols=workflow.n_cols, extra_meta=meta)
 
 
-def read_current(root: str) -> str | None:
-    try:
-        with open(os.path.join(root, CURRENT_FILE), encoding="utf-8") as f:
-            v = f.read().strip()
-        return v or None
-    except FileNotFoundError:
-        return None
+_TENANT_NAME_RE = re.compile(r"^[A-Za-z0-9._-]+$")
 
 
-def set_current(root: str, version: str) -> None:
-    _atomic_write(os.path.join(root, CURRENT_FILE), version + "\n")
+def _current_file(tenant: str | None = None) -> str:
+    """The pointer file a (tenant-scoped) roll moves: ``CURRENT`` for
+    the fleet, ``CURRENT-<tenant>`` for one tenant's independent line.
+    Tenant names are path components here, so the charset is strict."""
+    if not tenant:
+        return CURRENT_FILE
+    if not _TENANT_NAME_RE.match(tenant):
+        raise ValueError(
+            f"tenant name {tenant!r} cannot scope a rollout pointer "
+            "(want letters, digits, '.', '_' or '-')")
+    return f"{CURRENT_FILE}-{tenant}"
+
+
+def read_current(root: str, tenant: str | None = None) -> str | None:
+    """The serving version pointer. With ``tenant``, the tenant's own
+    pointer wins and the fleet-wide ``CURRENT`` is the fallback — a
+    tenant that never rolled independently follows the fleet."""
+    names = ([_current_file(tenant), CURRENT_FILE] if tenant
+             else [CURRENT_FILE])
+    for name in names:
+        try:
+            with open(os.path.join(root, name), encoding="utf-8") as f:
+                v = f.read().strip()
+            if v:
+                return v
+        except FileNotFoundError:
+            continue
+    return None
+
+
+def set_current(root: str, version: str, *,
+                tenant: str | None = None) -> None:
+    _atomic_write(os.path.join(root, _current_file(tenant)),
+                  version + "\n")
 
 
 def read_version_meta(root: str, version: str) -> dict:
@@ -281,7 +307,8 @@ class Rollout:
                 f"{body.get('message', '')}".strip(),
                 replica_id=ep.replica_id, step="reload")
 
-    def _canary(self, ep, version: str) -> None:
+    def _canary(self, ep, version: str,
+                tenant: str | None = None) -> None:
         """Post-flip canaries straight at the replica, feeding a rollout
         breaker: one failure past the breaker threshold means the new
         version cannot serve — roll back."""
@@ -294,11 +321,15 @@ class Rollout:
         # silently disarm rollout canaries (threshold > canary_n would
         # let a version that fails EVERY canary complete its rollout)
         breaker = CircuitBreaker(f"rollout:{ep.name}", failure_threshold=1)
+        # a tenant-scoped roll canaries AS that tenant: the probe rides
+        # the X-OTPU-Tenant header, so replica-side admission exercises
+        # exactly the quota path the tenant's real traffic will hit
+        kw = {"tenant": tenant} if tenant else {}
         for i in range(self.canary_n):
             try:
                 out, _ = ep.client.predict(
                     self.canary_input, trace_id=f"rollout-canary-{i}",
-                    timeout_s=self.timeout_s)
+                    timeout_s=self.timeout_s, **kw)
                 if out.shape[0] != self.canary_input.shape[0]:
                     raise RolloutError(
                         f"canary returned {out.shape[0]} rows for "
@@ -357,17 +388,24 @@ class Rollout:
         return failed
 
     # ---------------------------------------------------------------- roll
-    def roll(self, version: str) -> dict:
+    def roll(self, version: str, *, tenant: str | None = None) -> dict:
         """Swap the fleet to ``version``, one replica at a time. Returns
         a result dict (never raises for a clean rollback — the typed
         error rides ``result['error']``)::
 
             {"outcome": "completed" | "rolled_back",
-             "version": ..., "previous": ...,
+             "version": ..., "previous": ..., "tenant": ...,
              "flipped": [ids], "error": str | None,
              "failed_replica": id | None, "rollback_failed": [ids]}
-        """
-        old = read_current(self.root)
+
+        With ``tenant``, the roll is TENANT-SCOPED: the previous version
+        is the tenant's own pointer (falling back to the fleet's), the
+        canaries probe as that tenant (quota path included), and a
+        completed roll moves only ``CURRENT-<tenant>`` — the fleet-wide
+        pointer and every other tenant's line are untouched, so tenants
+        roll, canary and roll back independently through the same
+        publish/flip machinery."""
+        old = read_current(self.root, tenant)
         if old is None:
             raise RolloutError(f"no CURRENT under {self.root}")
         if not os.path.isdir(os.path.join(self.root, version)):
@@ -388,7 +426,7 @@ class Rollout:
             try:
                 self._quiesce(ep)
                 self._reload(ep, version)
-                self._canary(ep, version)
+                self._canary(ep, version, tenant)
                 self._verify_ready(ep, version)
                 self._check_slo(ep, version, alerts0)
             except Exception as e:  # noqa: BLE001 - roll back, report typed
@@ -405,7 +443,7 @@ class Rollout:
                 # (the finally below re-admits the failing replica)
                 _M_ROLLOUTS.inc(1, outcome="rolled_back")
                 return {"outcome": "rolled_back", "version": version,
-                        "previous": old,
+                        "previous": old, "tenant": tenant,
                         "flipped": [f.replica_id for f in flipped],
                         "error": f"{type(e).__name__}: {e}",
                         "failed_replica": ep.replica_id,
@@ -413,12 +451,13 @@ class Rollout:
             finally:
                 self.router.set_admitted(ep.replica_id, True)
             flipped.append(ep)
-        set_current(self.root, version)
+        set_current(self.root, version, tenant=tenant)
         _M_ROLLOUTS.inc(1, outcome="completed")
-        log.info("fleet: rollout %s -> %s completed over %d replicas",
-                 old, version, len(flipped))
+        log.info("fleet: rollout %s -> %s completed over %d replicas%s",
+                 old, version, len(flipped),
+                 f" (tenant {tenant})" if tenant else "")
         return {"outcome": "completed", "version": version,
-                "previous": old,
+                "previous": old, "tenant": tenant,
                 "flipped": [f.replica_id for f in flipped],
                 "error": None, "failed_replica": None,
                 "rollback_failed": []}

@@ -58,6 +58,11 @@ from orange3_spark_tpu.resilience.overload import (
     CircuitBreaker,
     OverloadShedError,
 )
+from orange3_spark_tpu.serve.tenancy import (
+    TenantQuotaShedError,
+    current_tenant,
+    tenancy_enabled,
+)
 from orange3_spark_tpu.utils import knobs
 
 __all__ = ["FleetCoalescer", "FleetRouter", "HedgeSchedule",
@@ -258,6 +263,35 @@ class FleetRouter:
                 return ep
         raise KeyError(replica_id)
 
+    # ------------------------------------------------------- elastic table
+    def add_endpoint(self, replica_id: int, host: str, port: int, *,
+                     client=None) -> ReplicaEndpoint:
+        """Atomically grow the routing table (the autoscaler's scale-up
+        half). The new endpoint starts unpolled (``ready=False``) —
+        ``_pick``'s cold-start ordering keeps it behind warm replicas
+        until /readyz (poller or next refresh) flips it."""
+        ep = ReplicaEndpoint(replica_id, host, port, client=client)
+        with self._lock:
+            if any(e.replica_id == replica_id for e in self.endpoints):
+                raise KeyError(
+                    f"replica {replica_id} is already in the table")
+            self.endpoints.append(ep)
+        return ep
+
+    def remove_endpoint(self, replica_id: int) -> ReplicaEndpoint:
+        """Atomically shrink the routing table (the autoscaler's
+        scale-down half): no pick made after this returns can choose the
+        endpoint, while calls already on it run to completion — remove
+        FIRST, drain the replica AFTER, and only then close the returned
+        endpoint's client (closing earlier would abort the very
+        in-flight work scale-down promises never to kill)."""
+        with self._lock:
+            for i, ep in enumerate(self.endpoints):
+                if ep.replica_id == replica_id:
+                    self.endpoints.pop(i)
+                    return ep
+        raise KeyError(replica_id)
+
     def states(self) -> dict[str, str]:
         return {ep.name: ep.state() for ep in self.endpoints}
 
@@ -300,11 +334,16 @@ class FleetRouter:
     def _call(self, ep: ReplicaEndpoint, X, trace_id: str,
               timeout_s: float | None, conn_slot: list | None = None,
               cancel_event: threading.Event | None = None,
-              weight: int = 1, member_traces: list | None = None):
-        # member_traces is forwarded only when a coalesced dispatch set
-        # it, so fake clients with the pre-coalescer predict() signature
-        # keep working untouched
+              weight: int = 1, member_traces: list | None = None,
+              tenant: str | None = None):
+        # member_traces/tenant are forwarded only when set, so fake
+        # clients with the pre-coalescer predict() signature keep
+        # working untouched. The tenant rides as an EXPLICIT argument,
+        # not thread-local ambience: this may run on a hedge-pool or
+        # coalescer-leader thread that never entered the caller's scope
         kw = {"member_traces": member_traces} if member_traces else {}
+        if tenant is not None:
+            kw["tenant"] = tenant
         with self._lock:
             ep.inflight += 1
             _M_INFLIGHT.set(ep.inflight, replica=ep.name)
@@ -353,7 +392,8 @@ class FleetRouter:
 
     def _hedged_call(self, primary: ReplicaEndpoint, X, trace_id: str,
                      timeout_s: float | None, excluded: set,
-                     weight: int = 1, member_traces: list | None = None):
+                     weight: int = 1, member_traces: list | None = None,
+                     tenant: str | None = None):
         """Primary + (after the hedge delay) one hedge to a different
         replica; first success wins, the loser's connection is closed.
         Raises only when BOTH copies failed (primary's error surfaces;
@@ -368,7 +408,7 @@ class FleetRouter:
             cancels[ep.replica_id] = cancel = threading.Event()
             return self._call(ep, X, trace_id, timeout_s, conn_slot=slot,
                               cancel_event=cancel, weight=weight,
-                              member_traces=member_traces)
+                              member_traces=member_traces, tenant=tenant)
 
         def cancel_others(winner_fut):
             # mark the loser cancelled FIRST so its _call classifies the
@@ -409,11 +449,13 @@ class FleetRouter:
                         ReplicaDrainingError) as e:
                     errors[ep.replica_id] = e
                     continue
-                except ReplicaOverloadedError:
-                    # the replica shed OUR nearly-expired request typed:
-                    # waiting out the sibling copy (or retrying) would
-                    # only finish after the caller gave up — cancel the
-                    # sibling and surface the shed
+                except (ReplicaOverloadedError, TenantQuotaShedError):
+                    # the replica shed OUR request typed (nearly-expired
+                    # deadline, or its tenant over quota): waiting out
+                    # the sibling copy (or retrying) would only finish
+                    # after the caller gave up — and a quota shed would
+                    # shed again anywhere — cancel the sibling and
+                    # surface the shed
                     cancel_others(fut)
                     raise
                 cancel_others(fut)
@@ -442,19 +484,26 @@ class FleetRouter:
         trace_id = new_trace_id("fleet")
         _M_REQS.inc()
         use_hedge = self.hedging if hedge is None else hedge
+        # the tenant identity is captured HERE, on the caller's thread —
+        # every hop below may run on pool threads that never saw the
+        # caller's tenant_scope()
+        tenant = current_tenant() if tenancy_enabled() else None
         from orange3_spark_tpu.obs.fleetobs import fleetobs_enabled
 
         if not fleetobs_enabled():
-            return self._submit(X, trace_id, deadline_s, use_hedge)
+            return self._submit(X, trace_id, deadline_s, use_hedge,
+                                tenant)
         from orange3_spark_tpu.obs import trace as _trace
         from orange3_spark_tpu.obs.context import propagated_scope
 
+        span_kw = {"tenant": tenant} if tenant is not None else {}
         t0 = time.perf_counter()
         ok = False
         try:
             with propagated_scope(trace_id, "fleet"):
-                with _trace.span("serve", kind="fleet"):
-                    out = self._submit(X, trace_id, deadline_s, use_hedge)
+                with _trace.span("serve", kind="fleet", **span_kw):
+                    out = self._submit(X, trace_id, deadline_s,
+                                       use_hedge, tenant)
             ok = True
             return out
         finally:
@@ -462,15 +511,18 @@ class FleetRouter:
                 self.slo.record(ok, time.perf_counter() - t0)
 
     def _submit(self, X, trace_id: str, deadline_s: float | None,
-                use_hedge: bool) -> np.ndarray:
+                use_hedge: bool,
+                tenant: str | None = None) -> np.ndarray:
         if self.coalescer.enabled():
             return self.coalescer.submit(X, trace_id, deadline_s,
-                                         use_hedge)
-        return self._route(X, trace_id, deadline_s, use_hedge)
+                                         use_hedge, tenant=tenant)
+        return self._route(X, trace_id, deadline_s, use_hedge,
+                           tenant=tenant)
 
     def _route(self, X, trace_id: str, deadline_s: float | None,
                use_hedge: bool, weight: int = 1,
-               member_traces: list | None = None) -> np.ndarray:
+               member_traces: list | None = None,
+               tenant: str | None = None) -> np.ndarray:
         excluded: set = set()
         last_err: Exception | None = None
         for _attempt in range(max(2 * len(self.endpoints), 2)):
@@ -481,14 +533,18 @@ class FleetRouter:
                 if use_hedge and len(self.endpoints) > 1:
                     return self._hedged_call(ep, X, trace_id, deadline_s,
                                              excluded, weight=weight,
-                                             member_traces=member_traces)
+                                             member_traces=member_traces,
+                                             tenant=tenant)
                 return self._call(ep, X, trace_id, deadline_s,
                                   weight=weight,
-                                  member_traces=member_traces)
-            except ReplicaOverloadedError:
-                # typed shed under the caller's own propagated deadline:
-                # failing over would produce an answer after the caller
-                # gave up — surface it, no retry, no breaker
+                                  member_traces=member_traces,
+                                  tenant=tenant)
+            except (ReplicaOverloadedError, TenantQuotaShedError):
+                # typed shed under the caller's own propagated deadline
+                # (or its tenant's quota): failing over would produce an
+                # answer after the caller gave up — and a quota shed
+                # follows the tenant, not the replica — surface it, no
+                # retry, no breaker
                 raise
             except ReplicaDrainingError as e:
                 _M_FAILOVERS.inc(1, reason="draining")
@@ -516,15 +572,16 @@ class _Member:
     """One caller's predict riding a coalesced dispatch: a tiny future
     (event + result/error slot) the leader scatters back into."""
 
-    __slots__ = ("X", "n", "trace_id", "deadline_s", "enqueued",
-                 "event", "result", "error")
+    __slots__ = ("X", "n", "trace_id", "deadline_s", "tenant",
+                 "enqueued", "event", "result", "error")
 
     def __init__(self, X: np.ndarray, trace_id: str,
-                 deadline_s: float | None):
+                 deadline_s: float | None, tenant: str | None = None):
         self.X = X
         self.n = int(X.shape[0]) if X.ndim >= 1 else 1
         self.trace_id = trace_id
         self.deadline_s = deadline_s
+        self.tenant = tenant
         self.enqueued = time.monotonic()
         self.event = threading.Event()
         self.result = None
@@ -615,8 +672,8 @@ class FleetCoalescer:
 
     # ------------------------------------------------------------ submit
     def submit(self, X, trace_id: str, deadline_s: float | None,
-               use_hedge: bool):
-        m = _Member(np.asarray(X), trace_id, deadline_s)
+               use_hedge: bool, tenant: str | None = None):
+        m = _Member(np.asarray(X), trace_id, deadline_s, tenant)
         with self._lock:
             self._pending.append(m)
             lead = self._leaders < self._cap()
@@ -648,10 +705,15 @@ class FleetCoalescer:
         key = _merge_key(first.X)
         if key is None:
             return [first]
+        # same-tenant merge only: a merged dispatch is admitted (and
+        # quota-accounted) replica-side as ONE tenant, so mixing tenants
+        # would bill one tenant for another's rows
+        key = (key, first.tenant)
         group, rows, rest = [first], first.n, []
         while self._pending:
             m = self._pending.popleft()
-            if _merge_key(m.X) == key and rows + m.n <= max_rows:
+            if ((_merge_key(m.X), m.tenant) == key
+                    and rows + m.n <= max_rows):
                 group.append(m)
                 rows += m.n
             else:
@@ -691,7 +753,8 @@ class FleetCoalescer:
             m = live[0]
             try:
                 m.finish(self._router._route(
-                    m.X, m.trace_id, m.remaining_s(now), use_hedge))
+                    m.X, m.trace_id, m.remaining_s(now), use_hedge,
+                    tenant=m.tenant))
             except Exception as e:  # noqa: BLE001 — delivered, not hung
                 m.fail(e)
             return
@@ -709,7 +772,8 @@ class FleetCoalescer:
         try:
             out = self._router._route(
                 X, wire_id, deadline, use_hedge, weight=len(live),
-                member_traces=[m.trace_id for m in live])
+                member_traces=[m.trace_id for m in live],
+                tenant=live[0].tenant)
         except Exception as e:  # noqa: BLE001 — same typed error to all
             for m in live:
                 m.fail(e)

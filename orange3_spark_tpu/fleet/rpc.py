@@ -96,6 +96,10 @@ VERSION_HEADER = "X-OTPU-Version"
 DEADLINE_HEADER = "X-OTPU-Deadline-Ms"
 #: comma-joined trace ids of coalesced members riding one wire dispatch
 MEMBER_TRACES_HEADER = "X-OTPU-Member-Traces"
+#: the caller's tenant identity (serve/tenancy.py); the replica adopts
+#: it into a tenant_scope like the trace header, so replica-side
+#: admission enforces the SAME weighted-fair quotas the caller declared
+TENANT_HEADER = "X-OTPU-Tenant"
 
 _M_RPC = REGISTRY.counter(
     "otpu_fleet_rpc_requests_total",
@@ -302,8 +306,17 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
         from orange3_spark_tpu.resilience.overload import (
             OverloadShedError, request_deadline,
         )
+        from orange3_spark_tpu.serve.tenancy import (
+            TenantQuotaShedError, tenancy_enabled, tenant_scope,
+        )
 
         trace_id = self.headers.get(TRACE_HEADER) or None
+        # tenant adoption mirrors the trace header: the identity the
+        # caller scoped rides the wire and re-enters a tenant_scope here,
+        # so replica-side admission bills the right tenant. Gated on the
+        # kill-switch AND header presence — tenant-less wires unchanged.
+        tenant = (self.headers.get(TENANT_HEADER) or None
+                  if tenancy_enabled() else None)
         if runtime.draining:
             # typed, shed-style: carries the trace id of the request it
             # refused, and ticks the drain counter — never silently drops
@@ -359,13 +372,26 @@ class _ReplicaHandler(BaseHTTPRequestHandler):
                 carried = current_trace_id() or ""
                 with (request_deadline(dl_ms / 1e3) if dl_ms is not None
                       else nullcontext()):
-                    with (self._member_scope(members) if members
+                    with (tenant_scope(tenant) if tenant is not None
                           else nullcontext()):
-                        out = runtime.predict(X)
+                        with (self._member_scope(members) if members
+                              else nullcontext()):
+                            out = runtime.predict(X)
         except ReplicaDrainingError as e:   # drain raced the flag check
             _M_DRAINED.inc()
             self._send_json(503, {
                 "error": "ReplicaDrainingError", "message": str(e),
+                "trace_id": trace_id},
+                headers={TRACE_HEADER: trace_id or ""})
+            return
+        except TenantQuotaShedError as e:
+            # the quota shed travels typed with its evidence so the
+            # client reconstructs the SAME exception class and a caller
+            # sees one error type whether admission ran local or remote
+            self._send_json(503, {
+                "error": "TenantQuotaShedError", "message": str(e)[:500],
+                "reason": getattr(e, "reason", "tenant_inflight"),
+                "tenant": e.tenant, "usage": e.usage, "quota": e.quota,
                 "trace_id": trace_id},
                 headers={TRACE_HEADER: trace_id or ""})
             return
@@ -649,6 +675,17 @@ class FleetClient:
             err = {}
         if err.get("error") == "ReplicaDrainingError":
             raise ReplicaDrainingError(replica=replica, trace_id=trace_id)
+        if err.get("error") == "TenantQuotaShedError":
+            from orange3_spark_tpu.serve.tenancy import (
+                TenantQuotaShedError,
+            )
+
+            raise TenantQuotaShedError(
+                tenant=str(err.get("tenant") or "?"),
+                reason=err.get("reason") or "tenant_inflight",
+                usage=float(err.get("usage") or 0.0),
+                quota=float(err.get("quota") or 0.0),
+                trace_id=trace_id)
         if err.get("error") == "OverloadShedError":
             raise ReplicaOverloadedError(
                 f"replica {replica} shed the request: "
@@ -682,14 +719,26 @@ class FleetClient:
                 timeout_s: float | None = None,
                 conn_slot: list | None = None,
                 member_traces: list | None = None,
+                tenant: str | None = None,
                 ) -> tuple[np.ndarray, dict]:
         """One predict RPC → (prediction array, response headers)."""
+        from orange3_spark_tpu.serve.tenancy import (
+            current_tenant, tenancy_enabled,
+        )
+
         X = np.asarray(X)
         headers = {"Content-Type": NPY_CONTENT_TYPE}
         if trace_id:
             headers[TRACE_HEADER] = trace_id
         if member_traces:
             headers[MEMBER_TRACES_HEADER] = ",".join(member_traces)
+        if tenancy_enabled():
+            # explicit arg (the router captured the caller's scope on its
+            # own thread) wins over this thread's ambient scope; no
+            # tenant → no header — the tenant-less wire is byte-identical
+            tenant = tenant if tenant is not None else current_tenant()
+            if tenant:
+                headers[TENANT_HEADER] = tenant
         if fastwire.fastwire_enabled():
             # header gated with the rest of the fast path so that
             # OTPU_FLEET_FASTWIRE=0 restores the old wire byte-for-byte

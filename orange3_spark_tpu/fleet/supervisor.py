@@ -106,6 +106,9 @@ class ReplicaManager:
                               else knobs.get_int("OTPU_FLEET_REPLICAS"))
         base = int(port_base if port_base is not None
                    else knobs.get_int("OTPU_FLEET_PORT_BASE"))
+        # kept for elastic growth: add_replica() allocates ports on the
+        # same scheme the initial fleet used
+        self.port_base = base
         self.env = dict(env or {})
         # per-replica overrides (e.g. the bench's injected straggler:
         # one replica carries its own OTPU_FAULT_SPEC service delay)
@@ -120,9 +123,11 @@ class ReplicaManager:
             for i in range(self.n_replicas)
         ]
         # per-replica seeded backoff: the same schedule a transient source
-        # read retries on, so one knob family (OTPU_RETRY_*) tunes both
-        self._policies = [RetryPolicy.from_env(seed=i)
-                          for i in range(self.n_replicas)]
+        # read retries on, so one knob family (OTPU_RETRY_*) tunes both.
+        # Keyed by replica id, NOT list position: the autoscaler adds and
+        # removes replicas, so ids and positions diverge over time
+        self._policies = {i: RetryPolicy.from_env(seed=i)
+                          for i in range(self.n_replicas)}
         self._lock = threading.Lock()
         self._monitor: threading.Thread | None = None
         self._stop = threading.Event()
@@ -179,18 +184,70 @@ class ReplicaManager:
         return self
 
     # ------------------------------------------------------------- clients
+    def _handle(self, replica_id: int) -> ReplicaHandle:
+        """Handle lookup BY ID (positions shift once the autoscaler
+        removes a replica, so ``self.handles[rid]`` is wrong in general)."""
+        for h in self.handles:
+            if h.replica_id == replica_id:
+                return h
+        raise KeyError(f"unknown replica id {replica_id}")
+
     def client(self, replica_id: int):
         from orange3_spark_tpu.fleet.rpc import FleetClient
 
         c = self._clients.get(replica_id)
         if c is None:
-            h = self.handles[replica_id]
+            h = self._handle(replica_id)
             c = self._clients[replica_id] = FleetClient(
                 "127.0.0.1", h.port, name=f"replica-{replica_id}")
         return c
 
     def endpoints(self) -> list[tuple[int, str, int]]:
         return [(h.replica_id, "127.0.0.1", h.port) for h in self.handles]
+
+    # ------------------------------------------------------- elastic sizing
+    def add_replica(self) -> int:
+        """Grow the fleet by one replica through the SAME spawn path a
+        crash restart uses (fleet/control.py's scale-up). Returns the new
+        replica id; the caller (autoscaler) registers it with the router,
+        whose /readyz polling + breaker probe admit it once warm."""
+        with self._lock:
+            rid = (max((h.replica_id for h in self.handles), default=-1)
+                   + 1)
+            port = (self.port_base + rid if self.port_base
+                    else free_port())
+            h = ReplicaHandle(rid, port)
+            from orange3_spark_tpu.resilience.retry import RetryPolicy
+
+            self._policies[rid] = RetryPolicy.from_env(seed=rid)
+            self.handles.append(h)
+            self._spawn(h)
+        _M_LIFECYCLE.inc(1, replica=f"replica-{rid}", reason="scale_up")
+        trace.instant("replica_add", replica=rid, port=port)
+        return rid
+
+    def remove_replica(self, replica_id: int) -> int | None:
+        """Shrink the fleet by one replica: drain-then-stop (in-flight
+        work finishes inside the drain budget — scale-down never kills
+        live requests), then forget the handle so the monitor never
+        restarts it. Returns the exit code (0 = clean drain)."""
+        h = self._handle(replica_id)          # KeyError on unknown id
+        code = self.drain_stop(replica_id)
+        with self._lock:
+            if h in self.handles:
+                self.handles.remove(h)
+            self._policies.pop(replica_id, None)
+            c = self._clients.pop(replica_id, None)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 - already gone is fine
+                pass
+        self._unlink_uds(h.port)
+        _M_LIFECYCLE.inc(1, replica=f"replica-{replica_id}",
+                         reason="scale_down")
+        trace.instant("replica_remove", replica=replica_id, rc=code)
+        return code
 
     # --------------------------------------------------------- digest hook
     def on_digest(self, cb) -> None:
@@ -229,7 +286,7 @@ class ReplicaManager:
     def _monitor_loop(self) -> None:
         while not self._stop.is_set():
             now = time.monotonic()
-            for h in self.handles:
+            for h in list(self.handles):   # snapshot: scale ops mutate
                 with self._lock:
                     if h.stopping or h.proc is None:
                         continue
@@ -272,7 +329,7 @@ class ReplicaManager:
     def kill(self, replica_id: int) -> None:
         """HARD kill (the failure drill): group SIGKILL, no stopping mark
         — the monitor must notice and restart it."""
-        h = self.handles[replica_id]
+        h = self._handle(replica_id)
         if h.proc is not None:
             _M_LIFECYCLE.inc(1, replica=f"replica-{replica_id}",
                              reason="kill")
@@ -292,7 +349,7 @@ class ReplicaManager:
             ReplicaUnavailableError, drain_budget_s,
         )
 
-        h = self.handles[replica_id]
+        h = self._handle(replica_id)
         with self._lock:
             h.stopping = True
         if h.proc is None:

@@ -641,6 +641,12 @@ class ReplicaLoad:
     # device_bytes as the capacity half of its load signal
     goodput: dict | None = None
     device_bytes: dict = dataclasses.field(default_factory=dict)
+    # weighted-fair tenancy (serve/tenancy.py): this replica's typed
+    # quota sheds, held slots and total grants per tenant — the fleet
+    # view of who is over quota (tools/fleet_top.py renders the table)
+    tenant_sheds: dict = dataclasses.field(default_factory=dict)
+    tenant_inflight: dict = dataclasses.field(default_factory=dict)
+    tenant_granted: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -661,6 +667,9 @@ class FleetDigest:
     # pool totals (reuse %), coalescer merge stats and SHM byte counts —
     # None when no router is attached or the fast wire never ran
     wire: dict | None = None
+    # control plane (fleet/control.py): the active autoscaler's state()
+    # block as of this tick — None when none is attached
+    autoscaler: dict | None = None
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -998,7 +1007,8 @@ class FleetCollector:
                             aggregates[mname] = (
                                 aggregates.get(mname, 0.0)
                                 + sum(m["values"].values()))
-        return {
+        digest = self.digest()
+        out = {
             "fleetz_schema": FLEETZ_SCHEMA_VERSION,
             "at": time.time(),
             "scrape_s": self.scrape_s,
@@ -1009,9 +1019,27 @@ class FleetCollector:
                            for k, v in sorted(aggregates.items())},
             "slo": (self.slo.last_verdicts
                     if self.slo is not None else []),
-            "digest": self.digest().to_dict(),
+            "digest": digest.to_dict(),
             "last_incident_path": self.last_incident_path,
         }
+        # control plane (PR 20): present only when it has state, so a
+        # tenant-less fixed-size fleet's body keeps the exact old keys.
+        # Per-tenant sheds aggregate the replicas' scraped counters plus
+        # THIS process's ledger (the router sheds caller-side too).
+        from orange3_spark_tpu.serve.tenancy import tenant_shed_counts
+
+        tenants: dict[str, float] = {}
+        for r in digest.replicas:
+            for t, v in (r.tenant_sheds or {}).items():
+                tenants[t] = tenants.get(t, 0.0) + float(v)
+        for t, reasons in tenant_shed_counts().items():
+            tenants[t] = tenants.get(t, 0.0) + float(sum(reasons.values()))
+        if tenants:
+            out["tenants"] = {"sheds": {t: round(v, 6) for t, v
+                                        in sorted(tenants.items())}}
+        if digest.autoscaler is not None:
+            out["autoscaler"] = digest.autoscaler
+        return out
 
     # -------------------------------------------------------------- digest
     def digest(self) -> FleetDigest:
@@ -1051,13 +1079,22 @@ class FleetCollector:
                     goodput=goodput or None,
                     device_bytes=_values_by_label(
                         samples, "otpu_device_bytes", "owner"),
+                    tenant_sheds=_values_by_label(
+                        samples, "otpu_tenant_sheds_total", "tenant"),
+                    tenant_inflight=_values_by_label(
+                        samples, "otpu_tenant_inflight", "tenant"),
+                    tenant_granted=_values_by_label(
+                        samples, "otpu_tenant_granted_total", "tenant"),
                 ))
+        from orange3_spark_tpu.fleet.control import active_autoscaler_state
+
         return FleetDigest(
             at_wall=time.time(), scrape_s=self.scrape_s, replicas=loads,
             ewma_p95_ms=ewma_p95_ms,
             slo=(self.slo.last_verdicts if self.slo is not None else []),
             stale_replicas=len(stale),
-            wire=self._wire_stats())
+            wire=self._wire_stats(),
+            autoscaler=active_autoscaler_state())
 
     def _wire_stats(self) -> dict | None:
         """Aggregate the fast-wire signals off the attached router:

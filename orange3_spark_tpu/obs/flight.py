@@ -139,6 +139,25 @@ def _control_plane(context=None) -> dict:
                 out["mb"] = d
     except Exception:  # noqa: BLE001 - diagnostics only
         pass
+    try:
+        # weighted-fair tenancy (serve/tenancy.py): who was over quota
+        # when the incident froze — present only once a tenant exists,
+        # so tenant-less bundles keep their exact pre-tenancy shape
+        from orange3_spark_tpu.serve.tenancy import tenant_shed_counts
+
+        tenants: dict = {}
+        adm = getattr(context, "admission", None) if context else None
+        if adm is not None:
+            table = adm.tenancy_snapshot()
+            if table:
+                tenants["fair_share"] = table
+        sheds = tenant_shed_counts()
+        if sheds:
+            tenants["sheds"] = sheds
+        if tenants:
+            out["tenants"] = tenants
+    except Exception:  # noqa: BLE001 - diagnostics only
+        pass
     return out
 
 

@@ -120,14 +120,27 @@ def ready_body(context=None) -> tuple[dict, bool]:
     else:
         reason = None
     ready = reason is None
-    return {
+    body = {
         "status": "ready" if ready else "unready",
         "ready": ready,
         "reason": reason,
         "draining": draining,
         "warmup_complete": warm,
         "context_active": ctx is not None,
-    }, ready
+    }
+    # control-plane status (PR 20): keys appear ONLY when the control
+    # plane has something to say — tenant-less processes with no
+    # autoscaler keep the exact pre-tenancy body, byte for byte
+    from orange3_spark_tpu.fleet.control import active_autoscaler_state
+    from orange3_spark_tpu.serve.tenancy import tenant_shed_counts
+
+    sheds = tenant_shed_counts()
+    if sheds:
+        body["tenants"] = {"sheds": sheds}
+    scaler = active_autoscaler_state()
+    if scaler is not None:
+        body["autoscaler"] = scaler
+    return body, ready
 
 
 def spans_body(path: str) -> dict:

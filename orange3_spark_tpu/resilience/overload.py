@@ -208,6 +208,10 @@ class AdmissionController:
         # the owning context may attach a richer diagnostics provider
         # (breaker states) that shed errors carry
         self.diagnostics_hook = None
+        # weighted-fair tenancy state (serve/tenancy.py), built lazily on
+        # the first tenant-scoped acquire; None = anonymous single-tenant
+        # admission, bitwise the pre-tenancy behavior
+        self._fair_share = None
 
     # ------------------------------------------------------------ state
     def enabled(self) -> bool:
@@ -270,6 +274,48 @@ class AdmissionController:
             est_wait_s=est, deadline_s=deadline_s, diagnostics=self._diag(),
             trace_id=current_trace_id())
 
+    def _shed_tenant(self, tenant: str, reason: str, usage: float,
+                     quota: float, d: float | None):
+        """Typed per-tenant quota shed (cv held — same discipline as
+        ``_shed``: the flight dump happens outside, in ``slot``)."""
+        from orange3_spark_tpu.obs.context import (
+            current_trace_id, flag_current_trace,
+        )
+        from orange3_spark_tpu.serve.tenancy import (
+            TenantQuotaShedError, _record_tenant_shed,
+        )
+
+        _record_shed(reason)
+        _record_tenant_shed(tenant, reason)
+        flag_current_trace()
+        raise TenantQuotaShedError(
+            tenant=tenant, reason=reason, usage=usage, quota=quota,
+            queue_depth=self._waiters, inflight=self._inflight,
+            est_wait_s=self.estimate_wait_s(self._waiters),
+            deadline_s=d, diagnostics=self._diag(),
+            trace_id=current_trace_id())
+
+    def _fair(self):
+        """The weighted-fair tenancy state, (re)built when the
+        ``OTPU_TENANT_SPEC`` arm changes (bench A/B flips it live).
+        Callers hold the returned object for one acquire/release pair so
+        a mid-flight rebuild never mismatches grant and release."""
+        from orange3_spark_tpu.serve.tenancy import TenantFairShare
+        from orange3_spark_tpu.utils import knobs
+
+        raw = knobs.get_str("OTPU_TENANT_SPEC")
+        fair = self._fair_share
+        if fair is None or fair.spec_raw != raw:
+            fair = TenantFairShare(clock=self._clock)
+            self._fair_share = fair
+        return fair
+
+    def tenancy_snapshot(self) -> dict:
+        """Live per-tenant fairness table ({} until a tenant-scoped
+        request arrives) — the /fleetz and fleet_top surface."""
+        fair = self._fair_share
+        return fair.snapshot() if fair is not None else {}
+
     @staticmethod
     def _dump_shed(err: "OverloadShedError") -> None:
         """Black box (obs/flight.py): the first shed of an overload spell
@@ -315,12 +361,22 @@ class AdmissionController:
         if not self.enabled():
             yield
             return
+        from orange3_spark_tpu.serve.tenancy import (
+            current_tenant, tenancy_enabled,
+        )
+
+        tenant = current_tenant() if tenancy_enabled() else None
+        fair = self._fair() if tenant is not None else None
         d = deadline_s if deadline_s is not None else _ambient_deadline_s()
+        if d is None and fair is not None:
+            # the tenant's declared default deadline applies only when
+            # neither the call nor the ambient scope set one
+            d = fair.tenant_deadline_s(tenant)
         if d is not None and math.isinf(d):
             d = None    # request_deadline(inf): admitted work (the mb
             #             worker) waits for a slot but is never shed
         try:
-            self._acquire(d)
+            self._acquire(d, tenant=tenant, fair=fair)
         except OverloadShedError as e:
             # the raise already released self._cv — the flight dump's
             # stack/registry/disk work must never run under it
@@ -334,10 +390,27 @@ class AdmissionController:
             with self._cv:
                 self._inflight -= 1
                 _M_INFLIGHT.set(self._inflight)
-                self._cv.notify()
+                if self._fair_share is not None:
+                    # tenant-gated waiters sit behind a DRR grant check:
+                    # a single notify could wake a waiter the DRR head
+                    # is NOT, which re-waits and swallows the wakeup —
+                    # wake everyone and let may_grant() pick
+                    if fair is not None:
+                        fair.release(tenant)
+                    self._cv.notify_all()
+                else:
+                    self._cv.notify()
 
-    def _acquire(self, d: float | None) -> None:
+    def _acquire(self, d: float | None, *, tenant: str | None = None,
+                 fair=None) -> None:
         with self._cv:
+            if fair is not None:
+                quota = fair.try_admit(
+                    tenant, max_inflight=self.max_inflight,
+                    max_queue=self.max_queue)
+                if quota is not None:
+                    reason, usage, cap = quota
+                    self._shed_tenant(tenant, reason, usage, cap, d)
             depth = self._waiters
             backlog = depth + max(self._inflight - self.max_inflight + 1, 0)
             # both sheds apply only to deadline-carrying requests — a
@@ -353,9 +426,16 @@ class AdmissionController:
                     self._shed("projected_wait", depth, est, d)
             self._waiters += 1
             _M_QUEUE_DEPTH.set(self._waiters)
+            if fair is not None:
+                fair.note_waiting(tenant, +1)
             t_deadline = (self._clock() + d) if d is not None else None
             try:
-                while self._inflight >= self.max_inflight:
+                # the DRR gate only runs when a slot is actually free
+                # (`or` short-circuits) and only against WAITING tenants,
+                # so some waiter always passes — no gate deadlock
+                while (self._inflight >= self.max_inflight
+                       or (fair is not None
+                           and not fair.may_grant(tenant))):
                     remaining = (t_deadline - self._clock()
                                  if t_deadline is not None else None)
                     if remaining is not None and remaining <= 0:
@@ -363,15 +443,22 @@ class AdmissionController:
                         # notify() to get here — pass it on, or another
                         # waiter (e.g. the deadline-free mb worker)
                         # sleeps forever on a slot that is actually free
-                        self._cv.notify()
+                        if self._fair_share is not None:
+                            self._cv.notify_all()
+                        else:
+                            self._cv.notify()
                         self._shed("deadline", self._waiters - 1,
                                    self.estimate_wait_s(self._waiters), d)
                     self._cv.wait(timeout=remaining)
             finally:
                 self._waiters -= 1
                 _M_QUEUE_DEPTH.set(self._waiters)
+                if fair is not None:
+                    fair.note_waiting(tenant, -1)
             self._inflight += 1
             _M_INFLIGHT.set(self._inflight)
+            if fair is not None:
+                fair.granted(tenant)
 
 
 # ----------------------------------------------------- circuit breaker

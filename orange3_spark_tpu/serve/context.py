@@ -72,6 +72,7 @@ from orange3_spark_tpu.resilience.overload import (
     shed_total,
 )
 from orange3_spark_tpu.serve.cache import ExecutableCache
+from orange3_spark_tpu.serve.tenancy import current_tenant, tenancy_enabled
 from orange3_spark_tpu.utils.dispatch import beat
 from orange3_spark_tpu.utils.profiling import record_serve
 
@@ -191,8 +192,13 @@ def route(kind: str, raw_fn: Callable, model, *args, **kwargs):
         # point; the serve span (and everything under it, including a
         # micro-batched flush on another thread via flow events) carries it
         with _request_scope():
+            # the tenant identity rides the serve span like the dag
+            # label: present only when a tenant is scoped, so tenant-less
+            # spans stay byte-identical
+            tenant = current_tenant() if tenancy_enabled() else None
             with span("serve", kind=kind, rows=table.n_rows,
-                      **({"dag": dag} if dag else {})):
+                      **({"dag": dag} if dag else {}),
+                      **({"tenant": tenant} if tenant else {})):
                 if kind == "transform":
                     return ctx.served_transform(model, table, raw_fn)
                 return ctx.served_predict(model, table, raw_fn)
@@ -551,8 +557,10 @@ class ServingContext:
         # table calls), so this is their per-request trace-id entry point
         dag = getattr(model, "_dag_name", None)
         with _request_scope():
+            tenant = current_tenant() if tenancy_enabled() else None
             with span("serve", kind="array", rows=n,
-                      **({"dag": dag} if dag else {})):
+                      **({"dag": dag} if dag else {}),
+                      **({"tenant": tenant} if tenant else {})):
                 return self._served_array_inner(model, Xall, n)
 
     def _served_array_inner(self, model, Xall: np.ndarray, n: int):

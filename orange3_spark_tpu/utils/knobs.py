@@ -132,6 +132,25 @@ _ALL = [
     # ----------------------------------------------------------- serve/
     Knob("OTPU_SERVE_REQUESTS", "int", 120, "serve",
          "bench.py serving-trace request count."),
+    Knob("OTPU_TENANCY", "flag", "1", "serve",
+         "Multi-tenant weighted-fair serving kill-switch; 0 = no tenant "
+         "header rides the wire and admission ignores tenant scopes "
+         "(the anonymous single-tenant fleet, bitwise)."),
+    Knob("OTPU_TENANT_SPEC", "str", "", "serve",
+         "Per-tenant quota grammar, ';'-separated "
+         "'name:weight=4[,max_inflight=8,deadline_s=0.5]' items "
+         "(malformed raises naming the item); unlisted tenants get "
+         "OTPU_TENANT_DEFAULT_WEIGHT."),
+    Knob("OTPU_TENANT_DEFAULT_WEIGHT", "int", 1, "serve",
+         "Weight assigned to tenants absent from OTPU_TENANT_SPEC "
+         "(weighted-fair shares are weight / sum of active weights)."),
+    Knob("OTPU_TENANT_RATE", "float", 0.0, "serve",
+         "Per-weight-unit token-bucket refill rate (requests/s): a "
+         "tenant refills at weight x rate and sheds typed on an empty "
+         "bucket; 0 = buckets inert (share caps + DRR only)."),
+    Knob("OTPU_TENANT_BURST", "int", 8, "serve",
+         "Token-bucket capacity per weight unit (the burst a tenant may "
+         "spend ahead of its refill rate when OTPU_TENANT_RATE > 0)."),
     Knob("OTPU_WORKFLOW_SERVE", "flag", "1", "serve",
          "Whole-workflow fused serving kill-switch; 0 = a ServedWorkflow "
          "request walks its stages through the per-model serving path "
@@ -212,6 +231,24 @@ _ALL = [
     Knob("OTPU_FLEET_COALESCE_ROWS", "int", 4096, "fleet",
          "Row cap on one coalesced wire dispatch (ladder-clamped merge "
          "size: matches the default serving-ladder max bucket)."),
+    Knob("OTPU_AUTOSCALE", "flag", "1", "fleet",
+         "Digest-driven elastic autoscaling kill-switch; 0 = no "
+         "Autoscaler ever scales (the fixed-size PR-19 fleet, bitwise)."),
+    Knob("OTPU_AUTOSCALE_MIN", "int", 1, "fleet",
+         "Replica floor the autoscaler never drains below."),
+    Knob("OTPU_AUTOSCALE_MAX", "int", 8, "fleet",
+         "Replica ceiling the autoscaler never grows past."),
+    Knob("OTPU_AUTOSCALE_UP_X", "float", 2.0, "fleet",
+         "Scale-up hysteresis band: grow one replica when per-replica "
+         "load pressure (queue depth + in-flight per up replica, plus "
+         "any shed delta or brownout) is at or above this."),
+    Knob("OTPU_AUTOSCALE_DOWN_X", "float", 0.5, "fleet",
+         "Scale-down hysteresis band: drain one replica when per-replica "
+         "load pressure is at or below this with no sheds in the "
+         "window (the bands never overlap: DOWN_X < UP_X enforced)."),
+    Knob("OTPU_AUTOSCALE_COOLDOWN_S", "float", 10.0, "fleet",
+         "Minimum seconds between scale decisions (deterministic on the "
+         "injected clock — no wall-clock randomness)."),
     Knob("OTPU_FLEET_INPROC", "int", 0, "fleet",
          "In-process multi-device replica mode: N > 0 serves through N "
          "device-pinned lanes in THIS process (no sockets, no "

@@ -208,6 +208,24 @@ def _run(argv, timeout=420):
       # ISSUE 9: shed anomalies auto-write flight bundles, and every
       # burst request carried a trace id
       "traced_requests", "trace_coverage", "flight_bundles_written"}),
+    # multi-tenant control plane (ISSUE 20): the weighted-fair tenancy
+    # A/B (same-run 2-tenant skewed burst, unfair vs weighted-fair with
+    # the light tenant's p99 bounded and the burster shedding typed),
+    # the digest-driven autoscale drill over a REAL fleet (grow under
+    # load, drain to min with zero failed trickle requests), and the
+    # OTPU_TENANCY=0 + OTPU_AUTOSCALE=0 parity pin
+    (["bench.py", "--config", "tenancy"],
+     "tenancy_fairness_p99_bound_factor",
+     {"fairness_p99_bound_factor", "fairness_retried",
+      "fairness_p99_bound_factor_first", "light_p99_ms_unfair",
+      "light_p99_ms_fair", "heavy_typed_sheds", "heavy_completed_fair",
+      "light_completed_fair", "completed", "hung", "lost",
+      "autoscale_peak_replicas", "autoscale_final_replicas",
+      "autoscale_min_replicas", "autoscale_max_replicas",
+      "autoscale_decisions", "autoscale_decision_log", "autoscale_state",
+      "autoscale_scaledown_failures", "autoscale_scaledown_trickle_ok",
+      "autoscale_load_failures", "autoscale_load_hung",
+      "elasticity_factor", "tenancy_kill_switch_parity"}),
 ])
 def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
     r = _run(argv)
@@ -477,6 +495,40 @@ def test_harness_emits_one_parseable_line(argv, metric, extra_keys):
         # ISSUE 9: the first shed of the admitted arm auto-wrote a black
         # box (sheds >= 1 is asserted above, so a bundle must exist)
         assert d["flight_bundles_written"] >= 1
+    if "fairness_p99_bound_factor" in extra_keys:
+        # the control-plane claims (ISSUE 20 acceptance), semantics not
+        # just schema. (1) weighted-fair tenancy: on the same-run skewed
+        # burst (heavy offers 8x), the light tenant's p99 under the
+        # weighted-fair spec is >= 3x tighter than first-come-first-
+        # served, the burster's excess sheds TYPED, every light request
+        # completes, and nothing hangs or escapes untyped;
+        assert d["fairness_p99_bound_factor"] is not None
+        assert d["fairness_p99_bound_factor"] >= 3.0, (
+            d["fairness_p99_bound_factor"], "first measurement:",
+            d.get("fairness_p99_bound_factor_first"))
+        if d.get("fairness_retried"):
+            # a retried gate must log WHY it retried
+            assert (d["fairness_p99_bound_factor_first"] is None
+                    or d["fairness_p99_bound_factor_first"] < 3.0)
+        assert d["heavy_typed_sheds"] >= 1
+        assert d["light_completed_fair"] >= 1
+        assert d["hung"] == 0 and d["lost"] == 0
+        # (2) elasticity: the digest-driven autoscaler grew the REAL
+        # fleet to >= 2 replicas under load, then — load gone, past
+        # cooldown — drained back to min via drain-then-stop with ZERO
+        # failed requests during scale-down;
+        assert d["autoscale_peak_replicas"] >= 2, d["autoscale_peak_replicas"]
+        assert d["autoscale_final_replicas"] == d["autoscale_min_replicas"]
+        assert d["autoscale_scaledown_failures"] == 0
+        assert d["autoscale_scaledown_trickle_ok"] >= 1
+        assert d["autoscale_load_failures"] == 0
+        assert d["autoscale_load_hung"] == 0
+        assert d["autoscale_decisions"] >= 2
+        assert d["elasticity_factor"] >= 2.0, d["elasticity_factor"]
+        # (3) both kill-switches off is the PR-19 fleet bitwise: a
+        # scoped caller changes nothing, no fair-share state is built,
+        # and the autoscaler refuses to step
+        assert d["tenancy_kill_switch_parity"] is True
     if "multihost_scaling" in extra_keys:
         # the multihost claims (ISSUE 18 acceptance): the same-run A/B
         # must show >= 1.6x aggregate device-replay throughput for the

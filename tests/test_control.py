@@ -1,0 +1,413 @@
+"""fleet/control.py — digest-driven elastic autoscaling: hysteresis
+bands on an injected clock (up on pressure / sheds / brownout, down on
+idle, cooldown between decisions, min/max bounds, never drain a fleet
+that is not fully up), the band-validation errors, the OTPU_AUTOSCALE
+kill-switch, the /readyz//fleetz state surface, and one real-subprocess
+drill proving scale-down drains rather than kills.
+
+Every schedule rides a fake clock and a fake supervisor; only the final
+drill spawns replica subprocesses (the test_fleet.py convention)."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.fleet.control import (
+    Autoscaler,
+    active_autoscaler_state,
+    set_active_autoscaler,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_autoscale_state(monkeypatch):
+    for k in ("OTPU_AUTOSCALE", "OTPU_AUTOSCALE_MIN", "OTPU_AUTOSCALE_MAX",
+              "OTPU_AUTOSCALE_UP_X", "OTPU_AUTOSCALE_DOWN_X",
+              "OTPU_AUTOSCALE_COOLDOWN_S", "OTPU_TENANCY",
+              "OTPU_TENANT_SPEC"):
+        monkeypatch.delenv(k, raising=False)
+    set_active_autoscaler(None)
+    yield
+    set_active_autoscaler(None)
+
+
+class _Handle:
+    def __init__(self, rid):
+        self.replica_id = rid
+        self.port = 42000 + rid
+
+
+class _FakeSupervisor:
+    """handles/add_replica/remove_replica/_handle — the surface the
+    Autoscaler documents it needs."""
+
+    def __init__(self, n=1):
+        self.handles = [_Handle(i) for i in range(n)]
+        self._next = n
+        self.added: list = []
+        self.removed: list = []
+
+    def add_replica(self):
+        rid = self._next
+        self._next += 1
+        self.handles.append(_Handle(rid))
+        self.added.append(rid)
+        return rid
+
+    def remove_replica(self, rid):
+        self.handles = [h for h in self.handles if h.replica_id != rid]
+        self.removed.append(rid)
+
+    def _handle(self, rid):
+        return next(h for h in self.handles if h.replica_id == rid)
+
+
+def _digest(n_up=1, queue=0, inflight=0, sheds=0, brownout=0):
+    """A synthetic dict digest (the drill's timeline shape)."""
+    return {"replicas": {
+        f"replica-{i}": {"up": True, "stale": False,
+                         "queue_depth": queue, "inflight": inflight,
+                         "shed_total": sheds if i == 0 else 0,
+                         "brownout_level": brownout}
+        for i in range(n_up)}}
+
+
+def _scaler(sup, **kw):
+    clk = kw.pop("clk", [0.0])
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("up_x", 2.0)
+    kw.setdefault("down_x", 0.5)
+    kw.setdefault("cooldown_s", 5.0)
+    return Autoscaler(sup, None, clock=lambda: clk[0], **kw), clk
+
+
+# --------------------------------------------------------- hysteresis
+def test_scale_up_on_pressure_and_cooldown_blocks():
+    sup = _FakeSupervisor(1)
+    scaler, clk = _scaler(sup)
+    d = scaler.step(_digest(n_up=1, queue=7, inflight=1))
+    assert d is not None and d.direction == "up" and d.reason == "pressure"
+    assert d.replicas_before == 1 and d.replicas_after == 2
+    assert len(sup.handles) == 2
+    # same pressure inside the cooldown: NO second decision
+    assert scaler.step(_digest(n_up=2, queue=14, inflight=2)) is None
+    clk[0] += 5.0
+    d2 = scaler.step(_digest(n_up=2, queue=14, inflight=2))
+    assert d2 is not None and d2.replicas_after == 3
+    # at max: pressure can scream, the fleet stays put
+    clk[0] += 5.0
+    assert scaler.step(_digest(n_up=3, queue=30)) is None
+    assert len(sup.handles) == 3
+
+
+def test_scale_up_on_shed_delta_and_brownout():
+    sup = _FakeSupervisor(1)
+    scaler, clk = _scaler(sup)
+    # first look only BASELINES the shed counter — no decision
+    assert scaler.step(_digest(n_up=1, sheds=5)) is None
+    d = scaler.step(_digest(n_up=1, sheds=7))
+    assert d is not None and d.reason == "sheds" and d.shed_delta == 2
+    clk[0] += 5.0
+    d2 = scaler.step(_digest(n_up=2, sheds=7, brownout=2))
+    assert d2 is not None and d2.reason == "brownout"
+
+
+def test_scale_down_on_idle_picks_newest_replica():
+    sup = _FakeSupervisor(3)
+    scaler, clk = _scaler(sup)
+    d = scaler.step(_digest(n_up=3))
+    assert d is not None and d.direction == "down" and d.reason == "idle"
+    assert sup.removed == [2]            # deterministic victim: max id
+    # dead zone: pressure between the bands moves nothing (load 1 per
+    # replica with up_x=2 / down_x=0.5)
+    clk[0] += 5.0
+    assert scaler.step(_digest(n_up=2, inflight=1)) is None
+    clk[0] += 5.0
+    scaler.step(_digest(n_up=2))
+    clk[0] += 5.0
+    # at min: idle forever, still one replica
+    assert scaler.step(_digest(n_up=1)) is None
+    assert len(sup.handles) == 1
+
+
+def test_no_scale_down_while_fleet_not_fully_up():
+    """A replica mid-restart is capacity on the way back — draining
+    another one on top of it would double the hole."""
+    sup = _FakeSupervisor(2)
+    scaler, _clk = _scaler(sup)
+    assert scaler.step(_digest(n_up=1)) is None
+    assert len(sup.handles) == 2
+
+
+def test_no_scale_down_blocked_by_sheds_or_brownout():
+    sup = _FakeSupervisor(2)
+    scaler, _clk = _scaler(sup, max_replicas=2)
+    # baseline look in the dead zone: learns the shed counter, no move
+    assert scaler.step(_digest(n_up=2, inflight=1, sheds=1)) is None
+    # idle pressure but sheds since the last look: at max (no up
+    # possible) and the fresh sheds VETO the down
+    assert scaler.step(_digest(n_up=2, sheds=2)) is None
+    # brownout=1 (below the up rung at 2) also vetoes the down
+    assert scaler.step(_digest(n_up=2, sheds=2, brownout=1)) is None
+    assert scaler.decisions == []
+    # vetoes gone: the idle fleet finally drains
+    d = scaler.step(_digest(n_up=2, sheds=2))
+    assert d is not None and d.direction == "down"
+
+
+def test_object_digest_reads_like_dict_digest():
+    sup = _FakeSupervisor(1)
+    scaler, _clk = _scaler(sup)
+    digest = types.SimpleNamespace(replicas=[
+        types.SimpleNamespace(up=True, stale=False, queue_depth=7,
+                              inflight=1, shed_total=0, brownout_level=0),
+    ])
+    d = scaler.step(digest)
+    assert d is not None and d.direction == "up"
+
+
+def test_stale_and_down_replicas_do_not_count():
+    sup = _FakeSupervisor(1)
+    scaler, _clk = _scaler(sup)
+    digest = {"replicas": {
+        "replica-0": {"up": True, "stale": True, "queue_depth": 99},
+        "replica-1": {"up": False, "stale": False, "queue_depth": 99},
+    }}
+    # no live replica: pressure divides by max(n_up, 1), load is 0
+    assert scaler.step(digest) is None
+
+
+# ------------------------------------------------------------- guards
+def test_overlapping_bands_raise():
+    with pytest.raises(ValueError, match="overlap"):
+        Autoscaler(_FakeSupervisor(), None, min_replicas=1,
+                   max_replicas=3, up_x=1.0, down_x=1.0, cooldown_s=1.0)
+
+
+def test_max_below_min_raises():
+    with pytest.raises(ValueError, match="bounds"):
+        Autoscaler(_FakeSupervisor(), None, min_replicas=4,
+                   max_replicas=2, up_x=2.0, down_x=0.5, cooldown_s=1.0)
+
+
+def test_kill_switch_step_is_inert(monkeypatch):
+    monkeypatch.setenv("OTPU_AUTOSCALE", "0")
+    sup = _FakeSupervisor(1)
+    scaler, _clk = _scaler(sup)
+    assert scaler.step(_digest(n_up=1, queue=99, sheds=9,
+                               brownout=3)) is None
+    assert len(sup.handles) == 1 and scaler.decisions == []
+    assert scaler.state()["enabled"] is False
+
+
+def test_none_digest_is_inert():
+    scaler, _clk = _scaler(_FakeSupervisor(1))
+    assert scaler.step(None) is None
+
+
+# ----------------------------------------------------- router wiring
+class _FakeEndpoint:
+    def __init__(self, rid):
+        self.replica_id = rid
+        self.closed = []
+        self.client = types.SimpleNamespace(
+            close=lambda: self.closed.append(rid))
+
+
+class _FakeRouter:
+    def __init__(self):
+        self.table: dict[int, _FakeEndpoint] = {}
+        self.events: list = []
+
+    def add_endpoint(self, rid, host, port):
+        self.table[rid] = _FakeEndpoint(rid)
+        self.events.append(("add", rid))
+
+    def remove_endpoint(self, rid):
+        self.events.append(("remove", rid))
+        return self.table.pop(rid)
+
+
+def test_router_table_tracks_scale_decisions():
+    sup = _FakeSupervisor(1)
+    router = _FakeRouter()
+    clk = [0.0]
+    scaler = Autoscaler(sup, router, min_replicas=1, max_replicas=2,
+                        up_x=2.0, down_x=0.5, cooldown_s=1.0,
+                        clock=lambda: clk[0])
+    scaler.step(_digest(n_up=1, queue=7))
+    assert router.events == [("add", 1)]
+    clk[0] += 1.0
+    ep = router.table[1]
+    scaler.step(_digest(n_up=2))
+    # scale-down ordering: table shrank FIRST, the replica drained via
+    # remove_replica, and only then did the endpoint's client close
+    assert router.events == [("add", 1), ("remove", 1)]
+    assert sup.removed == [1] and ep.closed == [1]
+
+
+def test_scale_down_tolerates_unrouted_replica():
+    """A replica that scaled up but never entered the table (still
+    warming when the load vanished) drains without a KeyError."""
+    sup = _FakeSupervisor(2)
+
+    class _EmptyRouter(_FakeRouter):
+        def remove_endpoint(self, rid):
+            raise KeyError(rid)
+
+    scaler = Autoscaler(sup, _EmptyRouter(), min_replicas=1,
+                        max_replicas=2, up_x=2.0, down_x=0.5,
+                        cooldown_s=1.0, clock=lambda: 0.0)
+    d = scaler.step(_digest(n_up=2))
+    assert d is not None and d.direction == "down"
+    assert sup.removed == [1]
+
+
+# ---------------------------------------------------------- reporting
+def test_state_and_cooldown_remaining_on_fake_clock():
+    sup = _FakeSupervisor(1)
+    scaler, clk = _scaler(sup, cooldown_s=5.0)
+    s = scaler.state()
+    assert s["min"] == 1 and s["max"] == 3 and s["replicas"] == 1
+    assert s["decisions"] == 0 and s["last_decision"] is None
+    assert s["cooldown_remaining_s"] == 0.0
+    scaler.step(_digest(n_up=1, queue=7))
+    clk[0] += 1.0
+    s = scaler.state()
+    assert s["replicas"] == 2 and s["decisions"] == 1
+    assert s["last_decision"]["direction"] == "up"
+    assert s["cooldown_remaining_s"] == 4.0
+    clk[0] += 10.0
+    assert scaler.state()["cooldown_remaining_s"] == 0.0
+
+
+def test_active_autoscaler_registration():
+    assert active_autoscaler_state() is None
+    scaler, _clk = _scaler(_FakeSupervisor(1))
+    set_active_autoscaler(scaler)
+    s = active_autoscaler_state()
+    assert s is not None and s["replicas"] == 1
+    set_active_autoscaler(None)
+    assert active_autoscaler_state() is None
+
+
+def test_attach_registers_on_digest_and_active():
+    class _Sup(_FakeSupervisor):
+        def __init__(self):
+            super().__init__(1)
+            self.cbs: list = []
+
+        def on_digest(self, cb):
+            self.cbs.append(cb)
+
+    sup = _Sup()
+    scaler, _clk = _scaler(sup)
+    assert scaler.attach() is scaler
+    assert sup.cbs == [scaler.step]
+    assert active_autoscaler_state() is not None
+
+
+def test_autoscale_metric_ticks_by_direction():
+    from orange3_spark_tpu.obs.registry import REGISTRY
+
+    m = REGISTRY.get("otpu_autoscale_total")
+    before_up = m.value(dir="up")
+    before_down = m.value(dir="down")
+    sup = _FakeSupervisor(1)
+    scaler, clk = _scaler(sup, cooldown_s=1.0)
+    scaler.step(_digest(n_up=1, queue=7))
+    clk[0] += 1.0
+    scaler.step(_digest(n_up=2))
+    assert m.value(dir="up") == before_up + 1
+    assert m.value(dir="down") == before_down + 1
+
+
+# ------------------------------------------------- subprocess drill
+def test_scale_down_drains_live_fleet_without_losing_requests(
+        tmp_path, session):
+    """The acceptance's scale-down claim against REAL replica
+    subprocesses: concurrent tenant-scoped predicts ride through a
+    drain-then-stop scale-down and every caller gets a correct result
+    or a typed error — zero lost, zero hung."""
+    from orange3_spark_tpu.fleet import rollout as ro
+    from orange3_spark_tpu.fleet.router import (
+        FleetRouter, NoReplicaAvailableError, ReplicaDrainingError,
+        ReplicaUnavailableError,
+    )
+    from orange3_spark_tpu.fleet.supervisor import ReplicaManager
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+    from orange3_spark_tpu.resilience.overload import OverloadShedError
+    from orange3_spark_tpu.serve.tenancy import tenant_scope
+
+    rng = np.random.default_rng(3)
+    X = np.concatenate([
+        rng.standard_normal((2048, 4)).astype(np.float32),
+        rng.integers(0, 500, (2048, 4)).astype(np.float32),
+    ], axis=1)
+    y = (rng.random(2048) < 0.3).astype(np.float32)
+    model = StreamingHashedLinearEstimator(
+        n_dims=1 << 10, n_dense=4, n_cat=4, epochs=1, step_size=0.05,
+        chunk_rows=1024,
+    ).fit_stream(array_chunk_source(X, y, chunk_rows=1024),
+                 session=session)
+    root = str(tmp_path / "models")
+    ro.publish_version(model, root, n_cols=8)
+    mgr = ReplicaManager(root, n_replicas=2, ladder_max=256,
+                         env={"JAX_PLATFORMS": "cpu"})
+    mgr.start()
+    assert mgr.wait_ready(timeout_s=90), "fleet never ready"
+    router = FleetRouter(mgr.endpoints(), hedging=False)
+    router.refresh()
+    scaler = Autoscaler(mgr, router, min_replicas=1, max_replicas=2,
+                        up_x=2.0, down_x=0.5, cooldown_s=0.0)
+    expect = np.asarray(router.predict(X[:64]))
+    stop = threading.Event()
+    failures: list = []
+
+    def caller(tenant):
+        while not stop.is_set():
+            try:
+                with tenant_scope(tenant):
+                    out = router.predict(X[:64])
+                if not np.array_equal(out, expect):
+                    failures.append("wrong answer")
+                    return
+            except (ReplicaUnavailableError, ReplicaDrainingError,
+                    NoReplicaAvailableError, OverloadShedError):
+                pass                        # typed mid-drain is fine
+            except Exception as e:  # noqa: BLE001 - untyped = lost
+                failures.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=caller, daemon=True,
+                                args=("gold" if i % 2 else "silver",))
+               for i in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.2)                     # callers in flight...
+        d = scaler.step(_digest(n_up=2))    # ...drain-then-stop one
+        assert d is not None and d.direction == "down"
+        assert len(mgr.handles) == 1
+        time.sleep(0.2)                     # survivors keep serving
+        stop.set()
+        for t in threads:
+            t.join(15.0)
+            assert not t.is_alive(), "a caller hung across scale-down"
+        assert not failures, failures[:3]
+        # the shrunken fleet still answers correctly
+        np.testing.assert_array_equal(router.predict(X[:64]), expect)
+    finally:
+        stop.set()
+        router.close()
+        mgr.stop_all()

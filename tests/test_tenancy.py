@@ -1,0 +1,506 @@
+"""serve/tenancy.py — multi-tenant weighted-fair admission: the
+tenant_scope contextvar, the OTPU_TENANT_SPEC grammar, deficit-round-
+robin slot grants with per-tenant caps and token buckets, the typed
+TenantQuotaShedError, the X-OTPU-Tenant wire header's adoption on the
+replica side, tenant-scoped rollout pointers, the observability
+surfaces (/readyz, /fleetz, fleet digest, flight bundles), and the
+shutdown races every caller must survive typed.
+
+Fake clocks everywhere a schedule matters; the wire tests run against
+an in-process ReplicaServer on a loopback port (no subprocesses)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from orange3_spark_tpu.resilience.overload import (
+    AdmissionController, OverloadShedError,
+)
+from orange3_spark_tpu.serve.tenancy import (
+    TenantFairShare,
+    TenantQuotaShedError,
+    current_tenant,
+    parse_tenant_spec,
+    reset_tenant_sheds,
+    tenant_scope,
+    tenant_shed_counts,
+    tenancy_enabled,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tenancy_state(monkeypatch):
+    for k in ("OTPU_TENANCY", "OTPU_TENANT_SPEC",
+              "OTPU_TENANT_DEFAULT_WEIGHT", "OTPU_TENANT_RATE",
+              "OTPU_TENANT_BURST", "OTPU_RESILIENCE",
+              "OTPU_ADMISSION_DEADLINE_S", "OTPU_ADMISSION_SERVICE_MS"):
+        monkeypatch.delenv(k, raising=False)
+    reset_tenant_sheds()
+    yield
+    reset_tenant_sheds()
+
+
+# ------------------------------------------------------- spec grammar
+def test_parse_tenant_spec_full_grammar():
+    by = parse_tenant_spec(
+        "gold:weight=4;silver:weight=2,max_inflight=8,deadline_s=0.5")
+    assert by["gold"].weight == 4 and by["gold"].max_inflight is None
+    assert by["silver"].max_inflight == 8
+    assert by["silver"].deadline_s == 0.5
+
+
+def test_parse_tenant_spec_empty_is_empty():
+    assert parse_tenant_spec("") == {}
+    assert parse_tenant_spec("  ;  ") == {}
+
+
+@pytest.mark.parametrize("spec,needle", [
+    ("bronze", "bronze"),                    # bare name, no ':'
+    ("gold:weight", "weight"),               # param without '='
+    ("gold:weight=fast", "weight"),          # not a number
+    ("gold:weight=0", "weight"),             # must be positive
+    ("gold:max_inflight=1.5", "max_inflight"),
+    ("gold:deadline_s=0", "deadline_s"),     # must be > 0
+    ("gold:turbo=1", "turbo"),               # unknown param
+])
+def test_parse_tenant_spec_malformed_raises_naming_item(spec, needle):
+    with pytest.raises(ValueError, match=needle):
+        parse_tenant_spec(spec)
+
+
+# ------------------------------------------------------- tenant scope
+def test_tenant_scope_nests_and_restores():
+    assert current_tenant() is None
+    with tenant_scope("a"):
+        assert current_tenant() == "a"
+        with tenant_scope("b"):
+            assert current_tenant() == "b"
+        assert current_tenant() == "a"
+    assert current_tenant() is None
+
+
+def test_tenant_scope_is_thread_local():
+    seen = []
+
+    def other():
+        seen.append(current_tenant())
+
+    with tenant_scope("a"):
+        t = threading.Thread(target=other)
+        t.start()
+        t.join(5.0)
+    assert seen == [None]
+
+
+# ------------------------------------------- weighted-fair admission
+def _hold_slot(ac, tenant, entered, release, errors):
+    try:
+        with tenant_scope(tenant):
+            with ac.slot():
+                entered.set()
+                release.wait(10.0)
+    except Exception as e:  # noqa: BLE001 - the assertion target
+        errors.append(e)
+
+
+def test_tenant_max_inflight_hard_cap_sheds_typed(monkeypatch):
+    """A tenant at its spec'd in-flight cap sheds IMMEDIATELY with the
+    quota evidence (tenant/usage/quota/reason) on the typed error,
+    while another tenant still gets a slot."""
+    monkeypatch.setenv("OTPU_TENANCY", "1")
+    monkeypatch.setenv("OTPU_TENANT_SPEC",
+                       "heavy:weight=1,max_inflight=1;light:weight=4")
+    ac = AdmissionController(max_inflight=4, max_queue=16)
+    entered, release = threading.Event(), threading.Event()
+    errors: list = []
+    t = threading.Thread(target=_hold_slot,
+                         args=(ac, "heavy", entered, release, errors),
+                         daemon=True)
+    t.start()
+    assert entered.wait(5.0)
+    with pytest.raises(TenantQuotaShedError) as ei:
+        with tenant_scope("heavy"):
+            with ac.slot():
+                pass
+    e = ei.value
+    assert e.tenant == "heavy" and e.reason == "tenant_inflight"
+    assert e.usage >= e.quota == 1
+    assert isinstance(e, OverloadShedError)     # one except clause fits
+    # the OTHER tenant is untouched by heavy's cap
+    with tenant_scope("light"):
+        with ac.slot():
+            pass
+    release.set()
+    t.join(5.0)
+    assert not errors
+    assert tenant_shed_counts()["heavy"]["tenant_inflight"] == 1
+
+
+def test_drr_grants_follow_weights_on_fake_clock():
+    """With one slot and three waiting tenants, deficit-round-robin
+    grants land ~proportional to weight over a window."""
+    fair = TenantFairShare(parse_tenant_spec("a:weight=4;b:weight=2;"
+                                             "c:weight=1"),
+                           clock=lambda: 0.0)
+    for name in ("a", "b", "c"):
+        fair.note_waiting(name, +1)
+    grants: dict = {"a": 0, "b": 0, "c": 0}
+    for _ in range(70):
+        head = next(n for n in ("a", "b", "c") if fair.may_grant(n))
+        fair.granted(head)
+        grants[head] += 1
+        fair.release(head)
+    # 4:2:1 over 70 grants = 40/20/10
+    assert grants["a"] == 40 and grants["b"] == 20 and grants["c"] == 10
+
+
+def test_token_bucket_rate_limits_and_refills_on_fake_clock(monkeypatch):
+    monkeypatch.setenv("OTPU_TENANT_RATE", "1.0")    # 1 token/s * weight
+    monkeypatch.setenv("OTPU_TENANT_BURST", "2")
+    clk = [0.0]
+    fair = TenantFairShare(parse_tenant_spec("a:weight=1"),
+                           clock=lambda: clk[0])
+    # burst capacity = weight * burst = 2 tokens; drain them
+    for _ in range(2):
+        assert fair.try_admit("a", max_inflight=8, max_queue=8) is None
+        fair.granted("a")
+        fair.release("a")
+    quota = fair.try_admit("a", max_inflight=8, max_queue=8)
+    assert quota is not None and quota[0] == "tenant_rate"
+    clk[0] += 1.0                                    # 1 s -> 1 token back
+    assert fair.try_admit("a", max_inflight=8, max_queue=8) is None
+
+
+def test_fairness_under_contention_bounds_light_tenant(monkeypatch):
+    """The acceptance shape in miniature: heavy floods a 2-slot
+    controller, light's requests all complete and heavy's excess sheds
+    typed — nothing hangs, nothing escapes untyped."""
+    monkeypatch.setenv("OTPU_TENANCY", "1")
+    monkeypatch.setenv("OTPU_TENANT_SPEC",
+                       "light:weight=4;heavy:weight=1,max_inflight=1")
+    monkeypatch.setenv("OTPU_RESILIENCE", "1")
+    ac = AdmissionController(max_inflight=2, max_queue=32)
+    outcomes: list = []
+    lock = threading.Lock()
+
+    def one(tenant):
+        try:
+            with tenant_scope(tenant):
+                with ac.slot():
+                    time.sleep(0.005)
+            kind = "ok"
+        except TenantQuotaShedError:
+            kind = "tenant_shed"
+        except Exception:  # noqa: BLE001 - untyped escape = the failure
+            kind = "lost"
+        with lock:
+            outcomes.append((tenant, kind))
+
+    jobs = ["heavy"] * 24 + ["light"] * 6
+    threads = [threading.Thread(target=one, args=(t,), daemon=True)
+               for t in jobs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10.0)
+        assert not t.is_alive(), "a caller hung"
+    assert len(outcomes) == len(jobs)
+    assert sum(1 for t, k in outcomes if t == "light" and k == "ok") == 6
+    assert sum(1 for t, k in outcomes
+               if t == "heavy" and k == "tenant_shed") >= 1
+    assert not any(k == "lost" for _t, k in outcomes)
+
+
+def test_tenancy_kill_switch_no_fair_state(monkeypatch):
+    """OTPU_TENANCY=0: a tenant scope changes NOTHING — no fair-share
+    table is ever built and the single-notify admission path runs."""
+    monkeypatch.setenv("OTPU_TENANCY", "0")
+    monkeypatch.setenv("OTPU_TENANT_SPEC", "a:weight=4")
+    assert not tenancy_enabled()
+    ac = AdmissionController(max_inflight=2, max_queue=8)
+    with tenant_scope("a"):
+        with ac.slot():
+            pass
+    assert ac._fair_share is None
+    assert ac.tenancy_snapshot() == {}
+
+
+def test_spec_change_rebuilds_fair_share(monkeypatch):
+    monkeypatch.setenv("OTPU_TENANCY", "1")
+    monkeypatch.setenv("OTPU_TENANT_SPEC", "a:weight=2")
+    ac = AdmissionController(max_inflight=2, max_queue=8)
+    with tenant_scope("a"):
+        with ac.slot():
+            pass
+    assert ac.tenancy_snapshot()["a"]["weight"] == 2
+    monkeypatch.setenv("OTPU_TENANT_SPEC", "a:weight=5")
+    with tenant_scope("a"):
+        with ac.slot():
+            pass
+    assert ac.tenancy_snapshot()["a"]["weight"] == 5
+
+
+# ------------------------------------------------------------- wire
+class _StubRuntime:
+    def __init__(self, fn=None):
+        self.name = "stub"
+        self.version = "v-test"
+        self.draining = False
+        self.in_flight = 0
+        self.serving_context = None
+        self.tenants_seen: list = []
+        self._fn = fn or (lambda X: np.asarray(X) * 2.0)
+
+    def predict(self, X):
+        self.tenants_seen.append(current_tenant())
+        return self._fn(np.asarray(X))
+
+    def health(self):
+        return {"ok": True}, True
+
+    def initiate_drain(self, reason=""):
+        self.draining = True
+
+
+@pytest.fixture()
+def replica():
+    from orange3_spark_tpu.fleet.rpc import FleetClient, ReplicaServer
+
+    rt = _StubRuntime()
+    server = ReplicaServer(rt).start_background()
+    client = FleetClient("127.0.0.1", server.port)
+    yield rt, client
+    client.close()
+
+
+def test_tenant_header_rides_wire_and_is_adopted(replica, monkeypatch):
+    monkeypatch.setenv("OTPU_TENANCY", "1")
+    rt, client = replica
+    with tenant_scope("gold"):
+        y, _h = client.predict(np.ones((2, 3), np.float32))
+    assert float(np.asarray(y).sum()) == 12.0
+    client.predict(np.ones((1, 2), np.float32), tenant="bronze")
+    client.predict(np.ones((1, 2), np.float32))      # no scope, no header
+    assert rt.tenants_seen == ["gold", "bronze", None]
+
+
+def test_tenant_header_suppressed_by_kill_switch(replica, monkeypatch):
+    monkeypatch.setenv("OTPU_TENANCY", "0")
+    rt, client = replica
+    with tenant_scope("gold"):
+        client.predict(np.ones((1, 2), np.float32))
+    assert rt.tenants_seen == [None]
+
+
+def test_quota_shed_travels_typed_over_wire(monkeypatch):
+    """A replica-side TenantQuotaShedError reconstructs CLIENT-side as
+    the same class with the quota evidence intact."""
+    from orange3_spark_tpu.fleet.rpc import FleetClient, ReplicaServer
+
+    monkeypatch.setenv("OTPU_TENANCY", "1")
+
+    def quota_blown(X):
+        raise TenantQuotaShedError(
+            tenant="gold", reason="tenant_rate", usage=9.0, quota=4.0,
+            queue_depth=3, inflight=2, est_wait_s=0.1)
+
+    rt = _StubRuntime(fn=quota_blown)
+    server = ReplicaServer(rt).start_background()
+    client = FleetClient("127.0.0.1", server.port)
+    try:
+        with pytest.raises(TenantQuotaShedError) as ei:
+            with tenant_scope("gold"):
+                client.predict(np.ones((1, 2), np.float32))
+        assert ei.value.tenant == "gold"
+        assert ei.value.reason == "tenant_rate"
+        assert ei.value.usage == 9.0 and ei.value.quota == 4.0
+    finally:
+        client.close()
+
+
+def test_coalescer_merges_same_tenant_only():
+    """A merged dispatch is quota-billed as ONE tenant, so the group key
+    carries the tenant: same-shape members of different tenants never
+    merge."""
+    from orange3_spark_tpu.fleet.router import (
+        FleetCoalescer, _Member,
+    )
+
+    class _R:
+        endpoints: list = []
+
+    co = FleetCoalescer(_R())
+    X = np.ones((4, 2), np.float32)
+    m_a1 = _Member(X, "t1", None, "a")
+    m_b = _Member(X, "t2", None, "b")
+    m_a2 = _Member(X, "t3", None, "a")
+    co._pending.extend([m_a1, m_b, m_a2])
+    with co._lock:
+        group = co._take_group_locked(max_rows=1024)
+    assert group == [m_a1, m_a2]
+    assert list(co._pending) == [m_b]
+
+
+# ----------------------------------------------- rollout pointers
+def test_rollout_tenant_scoped_pointers(tmp_path):
+    from orange3_spark_tpu.fleet import rollout as ro
+
+    root = str(tmp_path)
+    ro.set_current(root, "v0001")
+    ro.set_current(root, "v0002", tenant="gold")
+    assert ro.read_current(root) == "v0001"
+    assert ro.read_current(root, "gold") == "v0002"
+    # an unscoped tenant falls back to the fleet pointer
+    assert ro.read_current(root, "silver") == "v0001"
+    with pytest.raises(ValueError, match="tenant name"):
+        ro.set_current(root, "v0003", tenant="../evil")
+
+
+# --------------------------------------------- observability surfaces
+def test_ready_body_tenantless_is_byte_compat(monkeypatch):
+    """No tenant ever seen + no autoscaler attached: /readyz grows NO
+    new keys (the tenant-less caller contract)."""
+    from orange3_spark_tpu.fleet.control import set_active_autoscaler
+    from orange3_spark_tpu.obs.server import ready_body
+
+    set_active_autoscaler(None)
+    reset_tenant_sheds()
+    body, _ready = ready_body()
+    assert "tenants" not in body and "autoscaler" not in body
+
+
+def test_ready_body_reports_tenant_sheds(monkeypatch):
+    monkeypatch.setenv("OTPU_TENANCY", "1")
+    monkeypatch.setenv("OTPU_TENANT_SPEC", "heavy:weight=1,max_inflight=1")
+    from orange3_spark_tpu.obs.server import ready_body
+
+    ac = AdmissionController(max_inflight=4, max_queue=8)
+    entered, release = threading.Event(), threading.Event()
+    errors: list = []
+    t = threading.Thread(target=_hold_slot,
+                         args=(ac, "heavy", entered, release, errors),
+                         daemon=True)
+    t.start()
+    assert entered.wait(5.0)
+    with pytest.raises(TenantQuotaShedError):
+        with tenant_scope("heavy"):
+            with ac.slot():
+                pass
+    release.set()
+    t.join(5.0)
+    body, _ready = ready_body()
+    assert body["tenants"]["sheds"]["heavy"]["tenant_inflight"] == 1
+
+
+def test_fleetz_aggregates_tenant_sheds(monkeypatch):
+    """fleetz sums per-tenant sheds across scraped replicas plus the
+    local ledger."""
+    from orange3_spark_tpu.obs.fleetobs import FleetCollector
+
+    class _FakeEp:
+        name = "replica-0"
+
+        def get_text(self, path, timeout_s=None):
+            return 200, ('# TYPE otpu_tenant_sheds_total counter\n'
+                         'otpu_tenant_sheds_total'
+                         '{tenant="gold",reason="tenant_rate"} 3.0\n')
+
+        def get_json(self, path, timeout_s=None):
+            return 200, {}
+
+    col = FleetCollector([_FakeEp()])
+    col.scrape_once()
+    out = col.fleetz()
+    assert out["tenants"]["sheds"]["gold"] == 3.0
+    digest = col.scrape_once()
+    assert digest.replicas[0].tenant_sheds == {"gold": 3.0}
+
+
+def test_flight_bundle_carries_tenant_table(monkeypatch, tmp_path):
+    monkeypatch.setenv("OTPU_TENANCY", "1")
+    monkeypatch.setenv("OTPU_TENANT_SPEC", "gold:weight=4")
+    from orange3_spark_tpu.obs import flight
+
+    ac = AdmissionController(max_inflight=2, max_queue=8)
+    with tenant_scope("gold"):
+        with ac.slot():
+            pass
+
+    class _Ctx:
+        admission = ac
+
+    bundle = flight._control_plane(_Ctx())
+    assert bundle["tenants"]["fair_share"]["gold"]["weight"] == 4
+
+
+# ------------------------------------------------- shutdown races
+def test_shutdown_race_tenant_submits_vs_context_exit(session, monkeypatch):
+    """Concurrent tenant-scoped predicts racing ServingContext.__exit__:
+    every caller gets a correct-length result or a typed error — nothing
+    hangs (the PR-8 convention, now with tenancy engaged)."""
+    monkeypatch.setenv("OTPU_TENANCY", "1")
+    monkeypatch.setenv("OTPU_TENANT_SPEC",
+                       "gold:weight=4;bronze:weight=1,max_inflight=2")
+    from orange3_spark_tpu.io.streaming import array_chunk_source
+    from orange3_spark_tpu.models.hashed_linear import (
+        StreamingHashedLinearEstimator,
+    )
+    from orange3_spark_tpu.serve import BucketLadder, ServingContext
+
+    rng = np.random.default_rng(3)
+    X = np.concatenate([
+        rng.standard_normal((2048, 4)).astype(np.float32),
+        rng.integers(0, 500, (2048, 4)).astype(np.float32),
+    ], axis=1)
+    y = (rng.random(2048) < 0.3).astype(np.float32)
+    model = StreamingHashedLinearEstimator(
+        n_dims=1 << 10, n_dense=4, n_cat=4, epochs=1, step_size=0.05,
+        chunk_rows=1024,
+    ).fit_stream(array_chunk_source(X, y, chunk_rows=1024),
+                 session=session)
+    ladder = BucketLadder(min_bucket=64, max_bucket=1 << 11)
+    ctx = ServingContext(ladder, micro_batch=False)
+    errors: list = []
+    done = threading.Event()
+
+    def caller(tenant):
+        while not done.is_set():
+            try:
+                with tenant_scope(tenant):
+                    out = model.predict(X[:64])
+                if out.shape[0] != 64:
+                    errors.append(AssertionError(out.shape))
+            except (TenantQuotaShedError, OverloadShedError):
+                pass                       # typed under the race is fine
+            except Exception as e:  # noqa: BLE001 - the assertion target
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=caller, daemon=True,
+                                args=("gold" if i % 2 else "bronze",))
+               for i in range(4)]
+    with ctx:
+        ctx.warmup(model, n_cols=8, kinds=("array",), session=session)
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+    time.sleep(0.05)
+    done.set()
+    for t in threads:
+        t.join(10.0)
+        assert not t.is_alive(), "a tenant predict hung across __exit__"
+    assert not errors, errors[:3]
+
+
+# ------------------------------------------------------- drill smoke
+def test_tenancy_drill_smoke():
+    from tools.tenancy_drill import run_drill
+
+    rows = run_drill(service_ms=5.0, per_tenant=4)
+    assert [r["rung"] for r in rows] == ["fairness", "autoscale"]
+    bad = [r for r in rows if not r["ok"]]
+    assert not bad, bad

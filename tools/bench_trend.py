@@ -52,6 +52,11 @@ RATIO_KEYS = frozenset({
     "workflow_fused_speedup",
     "staged_speedup",
     "fit_staged_speedup",
+    # r20: multi-tenant control plane (tenancy config) — weighted-fair
+    # light-tenant p99 bound and the autoscaler's peak/min breathing
+    # ratio, both same-run A/Bs
+    "fairness_p99_bound_factor",
+    "elasticity_factor",
 })
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")

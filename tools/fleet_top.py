@@ -37,7 +37,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _render(digest: dict, slo: list, out=sys.stderr) -> None:
+def _render(digest: dict, slo: list, fair_share: dict | None = None,
+            out=sys.stderr) -> None:
     rows = digest["replicas"]
     hdr = (f"{'replica':<14} {'up':<3} {'stale':<5} {'age_s':>6} "
            f"{'inflt':>5} {'queue':>5} {'shed':>6} {'brown':>5} "
@@ -79,6 +80,36 @@ def _render(digest: dict, slo: list, out=sys.stderr) -> None:
               f"sheds={co.get('sheds', 0)}) "
               f"shm_mb={shm.get('bytes_total', 0.0) / 1e6:.2f} "
               f"shm_fallbacks={shm.get('fallbacks', 0)}", file=out)
+    # per-tenant control-plane table (serve/tenancy.py): slots held,
+    # grants and typed quota sheds aggregated across the fleet; weight
+    # comes from the local fair-share table when one exists ('-' when
+    # attached to a remote fleet whose spec we cannot see)
+    tenants: dict = {}
+    for r in rows:
+        for key in ("tenant_inflight", "tenant_granted", "tenant_sheds"):
+            for t, v in (r.get(key) or {}).items():
+                tenants.setdefault(t, {})[key] = (
+                    tenants.get(t, {}).get(key, 0.0) + v)
+    if tenants:
+        print(f"[fleet-top] {'tenant':<14} {'weight':>6} {'inflt':>5} "
+              f"{'granted':>8} {'sheds':>6}", file=out)
+        fair = fair_share or {}
+        for t in sorted(tenants):
+            row = tenants[t]
+            w = fair.get(t, {}).get("weight", "-")
+            print(f"[fleet-top] {t:<14} {w!s:>6} "
+                  f"{row.get('tenant_inflight', 0.0):>5.0f} "
+                  f"{row.get('tenant_granted', 0.0):>8.0f} "
+                  f"{row.get('tenant_sheds', 0.0):>6.0f}", file=out)
+    scaler = digest.get("autoscaler")
+    if scaler:
+        last = scaler.get("last_decision") or {}
+        print(f"[fleet-top] autoscaler replicas={scaler['replicas']} "
+              f"bounds=[{scaler['min']},{scaler['max']}] "
+              f"decisions={scaler['decisions']} "
+              f"last={last.get('direction', '-')}"
+              f"{'/' + str(last.get('reason')) if last else ''} "
+              f"cooldown_s={scaler['cooldown_remaining_s']}", file=out)
     for v in slo:
         fast = v["rules"]["fast"]
         print(f"[fleet-top] slo {v['slo']:<14} ({v['kind']}) "
@@ -140,16 +171,34 @@ def run_top(session=None, *, requests: int = 8, endpoints=None,
             collector = FleetCollector(
                 router.endpoints, router=router, slo=slo,
                 scrape_s=scrape_s)
-            for _ in range(max(requests, 1)):
-                router.predict(X[:96])
+            # half the demo predicts ride a tenant scope so the
+            # per-tenant table has rows to render
+            from orange3_spark_tpu.serve.tenancy import tenant_scope
+
+            for i in range(max(requests, 1)):
+                if i % 2:
+                    with tenant_scope("demo-gold"):
+                        router.predict(X[:96])
+                else:
+                    router.predict(X[:96])
         digest = collector.scrape_once()
         fleetz = collector.fleetz()
-        _render(digest.to_dict(), fleetz["slo"])
+        # the local fair-share table (weights) when the serving context
+        # runs in THIS process — attach mode has no view into it
+        fair = None
+        if runtime is not None:
+            ctx = getattr(runtime, "serving_context", None)
+            adm = getattr(ctx, "admission", None)
+            if adm is not None:
+                fair = adm.tenancy_snapshot()
+        _render(digest.to_dict(), fleetz["slo"], fair)
         return {
             "digest": digest.to_dict(),
             "slo": fleetz["slo"],
             "staleness": collector.staleness(),
             "fleetz": fleetz,
+            "tenants": fleetz.get("tenants"),
+            "autoscaler": digest.autoscaler,
         }
     finally:
         if router is not None:

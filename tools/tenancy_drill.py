@@ -1,0 +1,235 @@
+"""One-shot multi-tenant control-plane drill — watch fairness fire.
+
+Runs the fleet control plane (serve/tenancy.py, fleet/control.py)
+against synthetic skewed load and prints each rung as it fires:
+
+  fairness    3 tenants (gold/silver/bronze, weights 4/2/1, bronze
+              capped at 1 in-flight slot) contend for a 2-slot
+              admission controller; a holder pins bronze at its cap
+              while bronze offers ~3x everyone else's load: gold and
+              silver complete everything, the burster sheds typed
+              TenantQuotaShedError — the per-tenant fairness table
+              (weights, grants, sheds) is the printed artifact
+  autoscale   a deterministic digest timeline (ramp up, then idle)
+              drives a real Autoscaler over a fake supervisor on a
+              fake clock: the fleet grows 1 -> 3 under pressure
+              through the cooldown bands, then drains back to min —
+              the decision timeline is the printed artifact
+
+Importable: ``run_drill()`` returns the row dicts (the not-slow smoke
+test in tests/test_tenancy.py calls it directly).
+
+Usage:
+    python tools/tenancy_drill.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SPEC = "gold:weight=4;silver:weight=2;bronze:weight=1,max_inflight=1"
+
+
+def run_drill(service_ms: float = 8.0, per_tenant: int = 8) -> list:
+    import concurrent.futures
+    import threading
+
+    from orange3_spark_tpu.resilience.overload import (
+        AdmissionController, OverloadShedError,
+    )
+    from orange3_spark_tpu.serve.tenancy import (
+        TenantQuotaShedError, reset_tenant_sheds, tenant_scope,
+    )
+
+    rows_out: list = []
+
+    def say(msg):
+        print(f"[drill] {msg}", file=sys.stderr)
+
+    # ---- rung 1: weighted-fair admission under a 3-tenant skew ----
+    saved = {k: os.environ.get(k) for k in (
+        "OTPU_TENANCY", "OTPU_TENANT_SPEC", "OTPU_RESILIENCE",
+        "OTPU_ADMISSION_MAX_INFLIGHT", "OTPU_ADMISSION_MAX_QUEUE")}
+    os.environ.update({
+        "OTPU_TENANCY": "1", "OTPU_TENANT_SPEC": SPEC,
+        "OTPU_RESILIENCE": "1", "OTPU_ADMISSION_MAX_INFLIGHT": "2",
+        "OTPU_ADMISSION_MAX_QUEUE": "64",
+    })
+    outcomes: list = []
+    lock = threading.Lock()
+    try:
+        reset_tenant_sheds()
+        ac = AdmissionController()
+        jobs = (["gold"] * per_tenant + ["silver"] * per_tenant
+                + ["bronze"] * (3 * per_tenant))
+
+        def one(tenant: str):
+            try:
+                with tenant_scope(tenant):
+                    with ac.slot():
+                        time.sleep(service_ms / 1e3)  # the "dispatch"
+                kind = "ok"
+            except TenantQuotaShedError:
+                kind = "tenant_shed"
+            except OverloadShedError:
+                kind = "shed"
+            with lock:
+                outcomes.append((tenant, kind))
+
+        # Pin bronze's single in-flight slot for the whole burst so the
+        # cap hit is deterministic: the burster sits *at* its quota
+        # while it offers 3x everyone else's load, instead of racing
+        # the thread scheduler to overlap two 5ms dispatches.
+        entered = threading.Event()
+        release = threading.Event()
+
+        def hold_bronze():
+            try:
+                with tenant_scope("bronze"):
+                    with ac.slot():
+                        entered.set()
+                        release.wait(30.0)
+                kind = "ok"
+            except OverloadShedError:
+                kind = "shed"
+            finally:
+                entered.set()
+            with lock:
+                outcomes.append(("bronze", kind))
+
+        holder = threading.Thread(target=hold_bronze)
+        holder.start()
+        entered.wait(10.0)
+        try:
+            with concurrent.futures.ThreadPoolExecutor(len(jobs)) as ex:
+                list(ex.map(one, jobs))
+        finally:
+            release.set()
+            holder.join(30.0)
+        table = ac.tenancy_snapshot()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    def count(tenant, kind):
+        return sum(1 for t, k in outcomes if t == tenant and k == kind)
+
+    for t in ("gold", "silver", "bronze"):
+        say(f"fairness: {t:<7} weight={table[t]['weight']} "
+            f"ok={count(t, 'ok')} tenant_shed={count(t, 'tenant_shed')} "
+            f"granted={table[t]['granted']}")
+    fairness_ok = (
+        count("gold", "ok") == per_tenant
+        and count("silver", "ok") == per_tenant
+        and count("bronze", "tenant_shed") >= 1
+        # every caller accounted for (pool jobs + the bronze holder)
+        and len(outcomes) == len(jobs) + 1)
+    rows_out.append({
+        "rung": "fairness", "outcomes": len(outcomes),
+        "gold_ok": count("gold", "ok"),
+        "silver_ok": count("silver", "ok"),
+        "bronze_ok": count("bronze", "ok"),
+        "bronze_typed_sheds": count("bronze", "tenant_shed"),
+        "table": table, "ok": fairness_ok,
+    })
+
+    # ---- rung 2: digest timeline breathes a fake fleet 1 -> 3 -> 1 ----
+    from orange3_spark_tpu.fleet.control import Autoscaler
+
+    class _Handle:
+        def __init__(self, rid):
+            self.replica_id = rid
+
+    class _FakeSupervisor:
+        """add/remove_replica surface only — no subprocesses spawned."""
+
+        def __init__(self):
+            self.handles = [_Handle(0)]
+
+        def add_replica(self):
+            rid = max(h.replica_id for h in self.handles) + 1
+            self.handles.append(_Handle(rid))
+            return rid
+
+        def remove_replica(self, rid):
+            self.handles = [h for h in self.handles
+                            if h.replica_id != rid]
+            return 0
+
+    clk = [0.0]
+    sup = _FakeSupervisor()
+    saved_as = os.environ.get("OTPU_AUTOSCALE")
+    os.environ["OTPU_AUTOSCALE"] = "1"
+    try:
+        scaler = Autoscaler(sup, None, min_replicas=1, max_replicas=3,
+                            up_x=2.0, down_x=0.5, cooldown_s=2.0,
+                            clock=lambda: clk[0])
+
+        def digest(load):
+            n = len(sup.handles)
+            per = load // n
+            return {"replicas": {
+                f"replica-{h.replica_id}": {
+                    "up": True, "stale": False, "queue_depth": per,
+                    "inflight": 0, "shed_total": 0, "brownout_level": 0,
+                } for h in sup.handles}}
+
+        timeline = []
+        peak = 1
+        for step in range(20):
+            load = 16 if step < 10 else 0      # ramp, then idle
+            decision = scaler.step(digest(load))
+            peak = max(peak, len(sup.handles))
+            timeline.append({
+                "t": clk[0], "load": load,
+                "replicas": len(sup.handles),
+                "decision": decision.to_dict() if decision else None,
+            })
+            clk[0] += 1.0
+        final = len(sup.handles)
+    finally:
+        if saved_as is None:
+            os.environ.pop("OTPU_AUTOSCALE", None)
+        else:
+            os.environ["OTPU_AUTOSCALE"] = saved_as
+    dirs = [t["decision"]["direction"] for t in timeline if t["decision"]]
+    say(f"autoscale: peak={peak} final={final} decisions={dirs}")
+    rows_out.append({
+        "rung": "autoscale", "peak_replicas": peak,
+        "final_replicas": final, "decisions": dirs,
+        "timeline": timeline,
+        "ok": peak >= 2 and final == 1 and "up" in dirs
+        and "down" in dirs,
+    })
+    return rows_out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--per-tenant", type=int, default=8)
+    args = ap.parse_args()
+    sys.path.insert(0, REPO)
+    results = run_drill(per_tenant=args.per_tenant)
+    bad = [r for r in results if not r["ok"]]
+    print(json.dumps({
+        "metric": "tenancy_drill",
+        "value": len(results),
+        "unit": "rungs_run",
+        "vs_baseline": None,
+        "rungs_ok": len(results) - len(bad),
+        "rungs": results,
+    }))
+    sys.exit(1 if bad else 0)
+
+
+if __name__ == "__main__":
+    main()
